@@ -1,0 +1,23 @@
+"""photon-ml-tpu: a TPU-native (JAX/XLA/pjit/pallas) framework for training
+Generalized Linear Models and GAME/GLMix mixed-effect models at scale.
+
+Brand-new design with the capabilities of LinkedIn Photon-ML (reference
+surveyed in SURVEY.md). The compute path is pure JAX: jit-compiled
+``lax.while_loop`` optimizers (LBFGS/OWLQN/TRON), segment-sum sparse GLM
+objectives, ``psum`` data-parallel reductions over a device mesh, and
+``vmap``-batched per-entity random-effect solvers.
+"""
+
+__version__ = "0.1.0"
+
+from photon_ml_tpu.ops.losses import (  # noqa: F401
+    LOSSES,
+    LogisticLoss,
+    PointwiseLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+    get_loss,
+)
+from photon_ml_tpu.ops.sparse import SparseBatch  # noqa: F401
+from photon_ml_tpu.ops.objective import GLMObjective  # noqa: F401

@@ -1,0 +1,173 @@
+"""Pointwise GLM losses: l(z, y), dl/dz, d2l/dz2 as vectorized JAX functions.
+
+Parity targets (reference, for behavior only — see SURVEY.md §2.c):
+  - logistic: photon-api .../function/glm/LogisticLossFunction.scala
+  - squared:  photon-api .../function/glm/SquaredLossFunction.scala
+  - poisson:  photon-api .../function/glm/PoissonLossFunction.scala
+  - smoothed hinge (Rennie): photon-api .../function/svm/SmoothedHingeLossFunction.scala
+
+All functions are elementwise over arrays of margins ``z`` and labels ``y``
+so they fuse into the surrounding segment-sum/objective computation under XLA.
+Labels with y > 0.5 are treated as positive, matching the reference's
+POSITIVE_RESPONSE_THRESHOLD convention (so both {0,1} and {-1,1} labels work).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_POSITIVE_THRESHOLD = 0.5
+
+
+class PointwiseLoss(NamedTuple):
+    """A pointwise loss l(z, y) with first and second derivatives in z.
+
+    ``has_hessian`` is False for losses that are not twice differentiable
+    (smoothed hinge); the optimizer factory rejects TRON for those, matching
+    the reference's OptimizerFactory behavior.
+    """
+
+    name: str
+    loss: Callable[[Array, Array], Array]
+    dz: Callable[[Array, Array], Array]
+    d2z: Callable[[Array, Array], Array]
+    has_hessian: bool = True
+
+    def loss_and_dz(self, z: Array, y: Array) -> tuple[Array, Array]:
+        return self.loss(z, y), self.dz(z, y)
+
+
+def _y01(y: Array) -> Array:
+    """Map labels to {0, 1} using the positive-response threshold."""
+    return jnp.where(y > _POSITIVE_THRESHOLD, 1.0, 0.0).astype(y.dtype)
+
+
+def _ypm1(y: Array) -> Array:
+    """Map labels to {-1, +1} using the positive-response threshold."""
+    return jnp.where(y > _POSITIVE_THRESHOLD, 1.0, -1.0).astype(y.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Logistic: l(z, y) = log(1 + exp(z)) - y*z  for y in {0,1}
+# ---------------------------------------------------------------------------
+
+def _logistic_loss(z: Array, y: Array) -> Array:
+    # softplus(z) - y*z == log1pExp(-z) for y=1, log1pExp(z) for y=0:
+    # numerically stable for large |z| (softplus is implemented stably).
+    return jax.nn.softplus(z) - _y01(y) * z
+
+
+def _logistic_dz(z: Array, y: Array) -> Array:
+    return jax.nn.sigmoid(z) - _y01(y)
+
+
+def _logistic_d2z(z: Array, y: Array) -> Array:
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+LogisticLoss = PointwiseLoss("logistic", _logistic_loss, _logistic_dz, _logistic_d2z)
+
+
+# ---------------------------------------------------------------------------
+# Squared: l(z, y) = 0.5 * (z - y)^2
+# ---------------------------------------------------------------------------
+
+def _squared_loss(z: Array, y: Array) -> Array:
+    d = z - y
+    return 0.5 * d * d
+
+
+def _squared_dz(z: Array, y: Array) -> Array:
+    return z - y
+
+
+def _squared_d2z(z: Array, y: Array) -> Array:
+    return jnp.ones_like(z)
+
+
+SquaredLoss = PointwiseLoss("squared", _squared_loss, _squared_dz, _squared_d2z)
+
+
+# ---------------------------------------------------------------------------
+# Poisson: l(z, y) = exp(z) - y*z
+# ---------------------------------------------------------------------------
+
+def _poisson_loss(z: Array, y: Array) -> Array:
+    return jnp.exp(z) - y * z
+
+
+def _poisson_dz(z: Array, y: Array) -> Array:
+    return jnp.exp(z) - y
+
+
+def _poisson_d2z(z: Array, y: Array) -> Array:
+    return jnp.exp(z)
+
+
+PoissonLoss = PointwiseLoss("poisson", _poisson_loss, _poisson_dz, _poisson_d2z)
+
+
+# ---------------------------------------------------------------------------
+# Smoothed hinge (Rennie): piecewise quadratic approximation of hinge loss.
+#   u = y*z with y in {-1,+1}
+#   l = 0.5 - u        (u <= 0)
+#       0.5*(1-u)^2    (0 < u < 1)
+#       0              (u >= 1)
+# Not twice differentiable; d2z below is the a.e. second derivative
+# (generalized Hessian), but has_hessian=False gates TRON off.
+# ---------------------------------------------------------------------------
+
+def _smoothed_hinge_loss(z: Array, y: Array) -> Array:
+    u = _ypm1(y) * z
+    return jnp.where(
+        u <= 0.0, 0.5 - u, jnp.where(u < 1.0, 0.5 * (1.0 - u) * (1.0 - u), 0.0)
+    )
+
+
+def _smoothed_hinge_dz(z: Array, y: Array) -> Array:
+    ym = _ypm1(y)
+    u = ym * z
+    du = jnp.where(u < 0.0, -1.0, jnp.where(u < 1.0, u - 1.0, 0.0))
+    return du * ym
+
+
+def _smoothed_hinge_d2z(z: Array, y: Array) -> Array:
+    u = _ypm1(y) * z
+    return jnp.where((u > 0.0) & (u < 1.0), 1.0, 0.0)
+
+
+SmoothedHingeLoss = PointwiseLoss(
+    "smoothed_hinge",
+    _smoothed_hinge_loss,
+    _smoothed_hinge_dz,
+    _smoothed_hinge_d2z,
+    has_hessian=False,
+)
+
+
+LOSSES: dict[str, PointwiseLoss] = {
+    loss.name: loss
+    for loss in (LogisticLoss, SquaredLoss, PoissonLoss, SmoothedHingeLoss)
+}
+
+# Task-type aliases mirroring the reference's TaskType enum.
+_TASK_ALIASES = {
+    "logistic_regression": "logistic",
+    "linear_regression": "squared",
+    "poisson_regression": "poisson",
+    "smoothed_hinge_loss_linear_svm": "smoothed_hinge",
+}
+
+
+def get_loss(name: str) -> PointwiseLoss:
+    key = name.lower()
+    key = _TASK_ALIASES.get(key, key)
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Available: {sorted(LOSSES)}")
+    return LOSSES[key]

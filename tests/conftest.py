@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference tests distributed code in Spark local[*] mode
+(SparkTestUtils.scala:56-75); the TPU-native analog is JAX's host-platform
+device-count override, which gives real multi-device sharding/collective
+semantics on CPU without TPU hardware (SURVEY.md §4).
+
+Must run before jax initializes, hence module-level os.environ writes in
+conftest (pytest imports conftest before test modules import jax).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep CPU compiles single-threaded-ish and quiet for CI stability.
+os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

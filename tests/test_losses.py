@@ -1,0 +1,73 @@
+"""Pointwise-loss unit tests: closed forms, derivatives vs autodiff, stability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops.losses import LOSSES, get_loss
+
+
+@pytest.mark.parametrize("name", sorted(LOSSES))
+def test_dz_matches_autodiff(name, rng):
+    loss = LOSSES[name]
+    z = jnp.asarray(rng.normal(size=64) * 3, jnp.float32)
+    y = jnp.asarray((rng.random(64) > 0.5).astype(np.float32))
+    if name == "poisson":
+        y = jnp.asarray(rng.poisson(2.0, size=64).astype(np.float32))
+    if name == "squared":
+        y = jnp.asarray(rng.normal(size=64).astype(np.float32))
+    auto = jax.vmap(jax.grad(lambda zi, yi: loss.loss(zi, yi)))(z, y)
+    np.testing.assert_allclose(loss.dz(z, y), auto, rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["logistic", "squared", "poisson"])
+def test_d2z_matches_autodiff(name, rng):
+    loss = LOSSES[name]
+    z = jnp.asarray(rng.normal(size=64) * 2, jnp.float32)
+    y = jnp.asarray((rng.random(64) > 0.5).astype(np.float32))
+    auto = jax.vmap(jax.grad(jax.grad(lambda zi, yi: loss.loss(zi, yi))))(z, y)
+    np.testing.assert_allclose(loss.d2z(z, y), auto, rtol=1e-3, atol=1e-5)
+
+
+def test_logistic_closed_form():
+    loss = get_loss("logistic")
+    z = jnp.asarray([0.0, 1.0, -1.0])
+    # positive label: log(1 + exp(-z))
+    np.testing.assert_allclose(
+        loss.loss(z, jnp.ones(3)), np.log1p(np.exp(-np.asarray(z))), rtol=1e-5
+    )
+    # negative label: log(1 + exp(z)); accepts both 0 and -1 encodings
+    for neg in (jnp.zeros(3), -jnp.ones(3)):
+        np.testing.assert_allclose(
+            loss.loss(z, neg), np.log1p(np.exp(np.asarray(z))), rtol=1e-5
+        )
+
+
+def test_logistic_stability_large_margins():
+    loss = get_loss("logistic")
+    z = jnp.asarray([1e4, -1e4], jnp.float32)
+    v_pos = loss.loss(z, jnp.ones(2))
+    v_neg = loss.loss(z, jnp.zeros(2))
+    assert np.all(np.isfinite(v_pos)) and np.all(np.isfinite(v_neg))
+    np.testing.assert_allclose(v_pos, [0.0, 1e4], rtol=1e-5)
+    np.testing.assert_allclose(v_neg, [1e4, 0.0], rtol=1e-5)
+
+
+def test_smoothed_hinge_piecewise():
+    loss = get_loss("smoothed_hinge")
+    # u = y*z regions: u<=0 -> 0.5-u ; 0<u<1 -> 0.5(1-u)^2 ; u>=1 -> 0
+    z = jnp.asarray([-2.0, 0.5, 3.0])
+    y = jnp.ones(3)
+    np.testing.assert_allclose(loss.loss(z, y), [2.5, 0.125, 0.0], rtol=1e-5)
+    np.testing.assert_allclose(loss.dz(z, y), [-1.0, -0.5, 0.0], rtol=1e-5)
+    # negative label flips the margin
+    np.testing.assert_allclose(loss.loss(-z, jnp.zeros(3)), [2.5, 0.125, 0.0], rtol=1e-5)
+    assert not loss.has_hessian
+
+
+def test_task_aliases():
+    assert get_loss("LOGISTIC_REGRESSION").name == "logistic"
+    assert get_loss("linear_regression").name == "squared"
+    assert get_loss("POISSON_REGRESSION").name == "poisson"
+    assert get_loss("smoothed_hinge_loss_linear_svm").name == "smoothed_hinge"
