@@ -1,0 +1,18 @@
+from photon_ml_tpu.data.index_map import (  # noqa: F401
+    INTERCEPT_KEY,
+    IndexMap,
+    MmapIndexMap,
+    feature_key,
+)
+from photon_ml_tpu.data.libsvm import LibSVMData, read_libsvm  # noqa: F401
+from photon_ml_tpu.data.normalization import (  # noqa: F401
+    NormalizationContext,
+    NormalizationType,
+    build_normalization_context,
+)
+from photon_ml_tpu.data.stats import FeatureSummary, summarize  # noqa: F401
+from photon_ml_tpu.data.validators import (  # noqa: F401
+    DataValidationError,
+    ValidationMode,
+    validate,
+)

@@ -1,0 +1,144 @@
+"""Feature index maps: feature name+term <-> dense integer id.
+
+Reference analog: photon-api util/{IndexMap,DefaultIndexMap,PalDBIndexMap}
+(SURVEY.md §2.c "Index maps"). The PalDB off-heap store is replaced by a
+host-side persisted format designed for zero-parse mmap loading: a sorted
+uint64-hash table (binary-searchable via numpy memmap) plus a names blob for
+reverse lookup. Index maps live only on the host — devices see dense int32
+feature ids, never strings.
+
+Feature keys follow the reference convention name + '\\x01' + term
+(photon-client util/Utils.getFeatureKey).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Iterable, Iterator, Mapping, Optional, Sequence
+
+import numpy as np
+
+DELIMITER = "\x01"
+INTERCEPT_KEY = "(INTERCEPT)"  # reference: GLMSuite/Constants INTERCEPT_NAME_TERM
+
+
+def feature_key(name: str, term: str = "") -> str:
+    return f"{name}{DELIMITER}{term}" if term else name
+
+
+def _hash64(key: str) -> int:
+    # stable across processes (unlike Python's salted hash)
+    return int.from_bytes(hashlib.blake2b(key.encode(), digest_size=8).digest(), "little")
+
+
+class IndexMap(Mapping[str, int]):
+    """In-memory feature index map (DefaultIndexMap analog) with optional
+    binary persistence for fast reload (PalDBIndexMap analog)."""
+
+    def __init__(self, names: Sequence[str]):
+        self._names = list(names)
+        self._index = {n: i for i, n in enumerate(self._names)}
+        if len(self._index) != len(self._names):
+            raise ValueError("duplicate feature keys in index map")
+
+    # Mapping interface -----------------------------------------------------
+    def __getitem__(self, key: str) -> int:
+        return self._index[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._names)
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def get(self, key: str, default: int = -1) -> int:  # type: ignore[override]
+        return self._index.get(key, default)
+
+    def name_of(self, idx: int) -> str:
+        return self._names[idx]
+
+    @property
+    def names(self) -> list[str]:
+        return self._names
+
+    # construction ----------------------------------------------------------
+    @staticmethod
+    def build(
+        keys: Iterable[str],
+        add_intercept: bool = False,
+        sort: bool = True,
+    ) -> "IndexMap":
+        """Build from an iterable of (possibly repeated) feature keys.
+
+        Sorting gives a deterministic id assignment independent of input
+        order (the reference's FeatureIndexingJob achieves determinism by
+        hash-partitioned offsets; sorted order is the simpler equivalent).
+        """
+        uniq = set(keys)
+        if add_intercept:
+            uniq.add(INTERCEPT_KEY)
+        names = sorted(uniq) if sort else list(uniq)
+        return IndexMap(names)
+
+    # persistence -----------------------------------------------------------
+    def save(self, directory: str) -> None:
+        """Write the mmap-friendly layout: sorted (hash, id) arrays + names."""
+        os.makedirs(directory, exist_ok=True)
+        hashes = np.asarray([_hash64(n) for n in self._names], dtype=np.uint64)
+        order = np.argsort(hashes)
+        np.save(os.path.join(directory, "hashes.npy"), hashes[order])
+        np.save(
+            os.path.join(directory, "ids.npy"),
+            np.asarray(order, dtype=np.int64),
+        )
+        with open(os.path.join(directory, "names.json"), "w") as f:
+            json.dump(self._names, f)
+        with open(os.path.join(directory, "meta.json"), "w") as f:
+            json.dump({"num_features": len(self._names), "format": 1}, f)
+
+    @staticmethod
+    def load(directory: str) -> "IndexMap":
+        with open(os.path.join(directory, "names.json")) as f:
+            return IndexMap(json.load(f))
+
+
+class MmapIndexMap:
+    """Read-only index map backed by memory-mapped arrays — loads in O(1)
+    regardless of vocabulary size, lookups by binary search over the sorted
+    hash table. The PalDBIndexMap replacement for huge vocabularies where
+    materializing a Python dict is too slow/large."""
+
+    def __init__(self, directory: str):
+        self._hashes = np.load(os.path.join(directory, "hashes.npy"), mmap_mode="r")
+        self._ids = np.load(os.path.join(directory, "ids.npy"), mmap_mode="r")
+        with open(os.path.join(directory, "meta.json")) as f:
+            self._size = json.load(f)["num_features"]
+        self._dir = directory
+        self._names: Optional[list[str]] = None  # lazy, reverse lookups only
+
+    def __len__(self) -> int:
+        return self._size
+
+    def get(self, key: str, default: int = -1) -> int:
+        h = np.uint64(_hash64(key))
+        pos = int(np.searchsorted(self._hashes, h))
+        if pos < len(self._hashes) and self._hashes[pos] == h:
+            return int(self._ids[pos])
+        return default
+
+    def get_many(self, keys: Sequence[str]) -> np.ndarray:
+        """Vectorized lookup; -1 for unknown keys."""
+        hs = np.asarray([_hash64(k) for k in keys], dtype=np.uint64)
+        pos = np.searchsorted(self._hashes, hs)
+        pos_c = np.minimum(pos, len(self._hashes) - 1)
+        hit = self._hashes[pos_c] == hs
+        out = np.where(hit, self._ids[pos_c], -1)
+        return out.astype(np.int64)
+
+    def name_of(self, idx: int) -> str:
+        if self._names is None:
+            with open(os.path.join(self._dir, "names.json")) as f:
+                self._names = json.load(f)
+        return self._names[idx]

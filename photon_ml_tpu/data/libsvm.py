@@ -1,0 +1,114 @@
+"""LibSVM text reader -> SparseBatch.
+
+Reference analog: photon-client io/deprecated LibSVMInputDataFormat
+(SURVEY.md §2.d "Legacy input formats"); also the a1a demo workload path
+(reference README.md:236-252). Parsing is host-side numpy; the result is a
+device-ready :class:`SparseBatch` with an optional intercept column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.ops.sparse import SparseBatch
+
+
+@dataclasses.dataclass
+class LibSVMData:
+    """Host COO arrays parsed from LibSVM text (pre-device)."""
+
+    values: np.ndarray
+    rows: np.ndarray
+    cols: np.ndarray
+    labels: np.ndarray
+    num_features: int
+
+    def to_batch(
+        self,
+        num_features: Optional[int] = None,
+        add_intercept: bool = True,
+        dtype=None,
+        row_pad_multiple: int = 8,
+        nnz_pad_multiple: int = 128,
+        offsets: Optional[np.ndarray] = None,
+        weights: Optional[np.ndarray] = None,
+    ) -> SparseBatch:
+        """Materialize a SparseBatch; intercept becomes the LAST column."""
+        import jax.numpy as jnp
+
+        d = int(num_features if num_features is not None else self.num_features)
+        values, rows, cols = self.values, self.rows, self.cols
+        if add_intercept:
+            n = len(self.labels)
+            values = np.concatenate([values, np.ones(n)])
+            rows = np.concatenate([rows, np.arange(n, dtype=rows.dtype)])
+            cols = np.concatenate([cols, np.full(n, d, dtype=cols.dtype)])
+            d += 1
+        return SparseBatch.from_coo(
+            values=values,
+            rows=rows,
+            cols=cols,
+            labels=self.labels,
+            num_features=d,
+            offsets=offsets,
+            weights=weights,
+            dtype=dtype if dtype is not None else jnp.float32,
+            row_pad_multiple=row_pad_multiple,
+            nnz_pad_multiple=nnz_pad_multiple,
+        )
+
+    @property
+    def intercept_index(self) -> int:
+        """Index of the intercept column after to_batch(add_intercept=True)."""
+        return self.num_features
+
+
+def read_libsvm(
+    path: str,
+    zero_based: bool = False,
+    binary_labels_to_01: bool = True,
+) -> LibSVMData:
+    """Parse a LibSVM file. Labels {-1,+1} are mapped to {0,1} when
+    ``binary_labels_to_01`` (the loss layer accepts both, but evaluators
+    expect {0,1})."""
+    labels: list[float] = []
+    rows: list[int] = []
+    cols: list[int] = []
+    vals: list[float] = []
+    max_col = -1
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                if tok.startswith("#"):
+                    break
+                k, v = tok.split(":")
+                c = int(k) - (0 if zero_based else 1)
+                if c < 0:
+                    raise ValueError(
+                        f"negative feature index at line {i}: {tok} "
+                        f"(wrong zero_based setting?)"
+                    )
+                rows.append(len(labels) - 1)
+                cols.append(c)
+                vals.append(float(v))
+                max_col = max(max_col, c)
+
+    y = np.asarray(labels)
+    if binary_labels_to_01 and set(np.unique(y)).issubset({-1.0, 1.0}):
+        y = (y > 0).astype(np.float64)
+
+    return LibSVMData(
+        values=np.asarray(vals),
+        rows=np.asarray(rows, dtype=np.int64),
+        cols=np.asarray(cols, dtype=np.int64),
+        labels=y,
+        num_features=max_col + 1,
+    )

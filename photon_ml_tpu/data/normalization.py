@@ -1,0 +1,90 @@
+"""Feature normalization contexts.
+
+Parity with photon-lib normalization/NormalizationContext.scala:70-131:
+x' = (x - shift) .* factor applied algebraically inside the objective
+(never materialized), and trained coefficients mapped back to the original
+space by w = w' .* factor ; intercept -= w_out . shift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.stats import FeatureSummary
+
+Array = jax.Array
+
+
+class NormalizationType(str, Enum):
+    NONE = "none"
+    SCALE_WITH_MAX_MAGNITUDE = "scale_with_max_magnitude"
+    SCALE_WITH_STANDARD_DEVIATION = "scale_with_standard_deviation"
+    STANDARDIZATION = "standardization"
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """factors/shifts may be None (identity). ``intercept_index`` is the
+    feature column holding the explicit intercept (factor 1, shift 0)."""
+
+    factors: Optional[Array] = None
+    shifts: Optional[Array] = None
+    intercept_index: Optional[int] = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
+
+    def transform_model_coefficients(self, w: Array) -> Array:
+        """Map coefficients trained in normalized space back to original space."""
+        out = w if self.factors is None else w * self.factors
+        if self.shifts is not None:
+            if self.intercept_index is None:
+                raise ValueError("shifts require an intercept column")
+            out = out.at[self.intercept_index].add(-jnp.dot(out, self.shifts))
+        return out
+
+
+def build_normalization_context(
+    normalization_type: NormalizationType | str,
+    summary: Optional[FeatureSummary] = None,
+    intercept_index: Optional[int] = None,
+) -> NormalizationContext:
+    """Factory matching NormalizationContext.apply (reference :96-131)."""
+    ntype = NormalizationType(normalization_type)
+    if ntype == NormalizationType.NONE:
+        return NormalizationContext(intercept_index=intercept_index)
+    if summary is None:
+        raise ValueError(f"{ntype} requires a feature summary")
+
+    def inv_or_one(x):
+        return jnp.where(x > 0.0, 1.0 / jnp.where(x > 0.0, x, 1.0), 1.0)
+
+    if ntype == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        magnitude = jnp.maximum(jnp.abs(summary.max), jnp.abs(summary.min))
+        factors = inv_or_one(magnitude)
+        if intercept_index is not None:
+            factors = factors.at[intercept_index].set(1.0)
+        return NormalizationContext(factors=factors, intercept_index=intercept_index)
+
+    std = jnp.sqrt(summary.variance)
+    factors = inv_or_one(std)
+
+    if ntype == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        if intercept_index is not None:
+            factors = factors.at[intercept_index].set(1.0)
+        return NormalizationContext(factors=factors, intercept_index=intercept_index)
+
+    # STANDARDIZATION: requires intercept so shifts are absorbable
+    if intercept_index is None:
+        raise ValueError("STANDARDIZATION requires an intercept column")
+    shifts = summary.mean.at[intercept_index].set(0.0)
+    factors = factors.at[intercept_index].set(1.0)
+    return NormalizationContext(
+        factors=factors, shifts=shifts, intercept_index=intercept_index
+    )

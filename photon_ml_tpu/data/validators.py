@@ -1,0 +1,73 @@
+"""Row-level data sanity checks per task type.
+
+Reference analog: photon-client data/DataValidators.scala (SURVEY.md §2.d):
+finite features/labels/offsets/weights; binary labels for logistic;
+non-negative labels for Poisson. Modes VALIDATE_FULL / VALIDATE_SAMPLE /
+VALIDATE_DISABLED. Checks run host-side on the COO arrays before upload.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.ops.sparse import SparseBatch
+
+
+class ValidationMode(str, Enum):
+    FULL = "validate_full"
+    SAMPLE = "validate_sample"
+    DISABLED = "validate_disabled"
+
+
+class DataValidationError(ValueError):
+    pass
+
+
+def _sample_mask(n: int, mode: ValidationMode, rng: np.random.Generator):
+    if mode == ValidationMode.SAMPLE:
+        return rng.random(n) < max(0.01, min(1.0, 1000.0 / max(n, 1)))
+    return np.ones(n, dtype=bool)
+
+
+def validate(
+    batch: SparseBatch,
+    task: str,
+    mode: ValidationMode = ValidationMode.FULL,
+    seed: int = 0,
+) -> None:
+    """Raise DataValidationError on the first failed check."""
+    if mode == ValidationMode.DISABLED:
+        return
+    rng = np.random.default_rng(seed)
+
+    labels = np.asarray(batch.labels)
+    offsets = np.asarray(batch.offsets)
+    weights = np.asarray(batch.weights)
+    values = np.asarray(batch.values)
+    valid_rows = weights > 0  # padded rows excluded
+
+    mask = _sample_mask(len(labels), mode, rng) & valid_rows
+
+    if not np.all(np.isfinite(values)):
+        raise DataValidationError("non-finite feature values")
+    for name, arr in (("labels", labels), ("offsets", offsets), ("weights", weights)):
+        if not np.all(np.isfinite(arr[mask] if name != "weights" else arr)):
+            raise DataValidationError(f"non-finite {name}")
+    if np.any(weights < 0):
+        raise DataValidationError("negative weights")
+
+    task_l = task.lower()
+    if "logistic" in task_l or "hinge" in task_l or "svm" in task_l:
+        lab = labels[mask]
+        ok = np.isin(lab, (0.0, 1.0)) | np.isin(lab, (-1.0, 1.0))
+        if not np.all(ok):
+            raise DataValidationError(
+                f"binary task requires labels in {{0,1}} or {{-1,1}}; "
+                f"found {np.unique(lab[~ok])[:5]}"
+            )
+    if "poisson" in task_l:
+        if np.any(labels[mask] < 0):
+            raise DataValidationError("poisson task requires non-negative labels")

@@ -1,0 +1,13 @@
+from photon_ml_tpu.evaluation.evaluators import (  # noqa: F401
+    EVALUATORS,
+    auc,
+    better_than,
+    logistic_loss,
+    parse_evaluator,
+    poisson_loss,
+    rmse,
+    sharded_auc,
+    sharded_precision_at_k,
+    smoothed_hinge_loss,
+    squared_loss,
+)

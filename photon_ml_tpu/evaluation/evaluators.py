@@ -1,0 +1,241 @@
+"""Evaluators: weighted metrics over (scores, labels, weights) arrays, plus
+sharded (per-query/group) evaluators.
+
+Reference analog: photon-api evaluation/ (SURVEY.md §2.c "Evaluators"):
+AreaUnderROCCurveEvaluator (weighted rank AUC via sort-and-sweep,
+AreaUnderROCCurveLocalEvaluator.scala:31-70), RMSE, logistic/squared/poisson/
+smoothed-hinge losses, and ShardedEvaluator grouping by an id column with a
+per-group local metric averaged (ShardedEvaluator.scala:19-37,
+ShardedPrecisionAtKEvaluator). All metrics are jit-compatible device code;
+groups are segment-sums over a group-id array.
+
+``better_than`` direction per metric mirrors Evaluator.betterThan.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.losses import get_loss
+
+Array = jax.Array
+
+# metrics where larger is better
+_MAXIMIZE = {"auc", "precision@k", "sharded_auc"}
+
+
+def better_than(metric: str, a: float, b: float) -> bool:
+    base = metric.split(":")[0]
+    if base.startswith("precision@"):
+        base = "precision@k"
+    return a > b if base in _MAXIMIZE else a < b
+
+
+# ---------------------------------------------------------------------------
+# core metrics
+# ---------------------------------------------------------------------------
+
+def rmse(scores: Array, labels: Array, weights: Array) -> Array:
+    se = weights * (scores - labels) ** 2
+    return jnp.sqrt(jnp.sum(se) / jnp.maximum(jnp.sum(weights), 1e-12))
+
+
+def _mean_loss(loss_name: str):
+    loss = get_loss(loss_name)
+
+    def f(scores: Array, labels: Array, weights: Array) -> Array:
+        l = loss.loss(scores, labels)
+        return jnp.sum(weights * l) / jnp.maximum(jnp.sum(weights), 1e-12)
+
+    return f
+
+
+logistic_loss = _mean_loss("logistic")
+squared_loss = _mean_loss("squared")
+poisson_loss = _mean_loss("poisson")
+smoothed_hinge_loss = _mean_loss("smoothed_hinge")
+
+
+def auc(scores: Array, labels: Array, weights: Array) -> Array:
+    """Weighted ROC AUC by a single sort-and-sweep (rank statistic):
+
+        AUC = [ sum_pos w_i * R_i - W_pos*(W_pos+... ) ] / (W_pos * W_neg)
+
+    where R_i is the weighted mid-rank. Ties in score get average rank,
+    matching the reference's tied-score handling
+    (AreaUnderROCCurveLocalEvaluator.scala:31-70). Zero-weight (padding)
+    rows are inert. Returns 0.5 when one class is absent.
+    """
+    pos = (labels > 0.5).astype(scores.dtype) * weights
+    neg = (labels <= 0.5).astype(scores.dtype) * weights
+
+    order = jnp.argsort(scores)  # ascending
+    s = scores[order]
+    p = pos[order]
+    n = neg[order]
+    w = p + n
+
+    # weighted rank: cumulative weight up to-and-including, averaged with the
+    # exclusive prefix -> mid-rank for the element itself
+    cum = jnp.cumsum(w)
+    rank = cum - 0.5 * w  # mid-rank of each element in weight space
+
+    # tie groups: average the mid-rank over equal scores.
+    # segment ids for equal-score runs:
+    new_group = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    num_seg = s.shape[0]
+    g_w = jax.ops.segment_sum(w, gid, num_segments=num_seg, indices_are_sorted=True)
+    g_rw = jax.ops.segment_sum(
+        rank * w, gid, num_segments=num_seg, indices_are_sorted=True
+    )
+    g_mid = g_rw / jnp.maximum(g_w, 1e-30)  # weighted average rank per tie group
+    rank_tied = g_mid[gid]
+
+    w_pos = jnp.sum(p)
+    w_neg = jnp.sum(n)
+    sum_pos_rank = jnp.sum(rank_tied * p)
+    # U statistic: sum of positive ranks minus the ranks positives occupy
+    # among themselves (w_pos^2/2), over the pos*neg pair mass
+    u = sum_pos_rank - 0.5 * w_pos * w_pos
+    denom = w_pos * w_neg
+    return jnp.where(denom > 0, u / jnp.maximum(denom, 1e-30), 0.5)
+
+
+EVALUATORS: dict[str, Callable[[Array, Array, Array], Array]] = {
+    "auc": auc,
+    "rmse": rmse,
+    "logistic_loss": logistic_loss,
+    "squared_loss": squared_loss,
+    "poisson_loss": poisson_loss,
+    "smoothed_hinge_loss": smoothed_hinge_loss,
+}
+
+
+# ---------------------------------------------------------------------------
+# sharded (per-group) evaluators
+# ---------------------------------------------------------------------------
+
+def sharded_auc(
+    scores: Array, labels: Array, weights: Array, group_ids: Array, num_groups: int
+) -> Array:
+    """Mean per-group AUC over groups that have both classes.
+
+    The reference groups scores by an id column and averages a local AUC per
+    group (ShardedAreaUnderROCCurveEvaluator). Here: lexsort by (group,
+    score) and sweep — unweighted pair counting per group (weights act as
+    validity mask), fully on device.
+    """
+    valid = weights > 0
+    # sort by group then score
+    order = jnp.lexsort((scores, group_ids))
+    g = group_ids[order]
+    y = (labels[order] > 0.5) & valid[order]
+    v = valid[order]
+    neg = (~y) & v
+
+    # within-group cumulative count of negatives (exclusive prefix)
+    neg_f = neg.astype(scores.dtype)
+    cum_all = jnp.cumsum(neg_f)
+    g_start_total = jax.ops.segment_min(
+        cum_all - neg_f, g, num_segments=num_groups, indices_are_sorted=True
+    )
+    neg_before = cum_all - neg_f - g_start_total[g]  # negatives ranked below
+
+    # ties: average over equal (group, score) runs
+    s_sorted = scores[order]
+    new_run = jnp.concatenate(
+        [jnp.ones((1,), bool), (s_sorted[1:] != s_sorted[:-1]) | (g[1:] != g[:-1])]
+    )
+    rid = jnp.cumsum(new_run.astype(jnp.int32)) - 1
+    n_runs = scores.shape[0]
+    run_cnt = jax.ops.segment_sum(
+        v.astype(scores.dtype), rid, num_segments=n_runs, indices_are_sorted=True
+    )
+    run_neg = jax.ops.segment_sum(
+        neg_f, rid, num_segments=n_runs, indices_are_sorted=True
+    )
+    run_negbefore_min = jax.ops.segment_min(
+        jnp.where(v, neg_before, jnp.inf), rid, num_segments=n_runs,
+        indices_are_sorted=True,
+    )
+    # all-invalid runs yield inf from segment_min; zero them so the (0-mass)
+    # pair_credit below cannot produce inf * 0 = NaN
+    run_negbefore_min = jnp.where(
+        jnp.isfinite(run_negbefore_min), run_negbefore_min, 0.0
+    )
+    # a positive tied within a run sees (neg_before_run + run_neg/2) pairs won
+    pair_credit = run_negbefore_min[rid] + 0.5 * run_neg[rid]
+
+    pos_f = (y & v).astype(scores.dtype)
+    won = jax.ops.segment_sum(
+        pair_credit * pos_f, g, num_segments=num_groups, indices_are_sorted=True
+    )
+    n_pos = jax.ops.segment_sum(pos_f, g, num_segments=num_groups,
+                                indices_are_sorted=True)
+    n_neg = jax.ops.segment_sum(neg_f, g, num_segments=num_groups,
+                                indices_are_sorted=True)
+    pairs = n_pos * n_neg
+    has_both = pairs > 0
+    per_group = jnp.where(has_both, won / jnp.maximum(pairs, 1e-30), 0.0)
+    n_scored = jnp.sum(has_both.astype(scores.dtype))
+    return jnp.sum(per_group) / jnp.maximum(n_scored, 1.0)
+
+
+def sharded_precision_at_k(
+    scores: Array,
+    labels: Array,
+    weights: Array,
+    group_ids: Array,
+    num_groups: int,
+    k: int,
+) -> Array:
+    """Mean per-group precision@k (PrecisionAtKLocalEvaluator analog):
+    fraction of the top-k scored valid items per group that are positive."""
+    valid = weights > 0
+    # rank within group by descending score: lexsort by (group, -score)
+    order = jnp.lexsort((-scores, group_ids))
+    g = group_ids[order]
+    y = ((labels[order] > 0.5) & valid[order]).astype(scores.dtype)
+    v = valid[order].astype(scores.dtype)
+
+    cum_v = jnp.cumsum(v)
+    start = jax.ops.segment_min(
+        cum_v - v, g, num_segments=num_groups, indices_are_sorted=True
+    )
+    rank_in_group = cum_v - v - start[g]  # 0-based among valid rows
+    in_top_k = (rank_in_group < k) & (v > 0)
+
+    hits = jax.ops.segment_sum(
+        jnp.where(in_top_k, y, 0.0), g, num_segments=num_groups,
+        indices_are_sorted=True,
+    )
+    counts = jax.ops.segment_sum(
+        in_top_k.astype(scores.dtype), g, num_segments=num_groups,
+        indices_are_sorted=True,
+    )
+    has_any = counts > 0
+    per_group = jnp.where(has_any, hits / jnp.maximum(counts, 1.0), 0.0)
+    n_groups_scored = jnp.sum(has_any.astype(scores.dtype))
+    return jnp.sum(per_group) / jnp.maximum(n_groups_scored, 1.0)
+
+
+def parse_evaluator(spec: str):
+    """Parse evaluator spec strings like 'auc', 'rmse', 'precision@5:queryId',
+    'auc:queryId' (sharded variants carry the grouping column after ':'),
+    mirroring EvaluatorType/ShardedEvaluatorType parsing."""
+    spec = spec.strip().lower()
+    if ":" in spec:
+        metric, group_col = spec.split(":", 1)
+        if metric.startswith("precision@"):
+            k = int(metric.split("@")[1])
+            return ("sharded_precision_at_k", group_col, k)
+        if metric == "auc":
+            return ("sharded_auc", group_col, None)
+        raise ValueError(f"unknown sharded evaluator '{spec}'")
+    if spec not in EVALUATORS:
+        raise ValueError(f"unknown evaluator '{spec}'. Known: {sorted(EVALUATORS)}")
+    return (spec, None, None)

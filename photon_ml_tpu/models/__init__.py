@@ -1,0 +1,5 @@
+from photon_ml_tpu.models.glm import (  # noqa: F401
+    Coefficients,
+    GeneralizedLinearModel,
+    make_model,
+)

@@ -1,0 +1,86 @@
+"""GLM model classes: coefficients + link functions + prediction.
+
+Reference analog: photon-api supervised/ (GeneralizedLinearModel.scala:25-77,
+LogisticRegressionModel, LinearRegressionModel, PoissonRegressionModel,
+SmoothedHingeLossLinearSVMModel) and photon-lib model/Coefficients.scala.
+Scores are margins w.x (+offset); means apply the task link function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.ops.sparse import SparseBatch
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """Means + optional per-coefficient variances (Coefficients.scala:55-60)."""
+
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def norm(self, order: int = 2) -> Array:
+        return jnp.linalg.norm(self.means, ord=order)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """A trained GLM for one task type.
+
+    ``task`` selects the link function: logistic -> sigmoid, poisson -> exp,
+    squared/smoothed_hinge -> identity. ``compute_score`` is the raw margin
+    (used by coordinate descent residuals); ``compute_mean`` applies the link
+    (GeneralizedLinearModel.scala computeScore/computeMean split).
+    """
+
+    coefficients: Coefficients
+    task: str = dataclasses.field(metadata=dict(static=True))
+
+    def compute_score(self, batch: SparseBatch) -> Array:
+        return batch.margins(self.coefficients.means)
+
+    def compute_mean(self, batch: SparseBatch) -> Array:
+        return self.mean_of(self.compute_score(batch))
+
+    def mean_of(self, scores: Array) -> Array:
+        loss_name = get_loss(self.task).name
+        if loss_name == "logistic":
+            return jax.nn.sigmoid(scores)
+        if loss_name == "poisson":
+            return jnp.exp(scores)
+        return scores  # squared / smoothed hinge: identity link
+
+    def predict_class(self, batch: SparseBatch, threshold: float = 0.5) -> Array:
+        """Binary classification API (BinaryClassifier.predictClass analog)."""
+        loss_name = get_loss(self.task).name
+        if loss_name not in ("logistic", "smoothed_hinge"):
+            raise ValueError(f"{self.task} is not a binary classification task")
+        if loss_name == "logistic":
+            return (self.compute_mean(batch) > threshold).astype(jnp.int32)
+        return (self.compute_score(batch) > 0.0).astype(jnp.int32)
+
+    def with_coefficients(self, means: Array, variances=None) -> "GeneralizedLinearModel":
+        return dataclasses.replace(
+            self, coefficients=Coefficients(means=means, variances=variances)
+        )
+
+
+def make_model(task: str, means: Array, variances=None) -> GeneralizedLinearModel:
+    get_loss(task)  # validates task name
+    return GeneralizedLinearModel(
+        coefficients=Coefficients(means=means, variances=variances), task=task
+    )

@@ -81,41 +81,66 @@ class GLMObjective:
         return batch.margins(w_eff, shift)
 
     # -- value / gradient ----------------------------------------------------
+    #
+    # ``axis_name`` enables SPMD data parallelism: when the batch rows are a
+    # local shard inside a shard_map over that mesh axis, the per-shard data
+    # sums are psum'd over ICI while the regularization terms (functions of
+    # the replicated coefficients) stay local. This is the treeAggregate
+    # replacement (SURVEY.md §2.a row 1) — the optimizers run unchanged.
 
-    def value_and_grad(self, w: Array, batch: SparseBatch) -> tuple[Array, Array]:
+    @staticmethod
+    def _psum(x, axis_name):
+        return x if axis_name is None else jax.lax.psum(x, axis_name)
+
+    def value_and_grad(
+        self, w: Array, batch: SparseBatch, axis_name: Optional[str] = None
+    ) -> tuple[Array, Array]:
         z = self.margins(w, batch)
         l, dz = self.loss.loss_and_dz(z, batch.labels)
-        value = jnp.sum(batch.weights * l)
+        value = self._psum(jnp.sum(batch.weights * l), axis_name)
         g_row = batch.weights * dz
-        grad = self._back_transform_vec(batch.scatter_features(g_row), jnp.sum(g_row))
+        grad = self._psum(
+            self._back_transform_vec(batch.scatter_features(g_row), jnp.sum(g_row)),
+            axis_name,
+        )
         l2 = self.l2_weight.astype(w.dtype)
         value = value + 0.5 * l2 * jnp.dot(w, w)
         grad = grad + l2 * w
         return value, grad
 
-    def value(self, w: Array, batch: SparseBatch) -> Array:
+    def value(
+        self, w: Array, batch: SparseBatch, axis_name: Optional[str] = None
+    ) -> Array:
         z = self.margins(w, batch)
         l = self.loss.loss(z, batch.labels)
-        return jnp.sum(batch.weights * l) + 0.5 * self.l2_weight.astype(
-            w.dtype
+        return self._psum(jnp.sum(batch.weights * l), axis_name) + 0.5 * (
+            self.l2_weight.astype(w.dtype)
         ) * jnp.dot(w, w)
 
-    def grad(self, w: Array, batch: SparseBatch) -> Array:
-        return self.value_and_grad(w, batch)[1]
+    def grad(
+        self, w: Array, batch: SparseBatch, axis_name: Optional[str] = None
+    ) -> Array:
+        return self.value_and_grad(w, batch, axis_name)[1]
 
     # -- second-order --------------------------------------------------------
 
-    def hessian_vector(self, w: Array, v: Array, batch: SparseBatch) -> Array:
+    def hessian_vector(
+        self, w: Array, v: Array, batch: SparseBatch, axis_name: Optional[str] = None
+    ) -> Array:
         """H(w) @ v  =  sum_i weight_i * l''(z_i) * (x'_i . v) * x'_i  + l2*v."""
         z = self.margins(w, batch)
         d2_row = batch.weights * self.loss.d2z(z, batch.labels)
         v_eff, v_shift = self._effective(v)
         xv = batch.dot_rows(v_eff) + v_shift  # x'_i . v per row
         q = d2_row * xv
-        hv = self._back_transform_vec(batch.scatter_features(q), jnp.sum(q))
+        hv = self._psum(
+            self._back_transform_vec(batch.scatter_features(q), jnp.sum(q)), axis_name
+        )
         return hv + self.l2_weight.astype(w.dtype) * v
 
-    def hessian_diagonal(self, w: Array, batch: SparseBatch) -> Array:
+    def hessian_diagonal(
+        self, w: Array, batch: SparseBatch, axis_name: Optional[str] = None
+    ) -> Array:
         """diag H(w)_j = sum_i weight_i l''(z_i) x'_ij^2 + l2."""
         z = self.margins(w, batch)
         d2_row = batch.weights * self.loss.d2z(z, batch.labels)
@@ -135,7 +160,7 @@ class GLMObjective:
                 total = jnp.sum(d2_row)
                 s = self.shifts
                 diag = f * f * (raw_sq - 2.0 * s * raw_lin + s * s * total)
-        return diag + self.l2_weight.astype(w.dtype)
+        return self._psum(diag, axis_name) + self.l2_weight.astype(w.dtype)
 
     # -- plumbing ------------------------------------------------------------
 

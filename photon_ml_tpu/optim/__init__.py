@@ -1,0 +1,24 @@
+from photon_ml_tpu.optim.adapter import glm_adapter  # noqa: F401
+from photon_ml_tpu.optim.common import (  # noqa: F401
+    CONVERGENCE_REASON_NAMES,
+    FUNCTION_VALUES_CONVERGED,
+    GRADIENT_CONVERGED,
+    MAX_ITERATIONS,
+    NOT_CONVERGED,
+    OBJECTIVE_NOT_IMPROVING,
+    BoxConstraints,
+    Objective,
+    SolveResult,
+    from_value_and_grad,
+)
+from photon_ml_tpu.optim.factory import (  # noqa: F401
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    build_objective,
+    solve,
+)
+from photon_ml_tpu.optim.lbfgs import LBFGSConfig, lbfgs_solve  # noqa: F401
+from photon_ml_tpu.optim.owlqn import owlqn_solve  # noqa: F401
+from photon_ml_tpu.optim.tron import TRONConfig, tron_solve  # noqa: F401

@@ -1,0 +1,99 @@
+"""Bridge from :class:`GLMObjective` to the optimizer :class:`Objective`
+adapter, including the margin-space fast line search.
+
+Along a search direction p, GLM margins are affine: z(a) = z + a*u with
+u = X' @ p precomputed once per line search. Each Wolfe trial then costs
+O(n) elementwise work instead of a full gather/scatter pass over the nnz —
+something the Spark reference cannot express (every Breeze line-search trial
+there is a full treeAggregate over the cluster; SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.optim.common import Objective
+
+Array = jax.Array
+
+
+class _LSCarry(NamedTuple):
+    z: Array  # margins at w
+    u: Array  # directional margins X' @ p
+    w: Array
+    p: Array
+    ww: Array  # w.w
+    wp: Array  # w.p
+    pp: Array  # p.p
+
+
+def glm_adapter(
+    obj: GLMObjective, batch: SparseBatch, axis_name: str | None = None
+) -> Objective:
+    """Build the optimizer-facing adapter for a GLM objective over a batch.
+
+    The returned closures capture ``obj`` and ``batch``; under jit they are
+    traced with whatever sharding the batch carries, so the same adapter
+    serves single-device, vmapped (per-entity) and mesh-sharded training.
+    With ``axis_name`` set (inside a shard_map over that mesh axis, batch =
+    the local row shard), all data sums are psum'd — including the line
+    search's per-trial phi/dphi, which costs one scalar-pair all-reduce over
+    ICI per trial instead of the reference's full treeAggregate round.
+    """
+    loss = obj.loss
+
+    def psum(x):
+        return x if axis_name is None else jax.lax.psum(x, axis_name)
+
+    def value_and_grad(w):
+        return obj.value_and_grad(w, batch, axis_name)
+
+    def value(w):
+        return obj.value(w, batch, axis_name)
+
+    def ls_prepare(w, p):
+        z = obj.margins(w, batch)
+        p_eff, p_shift = obj._effective(p)
+        u = batch.dot_rows(p_eff) + p_shift
+        return _LSCarry(
+            z=z,
+            u=u,
+            w=w,
+            p=p,
+            ww=jnp.dot(w, w),
+            wp=jnp.dot(w, p),
+            pp=jnp.dot(p, p),
+        )
+
+    def ls_eval(carry: _LSCarry, alpha):
+        z_a = carry.z + alpha * carry.u
+        l, dz = loss.loss_and_dz(z_a, batch.labels)
+        l2 = obj.l2_weight.astype(z_a.dtype)
+        data_sums = psum(
+            jnp.stack(
+                [jnp.sum(batch.weights * l), jnp.sum(batch.weights * dz * carry.u)]
+            )
+        )
+        phi = data_sums[0] + 0.5 * l2 * (
+            carry.ww + 2.0 * alpha * carry.wp + alpha * alpha * carry.pp
+        )
+        dphi = data_sums[1] + l2 * (carry.wp + alpha * carry.pp)
+        return phi, dphi
+
+    hvp = None
+    if loss.has_hessian:
+        def hvp(w, v):
+            return obj.hessian_vector(w, v, batch, axis_name)
+
+    return Objective(
+        value_and_grad=value_and_grad,
+        value=value,
+        ls_prepare=ls_prepare,
+        ls_eval=ls_eval,
+        hvp=hvp,
+    )

@@ -1,0 +1,194 @@
+"""Optimizer selection and regularization wiring.
+
+Mirrors the reference's OptimizerFactory (photon-api
+optimization/OptimizerFactory.scala:39-77) and RegularizationContext
+(optimization/RegularizationContext.scala:41-66): LBFGS handles NONE/L2,
+OWLQN handles L1/ELASTIC_NET (l1 = alpha*lambda, l2 = (1-alpha)*lambda),
+TRON handles NONE/L2 only and requires a twice-differentiable loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from enum import Enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.ops.objective import GLMObjective, make_objective
+from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.optim.adapter import glm_adapter
+from photon_ml_tpu.optim.common import BoxConstraints, SolveResult
+from photon_ml_tpu.optim.lbfgs import LBFGSConfig, lbfgs_solve
+from photon_ml_tpu.optim.owlqn import owlqn_solve
+from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
+
+Array = jax.Array
+
+
+class OptimizerType(str, Enum):
+    LBFGS = "lbfgs"
+    TRON = "tron"
+
+
+class RegularizationType(str, Enum):
+    NONE = "none"
+    L1 = "l1"
+    L2 = "l2"
+    ELASTIC_NET = "elastic_net"
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationContext:
+    """Splits a single regularization weight into (l1, l2) parts."""
+
+    reg_type: RegularizationType = RegularizationType.NONE
+    alpha: float = 1.0  # elastic-net mixing: l1 = alpha*w, l2 = (1-alpha)*w
+
+    def __post_init__(self):
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            if not (0.0 <= self.alpha <= 1.0):
+                raise ValueError(f"elastic-net alpha must be in [0,1]: {self.alpha}")
+
+    def l1_weight(self, reg_weight: float) -> float:
+        if self.reg_type == RegularizationType.L1:
+            return reg_weight
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            return self.alpha * reg_weight
+        return 0.0
+
+    def l2_weight(self, reg_weight: float) -> float:
+        if self.reg_type == RegularizationType.L2:
+            return reg_weight
+        if self.reg_type == RegularizationType.ELASTIC_NET:
+            return (1.0 - self.alpha) * reg_weight
+        return 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Typed analog of the reference's OptimizerConfig + GLMOptimizationConfiguration."""
+
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    regularization: RegularizationContext = RegularizationContext()
+    regularization_weight: float = 0.0
+    lbfgs_history: int = 10
+    down_sampling_rate: float = 1.0
+
+    def validate(self, loss_name: str) -> None:
+        uses_l1 = self.regularization.reg_type in (
+            RegularizationType.L1,
+            RegularizationType.ELASTIC_NET,
+        )
+        if self.optimizer_type == OptimizerType.TRON:
+            if uses_l1:
+                raise ValueError(
+                    "TRON does not support L1/elastic-net regularization "
+                    "(OptimizerFactory parity)"
+                )
+            if not get_loss(loss_name).has_hessian:
+                raise ValueError(
+                    f"TRON requires a twice-differentiable loss; '{loss_name}' "
+                    "is not (use LBFGS/OWLQN)"
+                )
+
+
+def build_objective(
+    loss_name: str,
+    config: OptimizerConfig,
+    factors: Optional[Array] = None,
+    shifts: Optional[Array] = None,
+) -> GLMObjective:
+    """GLM objective with the L2 part of the configured regularization."""
+    return make_objective(
+        loss_name,
+        l2_weight=config.regularization.l2_weight(config.regularization_weight),
+        factors=factors,
+        shifts=shifts,
+    )
+
+
+def dispatch_solve(
+    adapter,
+    w0: Array,
+    config: OptimizerConfig,
+    l1,
+    constraints: Optional[BoxConstraints] = None,
+    init_value: Optional[Array] = None,
+    init_grad_norm: Optional[Array] = None,
+) -> SolveResult:
+    """Route a prebuilt objective adapter to the configured optimizer.
+
+    Shared by the single-device path (solve) and the mesh path
+    (parallel.distributed) so dispatch rules live in exactly one place.
+    ``l1`` may be a traced scalar — the OWLQN-vs-LBFGS choice depends only
+    on the (static) regularization type, so lambda sweeps don't recompile.
+    """
+    uses_l1 = config.regularization.reg_type in (
+        RegularizationType.L1,
+        RegularizationType.ELASTIC_NET,
+    )
+    if config.optimizer_type == OptimizerType.TRON:
+        return tron_solve(
+            adapter,
+            w0,
+            TRONConfig(
+                max_iterations=config.max_iterations, tolerance=config.tolerance
+            ),
+            constraints=constraints,
+            init_value=init_value,
+            init_grad_norm=init_grad_norm,
+        )
+
+    lcfg = LBFGSConfig(
+        max_iterations=config.max_iterations,
+        tolerance=config.tolerance,
+        history=config.lbfgs_history,
+    )
+    if uses_l1:
+        return owlqn_solve(
+            adapter,
+            w0,
+            l1,
+            lcfg,
+            constraints=constraints,
+            init_value=init_value,
+            init_grad_norm=init_grad_norm,
+        )
+    return lbfgs_solve(
+        adapter,
+        w0,
+        lcfg,
+        constraints=constraints,
+        init_value=init_value,
+        init_grad_norm=init_grad_norm,
+    )
+
+
+def solve(
+    loss_name: str,
+    batch: SparseBatch,
+    config: OptimizerConfig,
+    w0: Array,
+    constraints: Optional[BoxConstraints] = None,
+    factors: Optional[Array] = None,
+    shifts: Optional[Array] = None,
+    init_value: Optional[Array] = None,
+    init_grad_norm: Optional[Array] = None,
+) -> SolveResult:
+    """One-stop GLM solve: build objective + adapter, dispatch the optimizer.
+
+    Pure and jit-friendly: wrap in jax.jit (static config) or vmap over
+    batched problems.
+    """
+    config.validate(loss_name)
+    obj = build_objective(loss_name, config, factors=factors, shifts=shifts)
+    adapter = glm_adapter(obj, batch)
+    l1 = config.regularization.l1_weight(config.regularization_weight)
+    return dispatch_solve(
+        adapter, w0, config, l1, constraints, init_value, init_grad_norm
+    )

@@ -1,0 +1,241 @@
+"""L-BFGS as a jit-compiled ``lax.while_loop`` — fully on device.
+
+The reference wraps Breeze's LBFGS iterator and crosses the driver/executor
+boundary twice per iteration (photon-lib optimization/LBFGS.scala:64-111;
+SURVEY.md §3.4). Here the entire optimize loop — two-loop recursion, strong
+Wolfe line search, convergence checks — is one XLA program; under ``vmap`` it
+solves batches of independent problems (per-entity random effects) with
+converged lanes frozen; under a sharded mesh the objective's psum makes it
+data-parallel with no other change.
+
+Defaults match the reference: maxIter=100, history m=10, tolerance=1e-7
+(LBFGS.scala:152-156). Box constraints project every iterate into the
+hypercube, as in LBFGS.BreezeOptimization.next (LBFGS.scala:72-87).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_ml_tpu.optim.common import (
+    NOT_CONVERGED,
+    BoxConstraints,
+    Objective,
+    SolveResult,
+    convergence_reason,
+    project_or_identity,
+)
+from photon_ml_tpu.optim.linesearch import strong_wolfe
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class LBFGSConfig:
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    history: int = 10
+    c1: float = 1e-4
+    c2: float = 0.9
+    max_ls_evals: int = 20
+    min_curvature: float = 1e-10  # skip history update below this s.y
+
+
+class _LBFGSState(NamedTuple):
+    w: Array
+    value: Array
+    grad: Array
+    prev_value: Array
+    S: Array  # [m, d] coefficient deltas (circular)
+    Y: Array  # [m, d] gradient deltas (circular)
+    rho: Array  # [m] 1/(s.y)
+    head: Array  # i32 next write slot
+    n_hist: Array  # i32 valid pairs
+    gamma: Array  # H0 scaling
+    iteration: Array
+    reason: Array
+    ls_failed: Array
+    values: Array
+    grad_norms: Array
+
+
+def two_loop_direction(
+    g: Array, S: Array, Y: Array, rho: Array, head: Array, n_hist: Array, gamma: Array
+) -> Array:
+    """Two-loop recursion: returns approx H^{-1} g (NOT negated)."""
+    m = S.shape[0]
+
+    def bwd(i, carry):
+        q, alphas = carry
+        idx = (head - 1 - i) % m
+        valid = i < n_hist
+        a = jnp.where(valid, rho[idx] * jnp.dot(S[idx], q), 0.0)
+        return q - a * Y[idx], alphas.at[idx].set(a)
+
+    q, alphas = lax.fori_loop(0, m, bwd, (g, jnp.zeros((m,), dtype=g.dtype)))
+    r = gamma * q
+
+    def fwd(i, r):
+        idx = (head - n_hist + i) % m
+        valid = i < n_hist
+        b = rho[idx] * jnp.dot(Y[idx], r)
+        return r + jnp.where(valid, alphas[idx] - b, 0.0) * S[idx]
+
+    return lax.fori_loop(0, m, fwd, r)
+
+
+def update_history(
+    S: Array,
+    Y: Array,
+    rho: Array,
+    head: Array,
+    n_hist: Array,
+    gamma: Array,
+    s: Array,
+    y: Array,
+    min_curvature: float,
+) -> tuple[Array, Array, Array, Array, Array, Array]:
+    """Push an (s, y) pair into the circular history if curvature is positive."""
+    sy = jnp.dot(s, y)
+    yy = jnp.dot(y, y)
+    ok = sy > min_curvature
+    m = S.shape[0]
+    S = jnp.where(ok, S.at[head].set(s), S)
+    Y = jnp.where(ok, Y.at[head].set(y), Y)
+    rho = jnp.where(ok, rho.at[head].set(1.0 / jnp.where(ok, sy, 1.0)), rho)
+    head = jnp.where(ok, (head + 1) % m, head)
+    n_hist = jnp.where(ok, jnp.minimum(n_hist + 1, m), n_hist)
+    gamma = jnp.where(ok & (yy > 0), sy / jnp.where(yy > 0, yy, 1.0), gamma)
+    return S, Y, rho, head, n_hist, gamma
+
+
+def lbfgs_solve(
+    objective: Objective,
+    w0: Array,
+    config: LBFGSConfig = LBFGSConfig(),
+    constraints: Optional[BoxConstraints] = None,
+    init_value: Optional[Array] = None,
+    init_grad_norm: Optional[Array] = None,
+) -> SolveResult:
+    """Minimize the objective from ``w0``; returns a :class:`SolveResult`.
+
+    ``init_value``/``init_grad_norm`` override the convergence-check anchors
+    for warm-started re-runs (isReusingPreviousInitialState semantics,
+    Optimizer.scala:33-35).
+    """
+    m, d = config.history, w0.shape[0]
+    dtype = w0.dtype
+    w0 = project_or_identity(constraints, w0)
+    f0, g0 = objective.value_and_grad(w0)
+
+    anchor_f = f0 if init_value is None else jnp.asarray(init_value, dtype)
+    anchor_gn = (
+        jnp.linalg.norm(g0) if init_grad_norm is None else jnp.asarray(init_grad_norm, dtype)
+    )
+
+    nvals = config.max_iterations + 1
+    values = jnp.full((nvals,), jnp.inf, dtype=dtype).at[0].set(f0)
+    gnorms = jnp.full((nvals,), jnp.inf, dtype=dtype).at[0].set(jnp.linalg.norm(g0))
+
+    init = _LBFGSState(
+        w=w0,
+        value=f0,
+        grad=g0,
+        prev_value=f0,
+        S=jnp.zeros((m, d), dtype=dtype),
+        Y=jnp.zeros((m, d), dtype=dtype),
+        rho=jnp.zeros((m,), dtype=dtype),
+        head=jnp.int32(0),
+        n_hist=jnp.int32(0),
+        gamma=jnp.asarray(1.0, dtype),
+        iteration=jnp.int32(0),
+        reason=jnp.int32(NOT_CONVERGED),
+        ls_failed=jnp.bool_(False),
+        values=values,
+        grad_norms=gnorms,
+    )
+
+    def cond(s: _LBFGSState):
+        return s.reason == NOT_CONVERGED
+
+    def body(s: _LBFGSState) -> _LBFGSState:
+        p = -two_loop_direction(s.grad, s.S, s.Y, s.rho, s.head, s.n_hist, s.gamma)
+        dphi0 = jnp.dot(s.grad, p)
+        # safeguard: fall back to steepest descent on non-descent direction
+        bad = dphi0 >= 0.0
+        p = jnp.where(bad, -s.grad, p)
+        dphi0 = jnp.where(bad, -jnp.dot(s.grad, s.grad), dphi0)
+
+        gnorm = jnp.linalg.norm(s.grad)
+        first = s.n_hist == 0
+        init_step = jnp.where(
+            first, jnp.minimum(1.0, 1.0 / jnp.maximum(gnorm, 1e-12)), 1.0
+        ).astype(dtype)
+
+        carry = objective.ls_prepare(s.w, p)
+        ls = strong_wolfe(
+            objective.ls_eval,
+            carry,
+            s.value,
+            dphi0,
+            init_step=init_step,
+            c1=config.c1,
+            c2=config.c2,
+            max_evals=config.max_ls_evals,
+        )
+
+        w_step = s.w + ls.alpha * p
+        w_new = project_or_identity(constraints, w_step)
+        f_new, g_new = objective.value_and_grad(w_new)
+
+        S, Y, rho, head, n_hist, gamma = update_history(
+            s.S, s.Y, s.rho, s.head, s.n_hist, s.gamma,
+            w_new - s.w, g_new - s.grad, config.min_curvature,
+        )
+
+        it = s.iteration + 1
+        reason = convergence_reason(
+            it,
+            f_new,
+            s.value,
+            jnp.linalg.norm(g_new),
+            anchor_f,
+            anchor_gn,
+            config.max_iterations,
+            config.tolerance,
+            ls.failed,
+        )
+        nxt = _LBFGSState(
+            w=w_new,
+            value=f_new,
+            grad=g_new,
+            prev_value=s.value,
+            S=S, Y=Y, rho=rho, head=head, n_hist=n_hist, gamma=gamma,
+            iteration=it,
+            reason=reason,
+            ls_failed=ls.failed,
+            values=s.values.at[it].set(f_new),
+            grad_norms=s.grad_norms.at[it].set(jnp.linalg.norm(g_new)),
+        )
+        # Freeze lanes that already converged (vmap batching runs the body
+        # for all lanes until every lane's cond is False).
+        return jax.tree.map(
+            lambda a, b: jnp.where(s.reason == NOT_CONVERGED, b, a), s, nxt
+        )
+
+    final = lax.while_loop(cond, body, init)
+    # On line-search failure keep the best iterate seen (pre-failure w).
+    return SolveResult(
+        w=final.w,
+        value=final.value,
+        grad=final.grad,
+        iterations=final.iteration,
+        reason=final.reason,
+        values=final.values,
+        grad_norms=final.grad_norms,
+    )

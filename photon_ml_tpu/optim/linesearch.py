@@ -1,0 +1,278 @@
+"""Strong-Wolfe line search (Nocedal & Wright Alg. 3.5/3.6) as a single
+``lax.while_loop`` state machine — one directional-oracle evaluation per loop
+iteration, jittable and vmappable.
+
+This replaces the Breeze StrongWolfeLineSearch the reference's LBFGS relies
+on (photon-lib optimization/LBFGS.scala wraps breeze.optimize.LBFGS). Default
+constants c1=1e-4, c2=0.9 match the Breeze/Nocedal defaults for quasi-Newton
+directions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+# state machine modes
+_BRACKET = 0
+_ZOOM = 1
+_DONE = 2
+_FAILED = 3
+
+
+class LineSearchResult(NamedTuple):
+    alpha: Array  # accepted step (0.0 on failure)
+    phi: Array  # objective at accepted step
+    dphi: Array  # directional derivative at accepted step
+    failed: Array  # bool
+    num_evals: Array  # i32
+
+
+class _LSState(NamedTuple):
+    mode: Array
+    alpha: Array  # next trial step
+    alpha_prev: Array
+    phi_prev: Array
+    dphi_prev: Array
+    lo: Array  # zoom bracket low endpoint (best-so-far inside bracket)
+    phi_lo: Array
+    dphi_lo: Array
+    hi: Array  # zoom bracket high endpoint
+    phi_hi: Array
+    dphi_hi: Array
+    best_alpha: Array  # Wolfe-accepted point
+    best_phi: Array
+    best_dphi: Array
+    armijo_alpha: Array  # best Armijo-satisfying trial seen anywhere
+    armijo_phi: Array
+    armijo_dphi: Array
+    evals: Array
+
+
+def _cubic_min(a, fa, dfa, b, fb, dfb):
+    """Minimizer of the cubic interpolant on [a, b]; falls back to bisection."""
+    d1 = dfa + dfb - 3.0 * (fa - fb) / (a - b)
+    rad = d1 * d1 - dfa * dfb
+    safe = rad >= 0.0
+    d2 = jnp.sqrt(jnp.where(safe, rad, 0.0)) * jnp.sign(b - a)
+    denom = dfb - dfa + 2.0 * d2
+    x = b - (b - a) * (dfb + d2 - d1) / denom
+    mid = 0.5 * (a + b)
+    lo_, hi_ = jnp.minimum(a, b), jnp.maximum(a, b)
+    # keep the trial strictly interior (5% margin) so zoom always shrinks
+    margin = 0.05 * (hi_ - lo_)
+    ok = safe & jnp.isfinite(x) & (x > lo_ + margin) & (x < hi_ - margin) & (
+        jnp.abs(denom) > 1e-20
+    )
+    return jnp.where(ok, x, mid)
+
+
+def strong_wolfe(
+    ls_eval: Callable[[Any, Array], tuple[Array, Array]],
+    carry: Any,
+    phi0: Array,
+    dphi0: Array,
+    init_step: Array | float = 1.0,
+    c1: float = 1e-4,
+    c2: float = 0.9,
+    max_evals: int = 20,
+    max_step: float = 1e10,
+) -> LineSearchResult:
+    """Find alpha satisfying phi(a) <= phi0 + c1*a*dphi0 and |dphi(a)| <= c2*|dphi0|.
+
+    ``ls_eval(carry, a) -> (phi(a), dphi(a))`` is the directional oracle.
+    On eval exhaustion, falls back to the best sufficient-decrease point seen
+    (Armijo-only acceptance, like Breeze's fallback on exhaustion).
+    """
+    dtype = phi0.dtype
+    f = jnp.asarray
+
+    init = _LSState(
+        mode=jnp.int32(_BRACKET),
+        alpha=f(init_step, dtype=dtype),
+        alpha_prev=f(0.0, dtype=dtype),
+        phi_prev=phi0,
+        dphi_prev=dphi0,
+        lo=f(0.0, dtype=dtype),
+        phi_lo=phi0,
+        dphi_lo=dphi0,
+        hi=f(0.0, dtype=dtype),
+        phi_hi=phi0,
+        dphi_hi=dphi0,
+        best_alpha=f(0.0, dtype=dtype),
+        best_phi=phi0,
+        best_dphi=dphi0,
+        armijo_alpha=f(0.0, dtype=dtype),
+        armijo_phi=phi0,
+        armijo_dphi=dphi0,
+        evals=jnp.int32(0),
+    )
+
+    armijo = lambda a, phi: phi <= phi0 + c1 * a * dphi0
+    curvature = lambda dphi: jnp.abs(dphi) <= c2 * jnp.abs(dphi0)
+
+    def cond(s: _LSState):
+        return (s.mode < _DONE) & (s.evals < max_evals)
+
+    def body(s: _LSState) -> _LSState:
+        phi, dphi = ls_eval(carry, s.alpha)
+        evals = s.evals + 1
+
+        # track best Armijo point across both phases (exhaustion fallback)
+        better = armijo(s.alpha, phi) & (phi < s.armijo_phi)
+        s = s._replace(
+            armijo_alpha=jnp.where(better, s.alpha, s.armijo_alpha),
+            armijo_phi=jnp.where(better, phi, s.armijo_phi),
+            armijo_dphi=jnp.where(better, dphi, s.armijo_dphi),
+        )
+
+        def bracket_step(s):
+            # Alg 3.5: decide accept / zoom / extend
+            hit_armijo_fail = (~armijo(s.alpha, phi)) | (
+                (evals > 1) & (phi >= s.phi_prev)
+            )
+            accept = armijo(s.alpha, phi) & curvature(dphi)
+            pos_slope = dphi >= 0.0
+
+            # -> zoom(alpha_prev, alpha) on armijo failure
+            # -> zoom(alpha, alpha_prev) on positive slope
+            go_zoom = hit_armijo_fail | (~accept & pos_slope)
+            zoom_lo = jnp.where(hit_armijo_fail, s.alpha_prev, s.alpha)
+            zoom_philo = jnp.where(hit_armijo_fail, s.phi_prev, phi)
+            zoom_dphilo = jnp.where(hit_armijo_fail, s.dphi_prev, dphi)
+            zoom_hi = jnp.where(hit_armijo_fail, s.alpha, s.alpha_prev)
+            zoom_phihi = jnp.where(hit_armijo_fail, phi, s.phi_prev)
+            zoom_dphihi = jnp.where(hit_armijo_fail, dphi, s.dphi_prev)
+
+            next_alpha_bracket = jnp.minimum(s.alpha * 2.0, max_step)
+            overflow = s.alpha >= max_step
+
+            mode = jnp.where(
+                accept,
+                _DONE,
+                jnp.where(go_zoom, _ZOOM, jnp.where(overflow, _FAILED, _BRACKET)),
+            ).astype(jnp.int32)
+            first_zoom_trial = _cubic_min(
+                zoom_lo, zoom_philo, zoom_dphilo, zoom_hi, zoom_phihi, zoom_dphihi
+            )
+            return s._replace(
+                mode=mode,
+                alpha=jnp.where(go_zoom, first_zoom_trial, next_alpha_bracket),
+                alpha_prev=s.alpha,
+                phi_prev=phi,
+                dphi_prev=dphi,
+                lo=jnp.where(go_zoom, zoom_lo, s.lo),
+                phi_lo=jnp.where(go_zoom, zoom_philo, s.phi_lo),
+                dphi_lo=jnp.where(go_zoom, zoom_dphilo, s.dphi_lo),
+                hi=jnp.where(go_zoom, zoom_hi, s.hi),
+                phi_hi=jnp.where(go_zoom, zoom_phihi, s.phi_hi),
+                dphi_hi=jnp.where(go_zoom, zoom_dphihi, s.dphi_hi),
+                best_alpha=jnp.where(accept, s.alpha, s.best_alpha),
+                best_phi=jnp.where(accept, phi, s.best_phi),
+                best_dphi=jnp.where(accept, dphi, s.best_dphi),
+                evals=evals,
+            )
+
+        def zoom_step(s):
+            # Alg 3.6 with cubic-interpolated trial (s.alpha is the trial)
+            a = s.alpha
+            fail_armijo = (~armijo(a, phi)) | (phi >= s.phi_lo)
+            accept = (~fail_armijo) & curvature(dphi)
+            # on ~fail_armijo & ~accept: lo moves to a; hi moves to old lo if
+            # dphi*(hi-lo) >= 0
+            flip_hi = dphi * (s.hi - s.lo) >= 0.0
+            new_lo = jnp.where(fail_armijo, s.lo, a)
+            new_philo = jnp.where(fail_armijo, s.phi_lo, phi)
+            new_dphilo = jnp.where(fail_armijo, s.dphi_lo, dphi)
+            new_hi = jnp.where(fail_armijo, a, jnp.where(flip_hi, s.lo, s.hi))
+            new_phihi = jnp.where(
+                fail_armijo, phi, jnp.where(flip_hi, s.phi_lo, s.phi_hi)
+            )
+            new_dphihi = jnp.where(
+                fail_armijo, dphi, jnp.where(flip_hi, s.dphi_lo, s.dphi_hi)
+            )
+
+            interval = jnp.abs(new_hi - new_lo)
+            tiny = interval <= 1e-12 * jnp.maximum(1.0, jnp.abs(new_lo))
+            trial = _cubic_min(
+                new_lo, new_philo, new_dphilo, new_hi, new_phihi, new_dphihi
+            )
+            mode = jnp.where(
+                accept, _DONE, jnp.where(tiny, _FAILED, _ZOOM)
+            ).astype(jnp.int32)
+            return s._replace(
+                mode=mode,
+                alpha=trial,
+                lo=new_lo,
+                phi_lo=new_philo,
+                dphi_lo=new_dphilo,
+                hi=new_hi,
+                phi_hi=new_phihi,
+                dphi_hi=new_dphihi,
+                best_alpha=jnp.where(accept, a, s.best_alpha),
+                best_phi=jnp.where(accept, phi, s.best_phi),
+                best_dphi=jnp.where(accept, dphi, s.best_dphi),
+                evals=evals,
+            )
+
+        return lax.cond(s.mode == _BRACKET, bracket_step, zoom_step, s)
+
+    final = lax.while_loop(cond, body, init)
+
+    found = final.mode == _DONE
+    # Exhaustion/failed fallback: best sufficient-decrease trial seen anywhere
+    # (bracket growth or zoom), Armijo-only acceptance.
+    usable = (~found) & (final.armijo_alpha > 0.0) & (final.armijo_phi < phi0)
+    alpha = jnp.where(found, final.best_alpha, jnp.where(usable, final.armijo_alpha, 0.0))
+    phi = jnp.where(found, final.best_phi, jnp.where(usable, final.armijo_phi, phi0))
+    dphi = jnp.where(
+        found, final.best_dphi, jnp.where(usable, final.armijo_dphi, dphi0)
+    )
+    failed = ~(found | usable)
+    return LineSearchResult(
+        alpha=alpha, phi=phi, dphi=dphi, failed=failed, num_evals=final.evals
+    )
+
+
+def backtracking(
+    value_fn: Callable[[Array], Array],
+    full_value0: Array,
+    sufficient_decrease_fn: Callable[[Array, Array], Array],
+    step_fn: Callable[[Array], Array],
+    init_step: Array | float = 1.0,
+    shrink: float = 0.5,
+    max_evals: int = 25,
+) -> tuple[Array, Array, Array]:
+    """Generic backtracking search used by OWLQN's orthant-projected step.
+
+    ``step_fn(alpha) -> w_candidate`` builds the (projected) candidate,
+    ``value_fn(w)`` evaluates the full (regularized) objective, and
+    ``sufficient_decrease_fn(alpha, value)`` decides acceptance.
+    Returns (alpha, value, failed).
+    """
+
+    def cond(s):
+        alpha, value, evals, done = s
+        return (~done) & (evals < max_evals)
+
+    def body(s):
+        alpha, _, evals, _ = s
+        v = value_fn(step_fn(alpha))
+        ok = sufficient_decrease_fn(alpha, v)
+        return (
+            jnp.where(ok, alpha, alpha * shrink),
+            v,
+            evals + 1,
+            ok,
+        )
+
+    alpha0 = jnp.asarray(init_step, dtype=full_value0.dtype)
+    alpha, value, evals, done = lax.while_loop(
+        cond, body, (alpha0, full_value0, jnp.int32(0), jnp.bool_(False))
+    )
+    return jnp.where(done, alpha, 0.0), jnp.where(done, value, full_value0), ~done

@@ -1,0 +1,11 @@
+from photon_ml_tpu.parallel.distributed import (  # noqa: F401
+    distributed_solve,
+    distributed_value_and_grad,
+)
+from photon_ml_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    ENTITY_AXIS,
+    make_mesh,
+    put_sharded,
+    shard_rows,
+)

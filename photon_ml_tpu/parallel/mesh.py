@@ -1,0 +1,127 @@
+"""Device-mesh construction and batch sharding helpers.
+
+The framework's mesh vocabulary (SURVEY.md §2.f):
+  - axis ``data``:   examples sharded for fixed-effect (DP) training
+  - axis ``entity``: per-entity problem batches sharded for random-effect
+                     ("expert-parallel"-like) training
+Both can coexist in a 2-D mesh on larger slices; collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.ops.sparse import SparseBatch, _round_up
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "entity"
+
+
+def make_mesh(
+    axis_sizes: Optional[dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create a mesh; default is a 1-D data mesh over all devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if axis_sizes is None:
+        axis_sizes = {DATA_AXIS: len(devices)}
+    names = tuple(axis_sizes)
+    sizes = tuple(axis_sizes[n] for n in names)
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh {dict(axis_sizes)} needs {total} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def shard_rows(batch: SparseBatch, num_shards: int) -> SparseBatch:
+    """Host-side: split a batch into ``num_shards`` equal row blocks with
+    LOCAL row indices, stacked on a new leading axis.
+
+    The result's leaves have shape [num_shards, ...]; feed it to shard_map
+    with in_specs P(axis) (the leading axis is consumed by the mesh), or
+    vmap for testing. Row blocks are contiguous (rows are already sorted),
+    nnz is padded to the max shard nnz.
+    """
+    import jax.numpy as jnp
+
+    n = batch.num_rows
+    rows_per = _round_up(n, num_shards) // num_shards
+    rows_np = np.asarray(batch.rows)
+    vals_np = np.asarray(batch.values)
+    cols_np = np.asarray(batch.cols)
+
+    # valid (non-padding) nnz mask: padding points at last row with value 0
+    shard_of_nnz = np.minimum(rows_np // rows_per, num_shards - 1)
+
+    shards = []
+    for s in range(num_shards):
+        sel = (shard_of_nnz == s) & (vals_np != 0)
+        local_rows = rows_np[sel] - s * rows_per
+        lo, hi = s * rows_per, min((s + 1) * rows_per, n)
+        count = max(hi - lo, 0)
+
+        def pad_to(a, total, fill=0.0):
+            out = np.full((total,), fill, dtype=np.asarray(a).dtype)
+            out[: len(a)] = np.asarray(a)
+            return out
+
+        labels = pad_to(np.asarray(batch.labels)[lo:hi], rows_per)
+        offsets = pad_to(np.asarray(batch.offsets)[lo:hi], rows_per)
+        weights = pad_to(np.asarray(batch.weights)[lo:hi], rows_per)
+        shards.append(
+            dict(
+                values=vals_np[sel],
+                rows=local_rows,
+                cols=cols_np[sel],
+                labels=labels,
+                offsets=offsets,
+                weights=weights,
+            )
+        )
+
+    nnz_max = max(len(s["values"]) for s in shards)
+    nnz_max = max(nnz_max, 1)
+
+    stacked = {}
+    for key, fill in (
+        ("values", 0.0),
+        ("rows", None),
+        ("cols", 0),
+        ("labels", 0.0),
+        ("offsets", 0.0),
+        ("weights", 0.0),
+    ):
+        parts = []
+        for s in shards:
+            a = s[key]
+            if key in ("values", "rows", "cols"):
+                f = rows_per - 1 if key == "rows" else (fill or 0)
+                out = np.full((nnz_max,), f, dtype=a.dtype if len(a) else np.int64)
+                out[: len(a)] = a
+                parts.append(out)
+            else:
+                parts.append(a)
+        stacked[key] = np.stack(parts)
+
+    return SparseBatch(
+        values=jnp.asarray(stacked["values"], batch.dtype),
+        rows=jnp.asarray(stacked["rows"], jnp.int32),
+        cols=jnp.asarray(stacked["cols"], jnp.int32),
+        labels=jnp.asarray(stacked["labels"], batch.dtype),
+        offsets=jnp.asarray(stacked["offsets"], batch.dtype),
+        weights=jnp.asarray(stacked["weights"], batch.dtype),
+        num_features=batch.num_features,
+    )
+
+
+def put_sharded(stacked: SparseBatch, mesh: Mesh, axis: str = DATA_AXIS) -> SparseBatch:
+    """Place a host-stacked batch so shard i's block lives on device i."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)

@@ -23,6 +23,13 @@ os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The axon TPU-tunnel sitecustomize imports jax at interpreter startup, which
+# latches JAX_PLATFORMS before this conftest runs — override via the config
+# API as well so tests really run on the 8-device CPU mesh.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,173 @@
+"""Data-plane tests: stats vs numpy, normalization contexts + model
+back-transform, index maps (incl. mmap store), libsvm reader, validators."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import (
+    DataValidationError,
+    IndexMap,
+    MmapIndexMap,
+    NormalizationType,
+    ValidationMode,
+    build_normalization_context,
+    feature_key,
+    read_libsvm,
+    summarize,
+    validate,
+)
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.optim import lbfgs_solve, glm_adapter
+
+
+def test_summary_matches_numpy(rng):
+    n, d = 80, 10
+    X = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.6)
+    batch = SparseBatch.from_dense(X, np.zeros(n))
+    s = summarize(batch)
+    np.testing.assert_allclose(s.mean, X.mean(0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(s.variance, X.var(0, ddof=1), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(s.max, X.max(0), rtol=1e-5)
+    np.testing.assert_allclose(s.min, X.min(0), rtol=1e-5)
+    np.testing.assert_allclose(s.num_nonzeros, (X != 0).sum(0), rtol=1e-6)
+    np.testing.assert_allclose(s.norm_l1, np.abs(X).sum(0), rtol=1e-4)
+    np.testing.assert_allclose(s.norm_l2, np.sqrt((X**2).sum(0)), rtol=1e-4)
+    assert int(s.count) == n
+
+
+def test_summary_ignores_padded_rows(rng):
+    X = rng.normal(size=(30, 5))
+    batch = SparseBatch.from_dense(X, np.zeros(30)).pad_rows_to(40, 200)
+    s = summarize(batch)
+    np.testing.assert_allclose(s.mean, X.mean(0), rtol=1e-4, atol=1e-5)
+    assert int(s.count) == 30
+
+
+def test_standardization_context_and_back_transform(rng):
+    # train on standardized data, map coefficients back, scores must match
+    n, d = 120, 8
+    X = rng.normal(size=(n, d)) * 3 + 1.5
+    X[:, -1] = 1.0  # intercept column
+    y = (rng.random(n) < 0.5).astype(float)
+    batch = SparseBatch.from_dense(X, y)
+    s = summarize(batch)
+    ctx = build_normalization_context(
+        NormalizationType.STANDARDIZATION, s, intercept_index=d - 1
+    )
+    np.testing.assert_allclose(ctx.factors[-1], 1.0)
+    np.testing.assert_allclose(ctx.shifts[-1], 0.0)
+
+    obj_norm = make_objective(
+        "logistic", l2_weight=0.1, factors=ctx.factors, shifts=ctx.shifts
+    )
+    res = lbfgs_solve(glm_adapter(obj_norm, batch), jnp.zeros(d, jnp.float32))
+    w_orig = ctx.transform_model_coefficients(res.w)
+
+    # margins with original-space coefficients on raw X == normalized-space
+    # margins with trained coefficients
+    z_norm = obj_norm.margins(res.w, batch)
+    z_orig = batch.margins(w_orig, 0.0)
+    np.testing.assert_allclose(z_orig, z_norm, rtol=1e-3, atol=1e-3)
+
+
+def test_normalization_same_optimum_as_unnormalized(rng):
+    # NormalizationTest.scala analog: optimizing with standardization then
+    # back-transforming reaches the same solution as optimizing raw
+    n, d = 150, 6
+    X = np.hstack([rng.normal(size=(n, d - 1)) * np.asarray([1, 5, 0.2, 3, 0.7]),
+                   np.ones((n, 1))])
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ rng.normal(size=d))))).astype(float)
+    batch = SparseBatch.from_dense(X, y)
+    raw = lbfgs_solve(
+        glm_adapter(make_objective("logistic"), batch), jnp.zeros(d, jnp.float32)
+    )
+    ctx = build_normalization_context(
+        NormalizationType.STANDARDIZATION, summarize(batch), intercept_index=d - 1
+    )
+    res = lbfgs_solve(
+        glm_adapter(
+            make_objective("logistic", factors=ctx.factors, shifts=ctx.shifts), batch
+        ),
+        jnp.zeros(d, jnp.float32),
+    )
+    w_back = ctx.transform_model_coefficients(res.w)
+    np.testing.assert_allclose(w_back, raw.w, rtol=2e-2, atol=2e-2)
+
+
+def test_scale_variants(rng):
+    X = rng.normal(size=(50, 4)) * np.asarray([1.0, 10.0, 0.1, 5.0])
+    batch = SparseBatch.from_dense(X, np.zeros(50))
+    s = summarize(batch)
+    c1 = build_normalization_context(NormalizationType.SCALE_WITH_MAX_MAGNITUDE, s)
+    np.testing.assert_allclose(
+        c1.factors, 1.0 / np.maximum(np.abs(X.max(0)), np.abs(X.min(0))), rtol=1e-4
+    )
+    c2 = build_normalization_context(
+        NormalizationType.SCALE_WITH_STANDARD_DEVIATION, s
+    )
+    np.testing.assert_allclose(c2.factors, 1.0 / X.std(0, ddof=1), rtol=1e-3)
+    with pytest.raises(ValueError, match="intercept"):
+        build_normalization_context(NormalizationType.STANDARDIZATION, s)
+
+
+def test_index_map_roundtrip(tmp_path):
+    keys = [feature_key("age", ""), feature_key("country", "us"),
+            feature_key("country", "de"), "plainfeature"]
+    im = IndexMap.build(keys * 3, add_intercept=True)
+    assert len(im) == 5
+    # deterministic: sorted order
+    assert im.names == sorted(im.names)
+    d = str(tmp_path / "idx")
+    im.save(d)
+    im2 = IndexMap.load(d)
+    assert im2.names == im.names
+    mm = MmapIndexMap(d)
+    assert len(mm) == len(im)
+    for k in im:
+        assert mm.get(k) == im[k]
+        assert mm.name_of(im[k]) == k
+    assert mm.get("missing-key") == -1
+    got = mm.get_many(list(im.names) + ["nope"])
+    np.testing.assert_array_equal(got[:-1], np.arange(len(im)))
+    assert got[-1] == -1
+
+
+def test_libsvm_reader(tmp_path):
+    p = tmp_path / "small.libsvm"
+    p.write_text("+1 1:0.5 3:2.0\n-1 2:1.0\n+1 1:1.5\n")
+    data = read_libsvm(str(p))
+    assert data.num_features == 3
+    np.testing.assert_array_equal(data.labels, [1.0, 0.0, 1.0])
+    batch = data.to_batch(add_intercept=True)
+    assert batch.num_features == 4
+    dense = batch.to_dense()[:3]
+    np.testing.assert_allclose(
+        dense,
+        [[0.5, 0, 2.0, 1.0], [0, 1.0, 0, 1.0], [1.5, 0, 0, 1.0]],
+    )
+
+
+def test_validators(rng):
+    X = rng.normal(size=(20, 4))
+    ok = SparseBatch.from_dense(X, (rng.random(20) > 0.5).astype(float))
+    validate(ok, "logistic_regression")
+
+    bad_label = SparseBatch.from_dense(X, rng.normal(size=20) * 5)
+    with pytest.raises(DataValidationError, match="binary"):
+        validate(bad_label, "logistic_regression")
+    validate(bad_label, "linear_regression")
+
+    with pytest.raises(DataValidationError, match="non-negative"):
+        validate(SparseBatch.from_dense(X, -np.ones(20)), "poisson_regression")
+
+    nan_feat = X.copy()
+    nan_feat[3, 2] = np.nan
+    with pytest.raises(DataValidationError, match="feature"):
+        validate(SparseBatch.from_dense(nan_feat, np.ones(20)), "linear_regression")
+
+    # disabled mode swallows everything
+    validate(bad_label, "logistic_regression", mode=ValidationMode.DISABLED)

@@ -1,0 +1,100 @@
+"""Distributed (8-virtual-device mesh) tests: sharded solves match
+single-device solves bit-for-tolerance; collectives actually ride the mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.optim import (
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    glm_adapter,
+    lbfgs_solve,
+    solve,
+)
+from photon_ml_tpu.parallel import (
+    distributed_solve,
+    distributed_value_and_grad,
+    make_mesh,
+    put_sharded,
+    shard_rows,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    return make_mesh({"data": 8})
+
+
+def _problem(rng, n=400, d=20, loss="logistic"):
+    X = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.3)
+    if loss == "logistic":
+        y = (rng.random(n) < 1 / (1 + np.exp(-(X @ rng.normal(size=d))))).astype(float)
+    else:
+        y = X @ rng.normal(size=d) + 0.1 * rng.normal(size=n)
+    wt = rng.random(n) + 0.5
+    return SparseBatch.from_dense(X, y, weights=wt)
+
+
+def test_sharded_value_and_grad_matches_local(rng, mesh):
+    batch = _problem(rng)
+    stacked = put_sharded(shard_rows(batch, 8), mesh)
+    obj = make_objective("logistic", l2_weight=0.7)
+    w = jnp.asarray(rng.normal(size=batch.num_features) * 0.2, jnp.float32)
+    v_local, g_local = obj.value_and_grad(w, batch)
+    v_dist, g_dist = distributed_value_and_grad(obj, w, stacked, mesh)
+    np.testing.assert_allclose(v_dist, v_local, rtol=1e-5)
+    np.testing.assert_allclose(g_dist, g_local, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "opt,reg",
+    [
+        (OptimizerType.LBFGS, RegularizationType.L2),
+        (OptimizerType.TRON, RegularizationType.L2),
+        (OptimizerType.LBFGS, RegularizationType.L1),
+    ],
+)
+def test_distributed_solve_matches_single_device(rng, mesh, opt, reg):
+    batch = _problem(rng)
+    stacked = put_sharded(shard_rows(batch, 8), mesh)
+    cfg = OptimizerConfig(
+        optimizer_type=opt,
+        regularization=RegularizationContext(reg),
+        regularization_weight=1.0,
+        max_iterations=50,
+    )
+    w0 = jnp.zeros(batch.num_features, jnp.float32)
+    res_single = solve("logistic", batch, cfg, w0)
+    res_dist = distributed_solve("logistic", stacked, cfg, w0, mesh)
+    np.testing.assert_allclose(res_dist.value, res_single.value, rtol=1e-4)
+    np.testing.assert_allclose(res_dist.w, res_single.w, rtol=5e-3, atol=5e-3)
+
+
+def test_uneven_rows_sharding(rng, mesh):
+    # 403 rows over 8 shards: padding rows must stay inert
+    batch = _problem(rng, n=403)
+    stacked = put_sharded(shard_rows(batch, 8), mesh)
+    obj = make_objective("logistic", l2_weight=0.5)
+    w = jnp.asarray(rng.normal(size=batch.num_features) * 0.1, jnp.float32)
+    v_local, g_local = obj.value_and_grad(w, batch)
+    v_dist, g_dist = distributed_value_and_grad(obj, w, stacked, mesh)
+    np.testing.assert_allclose(v_dist, v_local, rtol=1e-5)
+    np.testing.assert_allclose(g_dist, g_local, rtol=1e-4, atol=1e-4)
+
+
+def test_result_is_replicated(rng, mesh):
+    batch = _problem(rng, n=160)
+    stacked = put_sharded(shard_rows(batch, 8), mesh)
+    cfg = OptimizerConfig(max_iterations=10, regularization_weight=1.0,
+                          regularization=RegularizationContext(RegularizationType.L2))
+    res = distributed_solve("logistic", stacked, cfg,
+                            jnp.zeros(batch.num_features, jnp.float32), mesh)
+    # replicated output: every device holds the full coefficient vector
+    assert res.w.sharding.is_fully_replicated

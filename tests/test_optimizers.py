@@ -1,0 +1,229 @@
+"""Optimizer tests: convergence to closed forms / KKT conditions, parity
+between LBFGS and TRON, vmap-batched solves, box constraints, warm starts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.optim import (
+    FUNCTION_VALUES_CONVERGED,
+    GRADIENT_CONVERGED,
+    BoxConstraints,
+    LBFGSConfig,
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    TRONConfig,
+    from_value_and_grad,
+    glm_adapter,
+    lbfgs_solve,
+    owlqn_solve,
+    solve,
+    tron_solve,
+)
+
+
+def _make_batch(rng, n=200, d=15, loss="squared", density=0.5):
+    X = rng.normal(size=(n, d)) * (rng.random((n, d)) < density)
+    if loss == "squared":
+        y = X @ rng.normal(size=d) + 0.1 * rng.normal(size=n)
+    elif loss == "poisson":
+        rate = np.exp(np.clip(X @ (rng.normal(size=d) * 0.3), -3, 3))
+        y = rng.poisson(rate).astype(np.float64)
+    else:
+        y = (rng.random(n) < 1 / (1 + np.exp(-(X @ rng.normal(size=d))))).astype(
+            np.float64
+        )
+    wt = rng.random(n) + 0.5
+    return X, y, wt, SparseBatch.from_dense(X, y, weights=wt)
+
+
+def _ridge_closed_form(X, y, wt, l2):
+    W = np.diag(wt)
+    return np.linalg.solve(X.T @ W @ X + l2 * np.eye(X.shape[1]), X.T @ (wt * y))
+
+
+def test_lbfgs_matches_ridge_closed_form(rng):
+    X, y, wt, batch = _make_batch(rng)
+    w_star = _ridge_closed_form(X, y, wt, l2=2.0)
+    obj = make_objective("squared", l2_weight=2.0)
+    res = lbfgs_solve(glm_adapter(obj, batch), jnp.zeros(X.shape[1], jnp.float32))
+    np.testing.assert_allclose(res.w, w_star, rtol=2e-3, atol=2e-3)
+    assert int(res.reason) in (FUNCTION_VALUES_CONVERGED, GRADIENT_CONVERGED)
+
+
+def test_tron_matches_ridge_closed_form(rng):
+    X, y, wt, batch = _make_batch(rng)
+    w_star = _ridge_closed_form(X, y, wt, l2=2.0)
+    obj = make_objective("squared", l2_weight=2.0)
+    res = tron_solve(glm_adapter(obj, batch), jnp.zeros(X.shape[1], jnp.float32))
+    np.testing.assert_allclose(res.w, w_star, rtol=2e-3, atol=2e-3)
+
+
+def test_lbfgs_tron_agree_logistic(rng):
+    X, y, wt, batch = _make_batch(rng, loss="logistic")
+    obj = make_objective("logistic", l2_weight=1.0)
+    ad = glm_adapter(obj, batch)
+    d = X.shape[1]
+    r1 = lbfgs_solve(ad, jnp.zeros(d, jnp.float32))
+    r2 = tron_solve(ad, jnp.zeros(d, jnp.float32))
+    np.testing.assert_allclose(r1.w, r2.w, rtol=5e-3, atol=5e-3)
+    # both at a stationary point
+    assert float(jnp.linalg.norm(obj.grad(r1.w, batch))) < 1e-2
+    assert float(jnp.linalg.norm(obj.grad(r2.w, batch))) < 1e-2
+
+
+def test_poisson_convergence(rng):
+    X, y, wt, batch = _make_batch(rng, loss="poisson")
+    obj = make_objective("poisson", l2_weight=0.5)
+    res = lbfgs_solve(glm_adapter(obj, batch), jnp.zeros(X.shape[1], jnp.float32))
+    gn = float(jnp.linalg.norm(obj.grad(res.w, batch)))
+    assert gn < 5e-2, f"gradient norm {gn}"
+
+
+def test_owlqn_lasso_kkt(rng):
+    X, y, wt, batch = _make_batch(rng)
+    obj = make_objective("squared", l2_weight=0.0)
+    # pick l1 between the at-zero gradient magnitudes so SOME coords stay zero
+    g0 = np.abs(np.asarray(obj.grad(jnp.zeros(X.shape[1], jnp.float32), batch)))
+    l1 = float(np.median(g0))
+    res = owlqn_solve(glm_adapter(obj, batch), jnp.zeros(X.shape[1], jnp.float32), l1)
+    w, g = np.asarray(res.w), np.asarray(obj.grad(res.w, batch))
+    # KKT: |g_j| <= l1 where w_j = 0 ; g_j = -l1*sign(w_j) where w_j != 0
+    tol = 5e-2 * max(1.0, np.abs(g).max())
+    zero = w == 0.0
+    assert np.all(np.abs(g[zero]) <= l1 + tol)
+    np.testing.assert_allclose(g[~zero], -l1 * np.sign(w[~zero]), atol=tol)
+    # sparsity actually induced
+    assert zero.sum() > 0
+
+
+def test_owlqn_produces_sparser_models_with_larger_l1(rng):
+    X, y, wt, batch = _make_batch(rng)
+    obj = make_objective("squared")
+    ad = glm_adapter(obj, batch)
+    g0 = np.abs(np.asarray(obj.grad(jnp.zeros(X.shape[1], jnp.float32), batch)))
+    nnz = []
+    for l1 in (0.01 * float(g0.min()), 0.9 * float(g0.max())):
+        res = owlqn_solve(ad, jnp.zeros(X.shape[1], jnp.float32), l1)
+        nnz.append(int(np.sum(np.asarray(res.w) != 0)))
+    assert nnz[1] < nnz[0]
+
+
+def test_box_constraints_projection_and_kkt(rng):
+    X, y, wt, batch = _make_batch(rng)
+    d = X.shape[1]
+    lo = jnp.full((d,), -0.1)
+    hi = jnp.full((d,), 0.1)
+    obj = make_objective("squared", l2_weight=1.0)
+    res = lbfgs_solve(
+        glm_adapter(obj, batch),
+        jnp.zeros(d, jnp.float32),
+        constraints=BoxConstraints(lower=lo, upper=hi),
+    )
+    w = np.asarray(res.w)
+    assert np.all(w >= -0.1 - 1e-6) and np.all(w <= 0.1 + 1e-6)
+    # KKT for box: at interior points gradient ~ 0; at bounds gradient pushes out
+    g = np.asarray(obj.grad(res.w, batch))
+    interior = (w > -0.1 + 1e-4) & (w < 0.1 - 1e-4)
+    scale = max(1.0, np.abs(g).max())
+    assert np.all(np.abs(g[interior]) < 0.05 * scale)
+    assert np.all(g[w >= 0.1 - 1e-6] <= 1e-3 * scale)
+    assert np.all(g[w <= -0.1 + 1e-6] >= -1e-3 * scale)
+
+
+def test_vmap_batched_lbfgs_matches_individual(rng):
+    # the random-effect pattern: vmap over K independent problems
+    K, n, d = 5, 40, 6
+    Xs = rng.normal(size=(K, n, d))
+    ys = np.stack([X @ rng.normal(size=d) for X in Xs])
+    obj = make_objective("squared", l2_weight=1.0)
+
+    # build K batches with identical shapes, stack their arrays
+    batches = [SparseBatch.from_dense(Xs[k], ys[k]) for k in range(K)]
+    nnz_max = max(b.nnz for b in batches)
+    batches = [b.pad_rows_to(n, nnz_max) for b in batches]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+
+    cfg = LBFGSConfig(max_iterations=50)
+
+    def solve_one(b):
+        return lbfgs_solve(glm_adapter(obj, b), jnp.zeros(d, jnp.float32), cfg)
+
+    batched = jax.jit(jax.vmap(solve_one))(stacked)
+    for k in range(K):
+        single = solve_one(batches[k])
+        np.testing.assert_allclose(batched.w[k], single.w, rtol=1e-3, atol=1e-3)
+
+
+def test_warm_start_converges_quickly(rng):
+    X, y, wt, batch = _make_batch(rng)
+    obj = make_objective("squared", l2_weight=2.0)
+    ad = glm_adapter(obj, batch)
+    d = X.shape[1]
+    cold = lbfgs_solve(ad, jnp.zeros(d, jnp.float32))
+    warm = lbfgs_solve(
+        ad,
+        cold.w,
+        init_value=cold.values[0],
+        init_grad_norm=cold.grad_norms[0],
+    )
+    assert int(warm.iterations) <= 3
+    np.testing.assert_allclose(warm.w, cold.w, rtol=1e-3, atol=1e-3)
+
+
+def test_factory_dispatch_and_validation(rng):
+    X, y, wt, batch = _make_batch(rng, loss="logistic")
+    d = X.shape[1]
+    w0 = jnp.zeros(d, jnp.float32)
+    for opt, reg in [
+        (OptimizerType.LBFGS, RegularizationType.L2),
+        (OptimizerType.TRON, RegularizationType.L2),
+        (OptimizerType.LBFGS, RegularizationType.ELASTIC_NET),
+    ]:
+        cfg = OptimizerConfig(
+            optimizer_type=opt,
+            regularization=RegularizationContext(reg, alpha=0.5),
+            regularization_weight=1.0,
+            max_iterations=40,
+        )
+        res = solve("logistic", batch, cfg, w0)
+        assert np.all(np.isfinite(np.asarray(res.w)))
+
+    with pytest.raises(ValueError, match="TRON does not support L1"):
+        solve(
+            "logistic",
+            batch,
+            OptimizerConfig(
+                optimizer_type=OptimizerType.TRON,
+                regularization=RegularizationContext(RegularizationType.L1),
+                regularization_weight=1.0,
+            ),
+            w0,
+        )
+    with pytest.raises(ValueError, match="twice-differentiable"):
+        solve(
+            "smoothed_hinge",
+            batch,
+            OptimizerConfig(optimizer_type=OptimizerType.TRON),
+            w0,
+        )
+
+
+def test_generic_objective_rosenbrock():
+    # non-GLM objective through the generic adapter: Rosenbrock in 2D
+    def f(w):
+        v = 100.0 * (w[1] - w[0] ** 2) ** 2 + (1.0 - w[0]) ** 2
+        return v
+
+    ad = from_value_and_grad(jax.value_and_grad(f))
+    res = lbfgs_solve(
+        ad,
+        jnp.asarray([-1.2, 1.0], jnp.float32),
+        LBFGSConfig(max_iterations=200, tolerance=1e-12),
+    )
+    np.testing.assert_allclose(res.w, [1.0, 1.0], atol=2e-2)
