@@ -1,0 +1,94 @@
+"""Benchmark: sparse logistic GLM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config #1 from BASELINE.md: L2 logistic regression, 1M x 10K sparse
+(~20 nnz/row). Metric = example-rows processed per second per chip, where
+rows processed = n_rows x (number of full-data objective passes: one
+value+grad per LBFGS iteration + the initial evaluation; margin-space line
+search trials are O(rows) elementwise and excluded). The reference publishes
+no numbers (BASELINE.json "published": {}), so vs_baseline is null until a
+measured Spark baseline exists.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.ops.sparse import SparseBatch
+    from photon_ml_tpu.optim import LBFGSConfig, glm_adapter, lbfgs_solve
+
+    n_rows = 1_000_000
+    n_features = 10_000
+    nnz_per_row = 20
+    max_iters = 20
+
+    rng = np.random.default_rng(0)
+    nnz = n_rows * nnz_per_row
+    rows = np.repeat(np.arange(n_rows, dtype=np.int64), nnz_per_row)
+    cols = rng.integers(0, n_features, size=nnz)
+    values = rng.normal(size=nnz)
+    w_true = rng.normal(size=n_features) * 0.5
+    # labels from a planted model so the optimizer does real work
+    margins = np.zeros(n_rows)
+    np.add.at(margins, rows, values * w_true[cols])
+    y = (rng.random(n_rows) < 1.0 / (1.0 + np.exp(-margins))).astype(np.float64)
+
+    batch = SparseBatch.from_coo(
+        values=values, rows=rows, cols=cols, labels=y, num_features=n_features
+    )
+    obj = make_objective("logistic", l2_weight=1.0)
+    cfg = LBFGSConfig(max_iterations=max_iters, tolerance=0.0)  # fixed work
+
+    def run(w0):
+        return lbfgs_solve(glm_adapter(obj, batch), w0, cfg)
+
+    run_jit = jax.jit(run)
+
+    # compile + warmup with a DIFFERENT w0 than the timed run: identical
+    # (fn, args) re-executions are result-cached on the tunnel TPU, and
+    # block_until_ready is a no-op there — a scalar fetch inside the timed
+    # window is the only true sync (PERF_NOTES.md).
+    w_warm = jnp.asarray(rng.normal(size=n_features) * 1e-3, jnp.float32)
+    float(run_jit(w_warm).value)
+
+    w0 = jnp.zeros((n_features,), jnp.float32)
+    t0 = time.perf_counter()
+    res = run_jit(w0)
+    final_value = float(res.value)  # forces execution + D2H sync
+    elapsed = time.perf_counter() - t0
+
+    iters = int(res.iterations)
+    passes = iters + 1  # init value_and_grad + one per iteration
+    rows_per_sec = n_rows * passes / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "glm_logistic_1Mx10K_rows_per_sec_per_chip",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/s",
+                "vs_baseline": None,
+                "detail": {
+                    "elapsed_s": round(elapsed, 3),
+                    "lbfgs_iterations": iters,
+                    "final_loss": final_value,
+                    "platform": jax.devices()[0].platform,
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

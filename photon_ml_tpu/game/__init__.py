@@ -1,0 +1,25 @@
+from photon_ml_tpu.game.coordinate_descent import (  # noqa: F401
+    CoordinateDescentResult,
+    ValidationSpec,
+    run_coordinate_descent,
+)
+from photon_ml_tpu.game.coordinates import (  # noqa: F401
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.dataset import (  # noqa: F401
+    GameDataset,
+    IdColumn,
+    build_game_dataset,
+)
+from photon_ml_tpu.game.models import (  # noqa: F401
+    FixedEffectModel,
+    GameModel,
+    RandomEffectBucketModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.game.random_effect_data import (  # noqa: F401
+    EntityBucket,
+    RandomEffectDataset,
+    build_random_effect_dataset,
+)

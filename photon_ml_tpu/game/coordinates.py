@@ -1,0 +1,240 @@
+"""GAME coordinates: the per-block training strategies driven by coordinate
+descent.
+
+Reference analog: photon-api algorithm/{Coordinate,FixedEffectCoordinate,
+RandomEffectCoordinate}.scala (SURVEY.md §2.c). A coordinate owns its data
+block and knows how to (re)train its sub-model given residual scores from
+the other coordinates and how to produce its scores on the training data.
+
+TPU realization:
+  - FixedEffectCoordinate: one (optionally mesh-sharded) GLM solve; the
+    residuals enter as extra offsets (addScoresToOffsets analog).
+  - RandomEffectCoordinate: per geometry bucket, ONE vmapped optimizer call
+    solves every entity's independent problem simultaneously; converged
+    entities freeze in the masked while-loop. No cross-device communication
+    during the solve (SURVEY.md §2.f "per-entity model parallelism").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Optional, Protocol
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    RandomEffectBucketModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.game.random_effect_data import EntityBucket, RandomEffectDataset
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optim.adapter import glm_adapter
+from photon_ml_tpu.optim.factory import OptimizerConfig, dispatch_solve
+
+Array = jax.Array
+
+
+class Coordinate(Protocol):
+    name: str
+
+    def initialize_model(self): ...
+
+    def update_model(self, model, residual_scores: Array): ...
+
+    def score(self, model) -> Array: ...
+
+
+# ---------------------------------------------------------------------------
+# Fixed effect
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=64)
+def _fe_solver(config: OptimizerConfig, loss_name: str):
+    def run(obj, batch, w0, l1):
+        return dispatch_solve(glm_adapter(obj, batch), w0, config, l1)
+
+    return jax.jit(run)
+
+
+@dataclasses.dataclass
+class FixedEffectCoordinate:
+    """Global GLM block (the DP strategy; FixedEffectCoordinate.scala:33-167).
+
+    Residual scores arrive as additional offsets; the solve warm-starts from
+    the current sub-model. Down-sampling (BinaryClassificationDownSampler
+    analog) re-weights kept negatives by 1/rate.
+    """
+
+    name: str
+    data: GameDataset
+    shard_name: str
+    loss_name: str
+    config: OptimizerConfig
+    seed: int = 0
+
+    def __post_init__(self):
+        self.config.validate(self.loss_name)
+        base = self.data.batch_for(self.shard_name)
+        self._batch = self._maybe_downsample(base)
+        key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
+        self._solver = _fe_solver(key_cfg, self.loss_name)
+        self._obj = make_objective(
+            self.loss_name,
+            l2_weight=self.config.regularization.l2_weight(
+                self.config.regularization_weight
+            ),
+        )
+        self._l1 = jnp.float32(
+            self.config.regularization.l1_weight(self.config.regularization_weight)
+        )
+
+    def _maybe_downsample(self, batch):
+        rate = self.config.down_sampling_rate
+        if rate >= 1.0:
+            return batch
+        rng = np.random.default_rng(self.seed)
+        labels = np.asarray(batch.labels)
+        weights = np.asarray(batch.weights).copy()
+        if "logistic" in self.loss_name or "hinge" in self.loss_name:
+            # keep all positives, sample negatives at rate, reweight by 1/rate
+            neg = (labels <= 0.5) & (weights > 0)
+            drop = neg & (rng.random(len(labels)) >= rate)
+            weights[drop] = 0.0
+            weights[neg & ~drop] /= rate
+        else:
+            keep = rng.random(len(labels)) < rate
+            weights[~keep] = 0.0
+            weights[keep] /= rate
+        return dataclasses.replace(batch, weights=jnp.asarray(weights, batch.dtype))
+
+    def initialize_model(self) -> FixedEffectModel:
+        d = self._batch.num_features
+        return FixedEffectModel(
+            coefficients=jnp.zeros((d,), self._batch.dtype),
+            shard_name=self.shard_name,
+        )
+
+    def update_model(
+        self, model: FixedEffectModel, residual_scores: Optional[Array]
+    ) -> FixedEffectModel:
+        batch = self._batch
+        if residual_scores is not None:
+            batch = batch.with_offsets(batch.offsets + residual_scores)
+        res = self._solver(self._obj, batch, model.coefficients, self._l1)
+        return dataclasses.replace(model, coefficients=res.w)
+
+    def score(self, model: FixedEffectModel) -> Array:
+        return model.score(self.data)
+
+
+# ---------------------------------------------------------------------------
+# Random effect
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=64)
+def _re_solver(config: OptimizerConfig, loss_name: str):
+    def solve_one(obj, batch, w0, l1):
+        return dispatch_solve(glm_adapter(obj, batch), w0, config, l1)
+
+    # obj, l1 broadcast; batch leaves and w0 map over the entity axis
+    return jax.jit(jax.vmap(solve_one, in_axes=(None, 0, 0, None)))
+
+
+@lru_cache(maxsize=64)
+def _re_scorer():
+    def score_bucket(coeffs, bucket_batch):
+        # per-entity margins x.w (no offsets) -> [E, R]
+        return jax.vmap(lambda w, b: b.dot_rows(w))(coeffs, bucket_batch)
+
+    return jax.jit(score_bucket)
+
+
+@dataclasses.dataclass
+class RandomEffectCoordinate:
+    """Per-entity GLM blocks (RandomEffectCoordinate.scala:37-208).
+
+    Each bucket's entities are solved by one vmapped jit-compiled optimizer
+    run — the analog of Spark's mapValues-with-local-solver, with identical
+    per-entity optimization configs (RandomEffectOptimizationProblem
+    semantics). Passive rows are scored through the model's searchsorted
+    path.
+    """
+
+    name: str
+    data: GameDataset
+    re_data: RandomEffectDataset
+    loss_name: str
+    config: OptimizerConfig
+
+    def __post_init__(self):
+        self.config.validate(self.loss_name)
+        key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
+        self._solver = _re_solver(key_cfg, self.loss_name)
+        self._scorer = _re_scorer()
+        self._obj = make_objective(
+            self.loss_name,
+            l2_weight=self.config.regularization.l2_weight(
+                self.config.regularization_weight
+            ),
+        )
+        self._l1 = jnp.float32(
+            self.config.regularization.l1_weight(self.config.regularization_weight)
+        )
+
+    def initialize_model(self) -> RandomEffectModel:
+        buckets = tuple(
+            RandomEffectBucketModel(
+                coefficients=jnp.zeros(
+                    (b.num_entities, b.num_local_features), b.values.dtype
+                ),
+                projection=b.projection,
+                entity_codes=b.entity_codes,
+            )
+            for b in self.re_data.buckets
+        )
+        return RandomEffectModel(
+            id_name=self.re_data.id_name,
+            shard_name=self.re_data.shard_name,
+            buckets=buckets,
+            entity_bucket=self.re_data.entity_bucket,
+            entity_pos=self.re_data.entity_pos,
+            vocab=self.data.id_columns[self.re_data.id_name].vocab,
+        )
+
+    def update_model(
+        self, model: RandomEffectModel, residual_scores: Optional[Array]
+    ) -> RandomEffectModel:
+        new_buckets = []
+        for b, bm in zip(self.re_data.buckets, model.buckets):
+            bucket = (
+                b if residual_scores is None else b.with_extra_offsets(residual_scores)
+            )
+            res = self._solver(
+                self._obj, bucket.entity_batch(), bm.coefficients, self._l1
+            )
+            new_buckets.append(dataclasses.replace(bm, coefficients=res.w))
+        return dataclasses.replace(model, buckets=tuple(new_buckets))
+
+    def score(self, model: RandomEffectModel) -> Array:
+        """Scores on the training data: fast bucket path for active rows,
+        model searchsorted path for passive rows."""
+        n_pad = self.data.shard(self.re_data.shard_name).num_rows
+        scores = jnp.zeros((n_pad,), jnp.float32)
+        for b, bm in zip(self.re_data.buckets, model.buckets):
+            margins = self._scorer(bm.coefficients, b.entity_batch())  # [E, R]
+            idx = b.row_index.reshape(-1)
+            vals = margins.reshape(-1)
+            scores = scores.at[jnp.maximum(idx, 0)].add(
+                jnp.where(idx >= 0, vals, 0.0)
+            )
+        if len(self.re_data.passive_rows):
+            passive_scores = model.score(self.data)
+            mask = np.zeros(n_pad, bool)
+            mask[self.re_data.passive_rows] = True
+            scores = jnp.where(jnp.asarray(mask), passive_scores, scores)
+        return scores

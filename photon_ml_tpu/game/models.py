@@ -1,0 +1,164 @@
+"""GAME model containers: fixed-effect, random-effect, and the composite
+GAME model whose score is the sum of sub-model scores.
+
+Reference analog: photon-lib model/GAMEModel.scala:32-188 (sum-of-scores at
+:125-127, single-task enforcement at :181-187), photon-api
+model/{FixedEffectModel,RandomEffectModel}.scala. Sub-model scores are raw
+margins x.w (no offsets, no link), matching DatumScoringModel semantics —
+offsets enter only through training objectives and evaluator inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
+from photon_ml_tpu.ops.losses import get_loss
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """Global GLM coefficients over one feature shard (original space)."""
+
+    coefficients: Array  # f[num_features]
+    shard_name: str = dataclasses.field(metadata=dict(static=True))
+
+    def score(self, data: GameDataset) -> Array:
+        """Raw scores x.w for every example row ([n_pad] aligned array)."""
+        return data.shard(self.shard_name).dot_rows(self.coefficients)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RandomEffectBucketModel:
+    """Per-entity coefficients for one geometry bucket, aligned with the
+    bucket's sorted projection (local id k <-> global feature projection[k])."""
+
+    coefficients: Array  # f[E, K]
+    projection: Array  # i32[E, K] sorted global ids; sentinel = num_global
+    entity_codes: Array  # i32[E]
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """All per-entity models for one random-effect coordinate.
+
+    The coefficient table is sharded across buckets exactly as the training
+    data was (model co-located with its entity's data — the bin-packing
+    co-partitioning analog, RandomEffectOptimizationProblem.scala:28-131).
+    """
+
+    id_name: str
+    shard_name: str
+    buckets: tuple[RandomEffectBucketModel, ...]
+    entity_bucket: np.ndarray  # host: TRAINING entity code -> bucket (-1 none)
+    entity_pos: np.ndarray
+    vocab: np.ndarray  # training id vocabulary (sorted unique values)
+
+    def _codes_for(self, data: GameDataset) -> np.ndarray:
+        """Map a dataset's entity VALUES to training codes (-1 if unseen).
+
+        Entity identity is the id value, not the dataset-local integer code —
+        a scoring dataset has its own vocabulary (the RDD analog joins by
+        entity id string, RandomEffectModel.scala)."""
+        idc = data.id_columns[self.id_name]
+        values = idc.vocab[idc.codes]  # [n] original values
+        pos = np.searchsorted(self.vocab, values)
+        pos_c = np.minimum(pos, len(self.vocab) - 1)
+        hit = self.vocab[pos_c] == values
+        return np.where(hit, pos_c, -1)
+
+    def score(self, data: GameDataset) -> Array:
+        """Scores for every example row; entities without a model score 0.
+
+        Device kernel per bucket: rows are grouped by entity bucket on host,
+        then each nnz looks up its coefficient by binary search over the
+        entity's sorted projection (searchsorted), multiplies and
+        segment-sums. Entities unseen in training contribute nothing —
+        matching the reference's behavior of scoring only entities with
+        models (RandomEffectModel joins by entity id).
+        """
+        if data.id_columns.get(self.id_name) is None:
+            raise KeyError(f"scoring data lacks id column '{self.id_name}'")
+        batch = data.shard(self.shard_name)
+        n = data.num_rows
+        codes = self._codes_for(data)  # host [n], -1 for unseen entities
+
+        known = codes >= 0
+        safe_codes = np.where(known, codes, 0)
+        row_bucket = np.where(known, self.entity_bucket[safe_codes], -1)
+        row_pos = np.where(known, self.entity_pos[safe_codes], -1)
+
+        vals = np.asarray(batch.values)
+        rows = np.asarray(batch.rows)
+        cols = np.asarray(batch.cols)
+        live = (vals != 0) & (rows < n)
+
+        scores = jnp.zeros((batch.num_rows,), dtype=batch.dtype)
+        for b_idx, bm in enumerate(self.buckets):
+            sel = live & (row_bucket[np.minimum(rows, n - 1)] == b_idx)
+            if not np.any(sel):
+                continue
+            v = jnp.asarray(vals[sel], batch.dtype)
+            r = jnp.asarray(rows[sel], jnp.int32)
+            g = jnp.asarray(cols[sel], jnp.int32)
+            pos = jnp.asarray(row_pos[rows[sel]], jnp.int32)
+
+            proj_rows = bm.projection[pos]  # [m, K]
+            k = jax.vmap(jnp.searchsorted)(proj_rows, g)  # [m]
+            k = jnp.minimum(k, bm.projection.shape[1] - 1)
+            hit = jnp.take_along_axis(proj_rows, k[:, None], axis=1)[:, 0] == g
+            w = jnp.where(
+                hit,
+                jnp.take_along_axis(bm.coefficients[pos], k[:, None], axis=1)[:, 0],
+                0.0,
+            )
+            scores = scores.at[r].add(v * w)
+        return scores
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Named sub-models; score = sum of sub-model scores (GAMEModel:125-127).
+    All coordinates share one task type (GAMEModel.scala:181-187)."""
+
+    task: str
+    models: Mapping[str, object]  # name -> FixedEffectModel | RandomEffectModel
+
+    def __post_init__(self):
+        get_loss(self.task)
+
+    def score(self, data: GameDataset) -> Array:
+        total = None
+        for model in self.models.values():
+            s = model.score(data)
+            total = s if total is None else total + s
+        if total is None:
+            raise ValueError("GAME model has no sub-models")
+        return total
+
+    def predict_mean(self, data: GameDataset) -> Array:
+        raw = self.score(data)
+        scores = raw + jnp.asarray(
+            np.pad(data.offset, (0, raw.shape[0] - data.num_rows))
+        ).astype(jnp.float32)
+        name = get_loss(self.task).name
+        if name == "logistic":
+            return jax.nn.sigmoid(scores)
+        if name == "poisson":
+            return jnp.exp(scores)
+        return scores
+
+    def with_model(self, name: str, model) -> "GameModel":
+        new = dict(self.models)
+        new[name] = model
+        return dataclasses.replace(self, models=new)
