@@ -1,3 +1,4 @@
 from photon_ml_tpu.ops.losses import LOSSES, PointwiseLoss, get_loss  # noqa: F401
 from photon_ml_tpu.ops.objective import GLMObjective, make_objective  # noqa: F401
 from photon_ml_tpu.ops.sparse import SparseBatch, concat_batches  # noqa: F401
+from photon_ml_tpu.ops.tiled import TiledBatch  # noqa: F401

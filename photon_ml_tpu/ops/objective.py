@@ -95,13 +95,16 @@ class GLMObjective:
     def value_and_grad(
         self, w: Array, batch: SparseBatch, axis_name: Optional[str] = None
     ) -> tuple[Array, Array]:
-        z = self.margins(w, batch)
-        l, dz = self.loss.loss_and_dz(z, batch.labels)
-        value = self._psum(jnp.sum(batch.weights * l), axis_name)
-        g_row = batch.weights * dz
+        # One batch-layout-level sweep computes the weighted loss sum, the
+        # raw gradient scatter, and sum(w*dz) (needed for the normalization
+        # back-transform). TiledBatch fuses all three into one pallas pass.
+        w_eff, shift = self._effective(w)
+        data_value, raw_grad, row_total = batch.fused_value_grad(
+            w_eff, shift, self.loss_name
+        )
+        value = self._psum(data_value, axis_name)
         grad = self._psum(
-            self._back_transform_vec(batch.scatter_features(g_row), jnp.sum(g_row)),
-            axis_name,
+            self._back_transform_vec(raw_grad, row_total), axis_name
         )
         l2 = self.l2_weight.astype(w.dtype)
         value = value + 0.5 * l2 * jnp.dot(w, w)
@@ -128,10 +131,10 @@ class GLMObjective:
         self, w: Array, v: Array, batch: SparseBatch, axis_name: Optional[str] = None
     ) -> Array:
         """H(w) @ v  =  sum_i weight_i * l''(z_i) * (x'_i . v) * x'_i  + l2*v."""
-        z = self.margins(w, batch)
-        d2_row = batch.weights * self.loss.d2z(z, batch.labels)
         v_eff, v_shift = self._effective(v)
-        xv = batch.dot_rows(v_eff) + v_shift  # x'_i . v per row
+        w_eff, w_shift = self._effective(w)
+        z, xv = batch.margins_pair(w_eff, w_shift, v_eff, v_shift)
+        d2_row = batch.weights * self.loss.d2z(z, batch.labels)
         q = d2_row * xv
         hv = self._psum(
             self._back_transform_vec(batch.scatter_features(q), jnp.sum(q)), axis_name

@@ -171,6 +171,38 @@ class SparseBatch:
             contrib, self.rows, num_segments=self.num_rows, indices_are_sorted=True
         )
 
+    def margins_pair(
+        self, w: Array, shift, p: Array, p_shift
+    ) -> tuple[Array, Array]:
+        """(margins(w, shift), dot_rows(p) + p_shift).
+
+        Layouts that can share one data sweep between the two gathers
+        (TiledBatch) override this; here it is the plain composition, so
+        call sites need no per-layout dispatch.
+        """
+        return self.margins(w, shift), self.dot_rows(p) + p_shift
+
+    def fused_value_grad(
+        self, w: Array, shift, loss_name: str
+    ) -> tuple[Array, Array, Array]:
+        """(sum_i wgt_i*l(z_i), raw gradient scatter, sum_i wgt_i*dz_i).
+
+        The raw gradient is sum_i wgt_i*dz_i*x_i with NO normalization
+        back-transform or regularization (the objective applies those).
+        TiledBatch computes all three in one fused pallas sweep; this is
+        the equivalent composition for the padded-COO layout.
+        """
+        from photon_ml_tpu.ops.losses import get_loss
+
+        z = self.margins(w, shift)
+        l, dz = get_loss(loss_name).loss_and_dz(z, self.labels)
+        g_row = self.weights * dz
+        return (
+            jnp.sum(self.weights * l),
+            self.scatter_features(g_row),
+            jnp.sum(g_row),
+        )
+
     def scatter_features(self, per_row: Array) -> Array:
         """Compute sum_i per_row[i] * x_i as a dense feature-space vector.
 

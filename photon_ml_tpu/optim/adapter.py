@@ -57,9 +57,11 @@ def glm_adapter(
         return obj.value(w, batch, axis_name)
 
     def ls_prepare(w, p):
-        z = obj.margins(w, batch)
+        # TiledBatch shares one pass over the nnz slots for both gathers;
+        # SparseBatch composes margins + dot_rows.
         p_eff, p_shift = obj._effective(p)
-        u = batch.dot_rows(p_eff) + p_shift
+        w_eff, w_shift = obj._effective(w)
+        z, u = batch.margins_pair(w_eff, w_shift, p_eff, p_shift)
         return _LSCarry(
             z=z,
             u=u,
