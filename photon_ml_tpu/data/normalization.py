@@ -49,6 +49,21 @@ class NormalizationContext:
             out = out.at[self.intercept_index].add(-jnp.dot(out, self.shifts))
         return out
 
+    def inverse_transform_model_coefficients(self, w: Array) -> Array:
+        """Original space -> normalized space (exact inverse of the above).
+
+        Used to warm-start a normalized solve from a model stored in
+        original space (models always live in original space so scoring
+        never needs the context)."""
+        out = w
+        if self.shifts is not None:
+            if self.intercept_index is None:
+                raise ValueError("shifts require an intercept column")
+            out = out.at[self.intercept_index].add(jnp.dot(out, self.shifts))
+        if self.factors is not None:
+            out = out / self.factors
+        return out
+
 
 def build_normalization_context(
     normalization_type: NormalizationType | str,

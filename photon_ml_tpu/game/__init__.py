@@ -23,3 +23,10 @@ from photon_ml_tpu.game.random_effect_data import (  # noqa: F401
     RandomEffectDataset,
     build_random_effect_dataset,
 )
+from photon_ml_tpu.game.estimator import (  # noqa: F401
+    FixedEffectConfig,
+    GameConfig,
+    GameEstimator,
+    GameFitResult,
+    RandomEffectConfig,
+)

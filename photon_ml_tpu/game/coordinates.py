@@ -31,6 +31,7 @@ from photon_ml_tpu.game.models import (
     RandomEffectBucketModel,
     RandomEffectModel,
 )
+from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.game.random_effect_data import EntityBucket, RandomEffectDataset
 from photon_ml_tpu.ops.objective import make_objective
 from photon_ml_tpu.optim.adapter import glm_adapter
@@ -76,6 +77,7 @@ class FixedEffectCoordinate:
     loss_name: str
     config: OptimizerConfig
     seed: int = 0
+    normalization: Optional["NormalizationContext"] = None
 
     def __post_init__(self):
         self.config.validate(self.loss_name)
@@ -83,11 +85,14 @@ class FixedEffectCoordinate:
         self._batch = self._maybe_downsample(base)
         key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
         self._solver = _fe_solver(key_cfg, self.loss_name)
+        norm = self.normalization
         self._obj = make_objective(
             self.loss_name,
             l2_weight=self.config.regularization.l2_weight(
                 self.config.regularization_weight
             ),
+            factors=None if norm is None else norm.factors,
+            shifts=None if norm is None else norm.shifts,
         )
         self._l1 = jnp.float32(
             self.config.regularization.l1_weight(self.config.regularization_weight)
@@ -125,8 +130,16 @@ class FixedEffectCoordinate:
         batch = self._batch
         if residual_scores is not None:
             batch = batch.with_offsets(batch.offsets + residual_scores)
-        res = self._solver(self._obj, batch, model.coefficients, self._l1)
-        return dataclasses.replace(model, coefficients=res.w)
+        w0 = model.coefficients
+        if self.normalization is not None:
+            # models live in ORIGINAL space; the solve runs in normalized
+            # space (createModel analog, GeneralizedLinearOptimizationProblem)
+            w0 = self.normalization.inverse_transform_model_coefficients(w0)
+        res = self._solver(self._obj, batch, w0, self._l1)
+        w = res.w
+        if self.normalization is not None:
+            w = self.normalization.transform_model_coefficients(w)
+        return dataclasses.replace(model, coefficients=w)
 
     def score(self, model: FixedEffectModel) -> Array:
         return model.score(self.data)

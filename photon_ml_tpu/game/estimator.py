@@ -1,0 +1,227 @@
+"""GameEstimator: typed-config end-to-end GAME training.
+
+Reference analog: photon-client estimators/GameEstimator.scala:53-472 (the
+programmatic fit surface) and GameParams.scala:215-492 (the flag system).
+One typed config replaces both (SURVEY.md §5 "Config / flag system"): it
+names the coordinates in updating-sequence order, their shards/optimizers/
+normalization, the evaluators, and the CD schedule; ``fit`` builds the
+datasets and coordinates, runs coordinate descent, and returns the final +
+best models, optionally persisting them (the training driver's
+"best/" output layout, cli/game/training/Driver.scala:262-312).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional, Sequence
+
+from photon_ml_tpu.data.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    build_normalization_context,
+)
+from photon_ml_tpu.data.stats import summarize
+from photon_ml_tpu.game.coordinate_descent import (
+    CoordinateDescentResult,
+    ValidationSpec,
+    run_coordinate_descent,
+)
+from photon_ml_tpu.game.coordinates import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.game.models import GameModel
+from photon_ml_tpu.game.random_effect_data import build_random_effect_dataset
+from photon_ml_tpu.optim.factory import OptimizerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectConfig:
+    """One global GLM coordinate (FixedEffectDataConfiguration +
+    GLMOptimizationConfiguration analog)."""
+
+    shard_name: str
+    optimizer: OptimizerConfig = OptimizerConfig()
+    normalization: NormalizationType | str = NormalizationType.NONE
+    intercept_index: Optional[int] = None
+    down_sampling_seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectConfig:
+    """One per-entity coordinate (RandomEffectDataConfiguration analog:
+    randomEffectType = id_name, featureShardId = shard_name, active-data
+    caps as in RandomEffectDataSet.scala:294-357)."""
+
+    shard_name: str
+    id_name: str
+    optimizer: OptimizerConfig = OptimizerConfig()
+    active_rows_per_entity: Optional[int] = None
+    min_rows_per_entity: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class GameConfig:
+    """Full training configuration (GameParams analog).
+
+    ``coordinates`` is ordered: iteration order IS the updating sequence
+    (GameEstimator.scala updatingSequence). The first evaluator selects the
+    best model (CoordinateDescent.scala:130-137).
+    """
+
+    task: str
+    coordinates: Mapping[str, FixedEffectConfig | RandomEffectConfig]
+    num_iterations: int = 1
+    evaluators: Sequence[str] = ()
+
+    def __post_init__(self):
+        if not self.coordinates:
+            raise ValueError("GameConfig needs at least one coordinate")
+
+
+@dataclasses.dataclass
+class GameFitResult:
+    model: GameModel
+    best_model: GameModel
+    best_metric: Optional[float]
+    history: list
+
+
+class GameEstimator:
+    """Builds datasets + coordinates from a GameConfig and trains via CD."""
+
+    def __init__(self, config: GameConfig):
+        self.config = config
+
+    def _build_coordinates(self, data: GameDataset) -> dict:
+        coords = {}
+        for name, c in self.config.coordinates.items():
+            if isinstance(c, FixedEffectConfig):
+                norm = self._normalization_for(data, c)
+                coords[name] = FixedEffectCoordinate(
+                    name=name,
+                    data=data,
+                    shard_name=c.shard_name,
+                    loss_name=self.config.task,
+                    config=c.optimizer,
+                    seed=c.down_sampling_seed,
+                    normalization=norm,
+                )
+            elif isinstance(c, RandomEffectConfig):
+                red = build_random_effect_dataset(
+                    data,
+                    c.id_name,
+                    c.shard_name,
+                    active_rows_per_entity=c.active_rows_per_entity,
+                    min_rows_per_entity=c.min_rows_per_entity,
+                )
+                coords[name] = RandomEffectCoordinate(
+                    name=name,
+                    data=data,
+                    re_data=red,
+                    loss_name=self.config.task,
+                    config=c.optimizer,
+                )
+            else:
+                raise TypeError(
+                    f"coordinate '{name}': unknown config {type(c).__name__}"
+                )
+        return coords
+
+    @staticmethod
+    def _normalization_for(
+        data: GameDataset, c: FixedEffectConfig
+    ) -> Optional[NormalizationContext]:
+        ntype = NormalizationType(c.normalization)
+        if ntype == NormalizationType.NONE:
+            return None
+        summary = summarize(data.batch_for(c.shard_name))
+        return build_normalization_context(
+            ntype, summary, intercept_index=c.intercept_index
+        )
+
+    def fit(
+        self,
+        data: GameDataset,
+        validation_data: Optional[GameDataset] = None,
+        initial_models: Optional[Mapping[str, object]] = None,
+        output_dir: Optional[str] = None,
+    ) -> GameFitResult:
+        """Train; optionally save final + best models under ``output_dir``.
+
+        Output layout mirrors the reference training driver
+        (cli/game/training/Driver.scala:262-312): ``<output_dir>/final`` and
+        ``<output_dir>/best`` model directories.
+        """
+        coordinates = self._build_coordinates(data)
+        validation = None
+        if validation_data is not None:
+            if not self.config.evaluators:
+                raise ValueError("validation data provided but no evaluators")
+            validation = ValidationSpec(
+                data=validation_data, evaluators=list(self.config.evaluators)
+            )
+        result: CoordinateDescentResult = run_coordinate_descent(
+            coordinates,
+            task=self.config.task,
+            num_iterations=self.config.num_iterations,
+            validation=validation,
+            initial_models=initial_models,
+        )
+        fit = GameFitResult(
+            model=result.model,
+            best_model=result.best_model,
+            best_metric=result.best_metric,
+            history=result.history,
+        )
+        if output_dir is not None:
+            # local import: model_store imports game.models, which would be
+            # circular through game/__init__ at module load time
+            from photon_ml_tpu.data.model_store import save_game_model
+
+            meta = {
+                "config": _config_metadata(self.config),
+                "best_metric": result.best_metric,
+            }
+            save_game_model(
+                result.model, os.path.join(output_dir, "final"),
+                extra_metadata=meta,
+            )
+            save_game_model(
+                result.best_model, os.path.join(output_dir, "best"),
+                extra_metadata=meta,
+            )
+        return fit
+
+
+def _config_metadata(config: GameConfig) -> dict:
+    """JSON-safe description of the training config (model-metadata analog)."""
+
+    def describe(c):
+        out = {"shard_name": c.shard_name}
+        if isinstance(c, RandomEffectConfig):
+            out["type"] = "random_effect"
+            out["id_name"] = c.id_name
+            out["active_rows_per_entity"] = c.active_rows_per_entity
+        else:
+            out["type"] = "fixed_effect"
+            out["normalization"] = str(NormalizationType(c.normalization).value)
+        opt = c.optimizer
+        out["optimizer"] = {
+            "type": str(opt.optimizer_type.value),
+            "max_iterations": opt.max_iterations,
+            "tolerance": opt.tolerance,
+            "regularization": str(opt.regularization.reg_type.value),
+            "regularization_weight": opt.regularization_weight,
+            "down_sampling_rate": opt.down_sampling_rate,
+        }
+        return out
+
+    return {
+        "task": config.task,
+        "num_iterations": config.num_iterations,
+        "evaluators": list(config.evaluators),
+        "coordinates": {n: describe(c) for n, c in config.coordinates.items()},
+    }

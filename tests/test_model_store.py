@@ -1,0 +1,192 @@
+"""Model persistence round-trips: train -> save -> load -> identical scores.
+
+Reference parity: ModelProcessingUtils.scala:72 (save), :137 (load), :516
+(metadata); scoring driver cli/game/scoring/Driver.scala:51-201. The
+fresh-process test proves nothing is captured in interpreter state.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.model_store import (
+    load_game_model,
+    load_game_model_metadata,
+    load_glm,
+    save_game_model,
+    save_glm,
+    score_game_dataset,
+)
+from photon_ml_tpu.game import (
+    FixedEffectCoordinate,
+    GameModel,
+    RandomEffectCoordinate,
+    build_game_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.models.glm import make_model
+from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.optim import (
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+)
+
+_CFG = OptimizerConfig(
+    optimizer_type=OptimizerType.LBFGS,
+    max_iterations=20,
+    tolerance=1e-7,
+    regularization=RegularizationContext(RegularizationType.L2),
+    regularization_weight=1.0,
+)
+
+
+def _game_setup(rng, n=300, n_users=12):
+    Xg = rng.normal(size=(n, 8)) * (rng.random((n, 8)) < 0.5)
+    Xu = rng.normal(size=(n, 5)) * (rng.random((n, 5)) < 0.7)
+    users = rng.integers(0, n_users, size=n)
+    y = (rng.random(n) > 0.5).astype(float)
+    gds = build_game_dataset(
+        response=y,
+        feature_shards={
+            "global": SparseBatch.from_dense(Xg, y),
+            "user": SparseBatch.from_dense(Xu, y),
+        },
+        id_columns={"userId": [f"u{u:03d}" for u in users]},
+    )
+    return gds, users
+
+
+def _train_game_model(gds):
+    fe = FixedEffectCoordinate("fixed", gds, "global", "logistic", _CFG)
+    red = build_random_effect_dataset(gds, "userId", "user")
+    re = RandomEffectCoordinate("per-user", gds, red, "logistic", _CFG)
+    model = GameModel(task="logistic", models={})
+    model = model.with_model("fixed", fe.update_model(fe.initialize_model(), None))
+    model = model.with_model("per-user", re.update_model(re.initialize_model(), None))
+    return model
+
+
+def test_glm_roundtrip(tmp_path, rng):
+    m = make_model(
+        "poisson",
+        means=jnp.asarray(rng.normal(size=9), jnp.float32),
+        variances=jnp.asarray(rng.random(9), jnp.float32),
+    )
+    save_glm(m, str(tmp_path / "glm"))
+    m2 = load_glm(str(tmp_path / "glm"))
+    assert m2.task == "poisson"
+    np.testing.assert_array_equal(
+        np.asarray(m2.coefficients.means), np.asarray(m.coefficients.means))
+    np.testing.assert_array_equal(
+        np.asarray(m2.coefficients.variances),
+        np.asarray(m.coefficients.variances))
+
+
+def test_game_model_roundtrip_scores_identical(tmp_path, rng):
+    gds, _ = _game_setup(rng)
+    model = _train_game_model(gds)
+    s_before = np.asarray(model.score(gds))[: gds.num_rows]
+
+    save_game_model(model, str(tmp_path / "game"),
+                    extra_metadata={"note": "round-trip"})
+    model2 = load_game_model(str(tmp_path / "game"))
+    s_after = np.asarray(model2.score(gds))[: gds.num_rows]
+    np.testing.assert_allclose(s_after, s_before, rtol=1e-6, atol=1e-7)
+
+    meta = load_game_model_metadata(str(tmp_path / "game"))
+    assert meta["task"] == "logistic"
+    assert meta["extra"] == {"note": "round-trip"}
+    assert meta["coordinate_order"] == ["fixed", "per-user"]
+    assert meta["coordinates"]["per-user"]["type"] == "random_effect"
+
+
+def test_score_entry_point_with_unseen_entities(tmp_path, rng):
+    gds, _ = _game_setup(rng)
+    model = _train_game_model(gds)
+    save_game_model(model, str(tmp_path / "game"))
+
+    # scoring dataset with a mix of seen and UNSEEN entities
+    n2 = 100
+    Xg = rng.normal(size=(n2, 8))
+    Xu = rng.normal(size=(n2, 5))
+    ids = [f"u{i:03d}" if i % 2 == 0 else f"new{i}" for i in range(n2)]
+    y2 = np.zeros(n2)
+    gds2 = build_game_dataset(
+        response=y2,
+        feature_shards={
+            "global": SparseBatch.from_dense(Xg, y2),
+            "user": SparseBatch.from_dense(Xu, y2),
+        },
+        id_columns={"userId": ids},
+    )
+    scores = score_game_dataset(str(tmp_path / "game"), gds2)
+    assert scores.shape == (n2,)
+    assert np.all(np.isfinite(scores))
+
+    # unseen entities get ONLY the fixed-effect contribution
+    fe_scores = np.asarray(model.models["fixed"].score(gds2))[:n2]
+    unseen = np.array([not i.startswith("u") for i in ids])
+    np.testing.assert_allclose(
+        scores[unseen], fe_scores[unseen], rtol=1e-6, atol=1e-7)
+    # seen entities differ from FE-only (the RE part contributes)
+    assert not np.allclose(scores[~unseen], fe_scores[~unseen])
+
+
+def test_load_in_fresh_process(tmp_path, rng):
+    gds, _ = _game_setup(rng, n=150, n_users=6)
+    model = _train_game_model(gds)
+    s_before = np.asarray(model.score(gds))[: gds.num_rows]
+    save_game_model(model, str(tmp_path / "game"))
+    np.save(tmp_path / "xg.npy", np.asarray(gds.shard("global").to_dense()))
+    np.save(tmp_path / "xu.npy", np.asarray(gds.shard("user").to_dense()))
+    np.save(tmp_path / "y.npy", gds.response)
+    ids = gds.id_columns["userId"]
+    np.save(tmp_path / "ids.npy", ids.vocab[ids.codes])
+    np.save(tmp_path / "expected.npy", s_before)
+
+    script = f"""
+import numpy as np
+from photon_ml_tpu.data.model_store import score_game_dataset
+from photon_ml_tpu.game import build_game_dataset
+from photon_ml_tpu.ops.sparse import SparseBatch
+d = {str(tmp_path)!r}
+y = np.load(d + "/y.npy")
+n = len(y)
+gds = build_game_dataset(
+    response=y,
+    feature_shards={{
+        "global": SparseBatch.from_dense(np.load(d + "/xg.npy")[:n], y),
+        "user": SparseBatch.from_dense(np.load(d + "/xu.npy")[:n], y),
+    }},
+    id_columns={{"userId": np.load(d + "/ids.npy", allow_pickle=True)}},
+)
+scores = score_game_dataset(d + "/game", gds)
+np.testing.assert_allclose(scores, np.load(d + "/expected.npy"),
+                           rtol=1e-5, atol=1e-6)
+print("FRESH-PROCESS-OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "FRESH-PROCESS-OK" in out.stdout
+
+
+def test_wrong_model_type_errors(tmp_path, rng):
+    m = make_model("logistic", means=jnp.zeros(3))
+    save_glm(m, str(tmp_path / "m"))
+    try:
+        load_game_model(str(tmp_path / "m"))
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "GAME" in str(e)
