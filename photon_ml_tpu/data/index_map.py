@@ -84,9 +84,22 @@ class IndexMap(Mapping[str, int]):
 
     # persistence -----------------------------------------------------------
     def save(self, directory: str) -> None:
-        """Write the mmap-friendly layout: sorted (hash, id) arrays + names."""
+        """Write the mmap-friendly layout: sorted (hash, id) arrays + names.
+
+        Raises on a 64-bit hash collision between two distinct feature keys:
+        MmapIndexMap resolves lookups by hash alone, so a collision in the
+        persisted table would silently return the wrong feature id.
+        """
         os.makedirs(directory, exist_ok=True)
         hashes = np.asarray([_hash64(n) for n in self._names], dtype=np.uint64)
+        if len(hashes) != len(np.unique(hashes)):
+            sorted_h = np.sort(hashes)
+            dup = sorted_h[:-1][sorted_h[:-1] == sorted_h[1:]][0]
+            clashing = [n for n in self._names if np.uint64(_hash64(n)) == dup]
+            raise ValueError(
+                f"64-bit hash collision between feature keys {clashing!r}; "
+                "the mmap store cannot represent this vocabulary"
+            )
         order = np.argsort(hashes)
         np.save(os.path.join(directory, "hashes.npy"), hashes[order])
         np.save(

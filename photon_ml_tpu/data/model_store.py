@@ -47,6 +47,15 @@ def _write_json(path: str, obj) -> None:
     os.replace(tmp, path)
 
 
+def _write_npz(path: str, **arrays) -> None:
+    """Atomic npz write (tmp + rename) so a crash mid-save into an existing
+    model directory can never leave a truncated array file next to valid
+    metadata — every file in a model dir is replaced whole or not at all."""
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+
+
 # ---------------------------------------------------------------------------
 # single GLM (legacy-driver model format)
 # ---------------------------------------------------------------------------
@@ -58,7 +67,7 @@ def save_glm(model: GeneralizedLinearModel, path: str) -> None:
     arrays = {"means": np.asarray(model.coefficients.means, np.float32)}
     if model.coefficients.variances is not None:
         arrays["variances"] = np.asarray(model.coefficients.variances, np.float32)
-    np.savez(os.path.join(path, "coefficients.npz"), **arrays)
+    _write_npz(os.path.join(path, "coefficients.npz"), **arrays)
     _write_json(
         os.path.join(path, _METADATA_FILE),
         {"format_version": _FORMAT_VERSION, "model_type": "glm",
@@ -87,7 +96,7 @@ def load_glm(path: str) -> GeneralizedLinearModel:
 
 def _save_fixed_effect(model: FixedEffectModel, path: str) -> dict:
     os.makedirs(path, exist_ok=True)
-    np.savez(
+    _write_npz(
         os.path.join(path, "coefficients.npz"),
         coefficients=np.asarray(model.coefficients, np.float32),
     )
@@ -117,7 +126,7 @@ def _save_random_effect(model: RandomEffectModel, path: str) -> dict:
         arrays[f"coefficients_{i}"] = np.asarray(bm.coefficients, np.float32)
         arrays[f"projection_{i}"] = np.asarray(bm.projection, np.int32)
         arrays[f"entity_codes_{i}"] = np.asarray(bm.entity_codes, np.int32)
-    np.savez(os.path.join(path, "model.npz"), **arrays)
+    _write_npz(os.path.join(path, "model.npz"), **arrays)
     return {
         "type": "random_effect",
         "shard_name": model.shard_name,

@@ -32,7 +32,22 @@ class NormalizationType(str, Enum):
 @dataclasses.dataclass(frozen=True)
 class NormalizationContext:
     """factors/shifts may be None (identity). ``intercept_index`` is the
-    feature column holding the explicit intercept (factor 1, shift 0)."""
+    feature column holding the explicit intercept.
+
+    INVARIANT (required, guaranteed by build_normalization_context): the
+    intercept column is never normalized — ``factors[intercept_index] == 1``
+    and ``shifts[intercept_index] == 0``. ``inverse_transform_model_
+    coefficients`` is an exact inverse of ``transform_model_coefficients``
+    only under this invariant; a hand-built context violating it silently
+    produces wrong warm starts.
+
+    Regularization semantics (reference parity, L2Regularization.scala):
+    penalties apply to the coefficients the OPTIMIZER sees, i.e. in
+    NORMALIZED space. The original-space optimum is therefore invariant to
+    the normalization choice only when the regularization weight is zero
+    (NormalizationTest.scala:33 tests exactly that); under L2 > 0 each
+    normalization yields a (slightly) different original-space model.
+    """
 
     factors: Optional[Array] = None
     shifts: Optional[Array] = None

@@ -50,13 +50,18 @@ def summarize(batch: SparseBatch) -> FeatureSummary:
     s2 = zeros.at[batch.cols].add(v * v)
     sabs = zeros.at[batch.cols].add(jnp.abs(v))
     nnz = zeros.at[batch.cols].add((v != 0).astype(dtype))
-    # max/min must account for implicit zeros when a feature has any zero entry
+    # max/min must account for implicit zeros when a feature has any zero entry.
+    # Zero-valued entries (including nnz PADDING, whose value is 0 and whose
+    # row may alias a real row when n == n_pad) are excluded from the scatter;
+    # explicit zeros are indistinguishable from implicit ones and are folded
+    # back in via the has_zero correction below (nnz counts v != 0 only).
     big = jnp.asarray(jnp.finfo(dtype).max, dtype)
+    present = (valid_nnz > 0) & (batch.values != 0)
     maxv = jnp.full((d,), -big, dtype).at[batch.cols].max(
-        jnp.where(valid_nnz > 0, batch.values, -big)
+        jnp.where(present, batch.values, -big)
     )
     minv = jnp.full((d,), big, dtype).at[batch.cols].min(
-        jnp.where(valid_nnz > 0, batch.values, big)
+        jnp.where(present, batch.values, big)
     )
     has_zero = nnz < n
     maxv = jnp.where(has_zero, jnp.maximum(maxv, 0.0), maxv)

@@ -122,65 +122,56 @@ EVALUATORS: dict[str, Callable[[Array, Array, Array], Array]] = {
 def sharded_auc(
     scores: Array, labels: Array, weights: Array, group_ids: Array, num_groups: int
 ) -> Array:
-    """Mean per-group AUC over groups that have both classes.
+    """Mean per-group WEIGHTED AUC over groups that have both classes.
 
-    The reference groups scores by an id column and averages a local AUC per
-    group (ShardedAreaUnderROCCurveEvaluator). Here: lexsort by (group,
-    score) and sweep — unweighted pair counting per group (weights act as
-    validity mask), fully on device.
+    The reference groups scores by an id column and averages a weight-aware
+    local AUC per group (ShardedAreaUnderROCCurveEvaluator delegating to
+    AreaUnderROCCurveLocalEvaluator.scala:31-70). Same weighted mid-rank
+    statistic as the global ``auc`` above, computed group-relative in one
+    lexsort + sweep, fully on device. Zero-weight (padding) rows are inert.
     """
-    valid = weights > 0
-    # sort by group then score
     order = jnp.lexsort((scores, group_ids))
     g = group_ids[order]
-    y = (labels[order] > 0.5) & valid[order]
-    v = valid[order]
-    neg = (~y) & v
+    s = scores[order]
+    w = weights[order]
+    pos = (labels[order] > 0.5).astype(scores.dtype) * w
+    neg = (labels[order] <= 0.5).astype(scores.dtype) * w
+    wv = pos + neg
 
-    # within-group cumulative count of negatives (exclusive prefix)
-    neg_f = neg.astype(scores.dtype)
-    cum_all = jnp.cumsum(neg_f)
-    g_start_total = jax.ops.segment_min(
-        cum_all - neg_f, g, num_segments=num_groups, indices_are_sorted=True
+    # group-relative weighted mid-rank: cumulative weight within the group,
+    # averaged with the exclusive prefix
+    cum = jnp.cumsum(wv)
+    g_start = jax.ops.segment_min(
+        cum - wv, g, num_segments=num_groups, indices_are_sorted=True
     )
-    neg_before = cum_all - neg_f - g_start_total[g]  # negatives ranked below
+    g_start = jnp.where(jnp.isfinite(g_start), g_start, 0.0)  # empty groups
+    rank = cum - 0.5 * wv - g_start[g]
 
-    # ties: average over equal (group, score) runs
-    s_sorted = scores[order]
+    # ties: weighted-average the mid-rank over equal (group, score) runs
     new_run = jnp.concatenate(
-        [jnp.ones((1,), bool), (s_sorted[1:] != s_sorted[:-1]) | (g[1:] != g[:-1])]
+        [jnp.ones((1,), bool), (s[1:] != s[:-1]) | (g[1:] != g[:-1])]
     )
     rid = jnp.cumsum(new_run.astype(jnp.int32)) - 1
     n_runs = scores.shape[0]
-    run_cnt = jax.ops.segment_sum(
-        v.astype(scores.dtype), rid, num_segments=n_runs, indices_are_sorted=True
+    r_w = jax.ops.segment_sum(wv, rid, num_segments=n_runs, indices_are_sorted=True)
+    r_rw = jax.ops.segment_sum(
+        rank * wv, rid, num_segments=n_runs, indices_are_sorted=True
     )
-    run_neg = jax.ops.segment_sum(
-        neg_f, rid, num_segments=n_runs, indices_are_sorted=True
-    )
-    run_negbefore_min = jax.ops.segment_min(
-        jnp.where(v, neg_before, jnp.inf), rid, num_segments=n_runs,
-        indices_are_sorted=True,
-    )
-    # all-invalid runs yield inf from segment_min; zero them so the (0-mass)
-    # pair_credit below cannot produce inf * 0 = NaN
-    run_negbefore_min = jnp.where(
-        jnp.isfinite(run_negbefore_min), run_negbefore_min, 0.0
-    )
-    # a positive tied within a run sees (neg_before_run + run_neg/2) pairs won
-    pair_credit = run_negbefore_min[rid] + 0.5 * run_neg[rid]
+    r_mid = r_rw / jnp.maximum(r_w, 1e-30)
+    rank_tied = r_mid[rid]
 
-    pos_f = (y & v).astype(scores.dtype)
-    won = jax.ops.segment_sum(
-        pair_credit * pos_f, g, num_segments=num_groups, indices_are_sorted=True
+    w_pos = jax.ops.segment_sum(pos, g, num_segments=num_groups,
+                                indices_are_sorted=True)
+    w_neg = jax.ops.segment_sum(neg, g, num_segments=num_groups,
+                                indices_are_sorted=True)
+    sum_pos_rank = jax.ops.segment_sum(
+        rank_tied * pos, g, num_segments=num_groups, indices_are_sorted=True
     )
-    n_pos = jax.ops.segment_sum(pos_f, g, num_segments=num_groups,
-                                indices_are_sorted=True)
-    n_neg = jax.ops.segment_sum(neg_f, g, num_segments=num_groups,
-                                indices_are_sorted=True)
-    pairs = n_pos * n_neg
+    # U statistic per group (see auc above)
+    u = sum_pos_rank - 0.5 * w_pos * w_pos
+    pairs = w_pos * w_neg
     has_both = pairs > 0
-    per_group = jnp.where(has_both, won / jnp.maximum(pairs, 1e-30), 0.0)
+    per_group = jnp.where(has_both, u / jnp.maximum(pairs, 1e-30), 0.0)
     n_scored = jnp.sum(has_both.astype(scores.dtype))
     return jnp.sum(per_group) / jnp.maximum(n_scored, 1.0)
 

@@ -81,8 +81,11 @@ class FixedEffectCoordinate:
 
     def __post_init__(self):
         self.config.validate(self.loss_name)
-        base = self.data.batch_for(self.shard_name)
-        self._batch = self._maybe_downsample(base)
+        self._base_batch = self.data.batch_for(self.shard_name)
+        # fresh sample per update_model (runWithSampling parity: the reference
+        # re-samples on every coordinate update, DistributedOptimizationProblem
+        # .scala:113-125); counter salts the rng so updates differ
+        self._update_count = 0
         key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
         self._solver = _fe_solver(key_cfg, self.loss_name)
         norm = self.normalization
@@ -98,11 +101,11 @@ class FixedEffectCoordinate:
             self.config.regularization.l1_weight(self.config.regularization_weight)
         )
 
-    def _maybe_downsample(self, batch):
+    def _maybe_downsample(self, batch, update_index: int):
         rate = self.config.down_sampling_rate
         if rate >= 1.0:
             return batch
-        rng = np.random.default_rng(self.seed)
+        rng = np.random.default_rng((self.seed, update_index))
         labels = np.asarray(batch.labels)
         weights = np.asarray(batch.weights).copy()
         if "logistic" in self.loss_name or "hinge" in self.loss_name:
@@ -118,16 +121,17 @@ class FixedEffectCoordinate:
         return dataclasses.replace(batch, weights=jnp.asarray(weights, batch.dtype))
 
     def initialize_model(self) -> FixedEffectModel:
-        d = self._batch.num_features
+        d = self._base_batch.num_features
         return FixedEffectModel(
-            coefficients=jnp.zeros((d,), self._batch.dtype),
+            coefficients=jnp.zeros((d,), self._base_batch.dtype),
             shard_name=self.shard_name,
         )
 
     def update_model(
         self, model: FixedEffectModel, residual_scores: Optional[Array]
     ) -> FixedEffectModel:
-        batch = self._batch
+        batch = self._maybe_downsample(self._base_batch, self._update_count)
+        self._update_count += 1
         if residual_scores is not None:
             batch = batch.with_offsets(batch.offsets + residual_scores)
         w0 = model.coefficients

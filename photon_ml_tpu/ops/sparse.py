@@ -40,6 +40,24 @@ def _pad(a: np.ndarray, total: int, fill=0) -> np.ndarray:
     return out
 
 
+def validate_coo_indices(
+    rows: np.ndarray, cols: np.ndarray, num_rows: int, num_features: int
+) -> None:
+    """Reject out-of-range COO indices: silent out-of-range cols would be
+    dropped by the clamped device gathers and corrupt the scatter adds.
+    Shared by SparseBatch.from_coo and TiledBatch.from_coo."""
+    if len(cols) and (cols.min() < 0 or cols.max() >= num_features):
+        raise ValueError(
+            f"feature indices must be in [0, {num_features}); got "
+            f"[{cols.min()}, {cols.max()}]"
+        )
+    if len(rows) and (rows.min() < 0 or rows.max() >= num_rows):
+        raise ValueError(
+            f"row indices must be in [0, {num_rows}); got "
+            f"[{rows.min()}, {rows.max()}]"
+        )
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class SparseBatch:
@@ -87,12 +105,20 @@ class SparseBatch:
         row_pad_multiple: int = 1,
         nnz_pad_multiple: int = 1,
     ) -> "SparseBatch":
-        """Build a batch from host COO arrays, sorting by row and padding."""
+        """Build a batch from host COO arrays, sorting by row and padding.
+
+        Raises on out-of-range row/col indices — a silent out-of-range col
+        would be dropped by the clamped device gathers and corrupt the
+        scatter adds (TiledBatch.from_coo validates identically).
+        """
         n = int(len(labels))
+        rows = np.asarray(rows)
+        cols = np.asarray(cols)
+        validate_coo_indices(rows, cols, n, num_features)
         order = np.argsort(rows, kind="stable")
         values = np.asarray(values)[order]
-        rows = np.asarray(rows)[order]
-        cols = np.asarray(cols)[order]
+        rows = rows[order]
+        cols = cols[order]
 
         n_pad = _round_up(n, row_pad_multiple)
         nnz = int(len(values))

@@ -47,7 +47,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from photon_ml_tpu.ops.losses import get_loss
-from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.ops.sparse import SparseBatch, validate_coo_indices
 
 Array = jax.Array
 
@@ -351,10 +351,7 @@ class TiledBatch:
         rows = np.asarray(rows, np.int64)
         cols = np.asarray(cols, np.int64)
         values = np.asarray(values, np.float64)
-        if len(values) and (int(cols.max()) >= num_features or int(cols.min()) < 0):
-            raise ValueError("column index out of range")
-        if len(values) and (int(rows.max()) >= n or int(rows.min()) < 0):
-            raise ValueError("row index out of range")
+        validate_coo_indices(rows, cols, n, num_features)
 
         tile = rows // R
         order = np.argsort(tile, kind="stable")

@@ -171,3 +171,45 @@ def test_validators(rng):
 
     # disabled mode swallows everything
     validate(bad_label, "logistic_regression", mode=ValidationMode.DISABLED)
+
+
+def test_summary_maxmin_unaffected_by_nnz_padding():
+    """Regression (ADVICE r1-a): when n == n_pad, padding nnz entries alias
+    the real last row; their value-0 must not leak into feature 0's max/min."""
+    vals = np.array([-2.0, -3.0, -1.0])
+    rows = np.array([0, 1, 2])
+    cols = np.array([0, 0, 0])
+    b = SparseBatch.from_coo(
+        vals, rows, cols, np.zeros(3), num_features=2, nnz_pad_multiple=16
+    )
+    s = summarize(b)
+    assert float(s.max[0]) == -1.0
+    assert float(s.min[0]) == -3.0
+    # feature 1 is all implicit zeros
+    assert float(s.max[1]) == 0.0 and float(s.min[1]) == 0.0
+
+
+def test_from_coo_rejects_out_of_range_indices():
+    """Regression (ADVICE r1-b): out-of-range col/row indices must raise,
+    not be silently dropped by clamped gathers."""
+    with pytest.raises(ValueError):
+        SparseBatch.from_coo(
+            np.ones(2), np.array([0, 1]), np.array([0, 5]),
+            np.zeros(2), num_features=3,
+        )
+    with pytest.raises(ValueError):
+        SparseBatch.from_coo(
+            np.ones(2), np.array([0, 7]), np.array([0, 1]),
+            np.zeros(2), num_features=3,
+        )
+
+
+def test_index_map_save_detects_hash_collision(tmp_path, monkeypatch):
+    """Regression (ADVICE r1-c): a 64-bit hash collision between two keys
+    must fail save() loudly — the mmap store resolves by hash alone."""
+    from photon_ml_tpu.data import index_map as im_mod
+
+    m = IndexMap(["featA", "featB"])
+    monkeypatch.setattr(im_mod, "_hash64", lambda key: 42)
+    with pytest.raises(ValueError, match="collision"):
+        m.save(str(tmp_path / "idx"))

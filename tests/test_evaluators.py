@@ -126,3 +126,45 @@ def test_parse_and_direction():
     assert better_than("auc", 0.9, 0.8)
     assert better_than("rmse", 0.1, 0.2)
     assert better_than("precision@5:q", 0.9, 0.2)
+
+def test_sharded_auc_weighted_matches_naive(rng):
+    """Regression (VERDICT r2 weak #5): sharded AUC must be weight-aware —
+    mean of per-group WEIGHTED AUCs, matching the naive pair count."""
+    G, per = 4, 25
+    scores, labels, weights, gids = [], [], [], []
+    for g in range(G):
+        scores.append(np.round(rng.normal(size=per), 1))  # induce ties
+        labels.append((rng.random(per) > 0.5).astype(float))
+        weights.append(rng.random(per) + 0.1)
+        gids.append(np.full(per, g))
+    scores, labels, weights, gids = map(
+        np.concatenate, (scores, labels, weights, gids))
+    per_group = [
+        _naive_weighted_auc(scores[gids == g], labels[gids == g], weights[gids == g])
+        for g in range(G)
+        if len(np.unique(labels[gids == g])) == 2
+    ]
+    ours = float(
+        sharded_auc(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights),
+            jnp.asarray(gids, jnp.int32), num_groups=G,
+        )
+    )
+    assert np.isclose(ours, np.mean(per_group), atol=1e-5)
+
+
+def test_sharded_auc_zero_weight_rows_inert(rng):
+    scores = rng.normal(size=40)
+    labels = (rng.random(40) > 0.5).astype(float)
+    gids = np.repeat([0, 1], 20)
+    base = float(sharded_auc(
+        jnp.asarray(scores), jnp.asarray(labels), jnp.ones(40),
+        jnp.asarray(gids, jnp.int32), num_groups=2))
+    s2 = np.concatenate([scores, rng.normal(size=6)])
+    l2 = np.concatenate([labels, np.ones(6)])
+    w2 = np.concatenate([np.ones(40), np.zeros(6)])
+    g2 = np.concatenate([gids, np.repeat([0, 1], 3)])
+    padded = float(sharded_auc(
+        jnp.asarray(s2), jnp.asarray(l2), jnp.asarray(w2),
+        jnp.asarray(g2, jnp.int32), num_groups=2))
+    assert np.isclose(base, padded, atol=1e-6)

@@ -217,3 +217,36 @@ def test_unseen_entity_scores_zero(rng):
     )
     s = np.asarray(model.score(gds2))
     np.testing.assert_allclose(s[:50], 0.0, atol=1e-6)
+
+
+def test_fe_down_sampling_resamples_per_update(rng):
+    """Regression (ADVICE r1-d): the FE coordinate must draw a FRESH negative
+    down-sample on every update_model call (runWithSampling parity), not
+    freeze one sample at construction."""
+    from photon_ml_tpu.game.coordinates import FixedEffectCoordinate
+    from photon_ml_tpu.optim import OptimizerConfig
+
+    n = 200
+    X = rng.normal(size=(n, 5))
+    y = (rng.random(n) > 0.7).astype(float)
+    gds = build_game_dataset(
+        response=y, feature_shards={"g": SparseBatch.from_dense(X, y)})
+    coord = FixedEffectCoordinate(
+        name="fe", data=gds, shard_name="g", loss_name="logistic",
+        config=OptimizerConfig(max_iterations=3, down_sampling_rate=0.5),
+    )
+    b0 = coord._maybe_downsample(coord._base_batch, 0)
+    b1 = coord._maybe_downsample(coord._base_batch, 1)
+    w0 = np.asarray(b0.weights)
+    w1 = np.asarray(b1.weights)
+    assert not np.array_equal(w0, w1)  # different draws
+    # positives always kept at weight 1; kept negatives reweighted by 1/rate
+    pos = np.asarray(coord._base_batch.labels) > 0.5
+    real = np.asarray(coord._base_batch.weights) > 0
+    np.testing.assert_allclose(w0[pos & real], 1.0)
+    kept_neg = (~pos) & real & (w0 > 0)
+    np.testing.assert_allclose(w0[kept_neg], 2.0)
+    # update_model advances the sample index
+    m = coord.initialize_model()
+    m = coord.update_model(m, None)
+    assert coord._update_count == 1
