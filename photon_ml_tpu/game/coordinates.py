@@ -24,6 +24,7 @@ from typing import Optional, Protocol
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.models import (
@@ -34,8 +35,11 @@ from photon_ml_tpu.game.models import (
 from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.game.random_effect_data import EntityBucket, RandomEffectDataset
 from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.ops.sparse import SparseBatch
 from photon_ml_tpu.optim.adapter import glm_adapter
 from photon_ml_tpu.optim.factory import OptimizerConfig, dispatch_solve
+from photon_ml_tpu.parallel.distributed import distributed_solve
+from photon_ml_tpu.parallel.mesh import put_sharded, shard_rows
 
 Array = jax.Array
 
@@ -78,6 +82,7 @@ class FixedEffectCoordinate:
     config: OptimizerConfig
     seed: int = 0
     normalization: Optional["NormalizationContext"] = None
+    mesh: Optional[Mesh] = None  # 1-D data-axis mesh -> distributed_solve
 
     def __post_init__(self):
         self.config.validate(self.loss_name)
@@ -100,11 +105,23 @@ class FixedEffectCoordinate:
         self._l1 = jnp.float32(
             self.config.regularization.l1_weight(self.config.regularization_weight)
         )
+        if self.mesh is not None:
+            # pre-shard the static COO structure once; per-update offsets and
+            # weights are re-stacked on device (_restack) so residual updates
+            # and fresh down-samples never rebuild the nnz arrays
+            self._axis = self.mesh.axis_names[0]
+            self._n_shards = int(self.mesh.devices.size)
+            self._stacked = put_sharded(
+                shard_rows(self._base_batch, self._n_shards),
+                self.mesh,
+                self._axis,
+            )
+            self._rows_per = int(self._stacked.labels.shape[1])
 
-    def _maybe_downsample(self, batch, update_index: int):
+    def _downsampled_weights(self, batch, update_index: int):
         rate = self.config.down_sampling_rate
         if rate >= 1.0:
-            return batch
+            return batch.weights
         rng = np.random.default_rng((self.seed, update_index))
         labels = np.asarray(batch.labels)
         weights = np.asarray(batch.weights).copy()
@@ -118,7 +135,24 @@ class FixedEffectCoordinate:
             keep = rng.random(len(labels)) < rate
             weights[~keep] = 0.0
             weights[keep] /= rate
-        return dataclasses.replace(batch, weights=jnp.asarray(weights, batch.dtype))
+        return jnp.asarray(weights, batch.dtype)
+
+    def _maybe_downsample(self, batch, update_index: int):
+        if self.config.down_sampling_rate >= 1.0:
+            return batch
+        return dataclasses.replace(
+            batch, weights=self._downsampled_weights(batch, update_index)
+        )
+
+    def _restack(self, per_row: Array) -> Array:
+        """Reshape a global [n_pad] per-row array into the contiguous
+        [num_shards, rows_per] block layout of shard_rows and place it on
+        the mesh."""
+        total = self._n_shards * self._rows_per
+        a = jnp.asarray(per_row, self._base_batch.dtype)
+        a = jnp.pad(a, (0, total - a.shape[0]))
+        a = a.reshape(self._n_shards, self._rows_per)
+        return jax.device_put(a, NamedSharding(self.mesh, P(self._axis)))
 
     def initialize_model(self) -> FixedEffectModel:
         d = self._base_batch.num_features
@@ -130,19 +164,51 @@ class FixedEffectCoordinate:
     def update_model(
         self, model: FixedEffectModel, residual_scores: Optional[Array]
     ) -> FixedEffectModel:
-        batch = self._maybe_downsample(self._base_batch, self._update_count)
-        self._update_count += 1
-        if residual_scores is not None:
-            batch = batch.with_offsets(batch.offsets + residual_scores)
         w0 = model.coefficients
-        if self.normalization is not None:
+        norm = self.normalization
+        if norm is not None:
             # models live in ORIGINAL space; the solve runs in normalized
             # space (createModel analog, GeneralizedLinearOptimizationProblem)
-            w0 = self.normalization.inverse_transform_model_coefficients(w0)
-        res = self._solver(self._obj, batch, w0, self._l1)
+            w0 = norm.inverse_transform_model_coefficients(w0)
+        update_index = self._update_count
+        self._update_count += 1
+        if self.mesh is not None:
+            # DP path (FixedEffectCoordinate.scala:136-147): rows sharded
+            # over the mesh, whole while-loop inside shard_map, grads psum'd.
+            # Only changed per-row arrays are re-stacked onto the mesh.
+            stacked = self._stacked
+            if residual_scores is not None:
+                stacked = dataclasses.replace(
+                    stacked,
+                    offsets=self._restack(
+                        self._base_batch.offsets + residual_scores
+                    ),
+                )
+            if self.config.down_sampling_rate < 1.0:
+                stacked = dataclasses.replace(
+                    stacked,
+                    weights=self._restack(
+                        self._downsampled_weights(self._base_batch, update_index)
+                    ),
+                )
+            res = distributed_solve(
+                self.loss_name,
+                stacked,
+                self.config,
+                w0,
+                self.mesh,
+                axis=self._axis,
+                factors=None if norm is None else norm.factors,
+                shifts=None if norm is None else norm.shifts,
+            )
+        else:
+            batch = self._maybe_downsample(self._base_batch, update_index)
+            if residual_scores is not None:
+                batch = batch.with_offsets(batch.offsets + residual_scores)
+            res = self._solver(self._obj, batch, w0, self._l1)
         w = res.w
-        if self.normalization is not None:
-            w = self.normalization.transform_model_coefficients(w)
+        if norm is not None:
+            w = norm.transform_model_coefficients(w)
         return dataclasses.replace(model, coefficients=w)
 
     def score(self, model: FixedEffectModel) -> Array:
@@ -160,6 +226,53 @@ def _re_solver(config: OptimizerConfig, loss_name: str):
 
     # obj, l1 broadcast; batch leaves and w0 map over the entity axis
     return jax.jit(jax.vmap(solve_one, in_axes=(None, 0, 0, None)))
+
+
+@lru_cache(maxsize=64)
+def _re_solver_sharded(config: OptimizerConfig, loss_name: str, mesh: Mesh, axis: str):
+    """Entity-sharded bucket solver: explicit shard_map over ``axis`` — each
+    device runs the vmapped while-loop solve on its local entity block with
+    NO collectives (per-entity problems are independent; the EP-like strategy
+    of SURVEY.md §2.f / RandomEffectCoordinate.scala:101-130)."""
+
+    def solve_one(obj, batch, w0, l1):
+        return dispatch_solve(glm_adapter(obj, batch), w0, config, l1)
+
+    def local(obj, bucket_batch, w0, l1):
+        return jax.vmap(solve_one, in_axes=(None, 0, 0, None))(
+            obj, bucket_batch, w0, l1
+        )
+
+    def wrapped(obj, bucket_batch, w0, l1):
+        rep = lambda t: jax.tree.map(lambda _: P(), t)
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(
+                rep(obj),
+                jax.tree.map(lambda _: P(axis), bucket_batch),
+                P(axis),
+                P(),
+            ),
+            out_specs=P(axis),
+            check_vma=False,
+        )(obj, bucket_batch, w0, l1)
+
+    return jax.jit(wrapped)
+
+
+def _pad_entities(batch: SparseBatch, w0: Array, total: int):
+    """Pad the leading entity axis to ``total`` with all-zero problems
+    (weight 0 everywhere -> the padded solves converge immediately)."""
+    n = w0.shape[0]
+    if total == n:
+        return batch, w0
+
+    def padf(x):
+        pad = jnp.zeros((total - n,) + x.shape[1:], x.dtype)
+        return jnp.concatenate([x, pad], axis=0)
+
+    return jax.tree.map(padf, batch), padf(w0)
 
 
 @lru_cache(maxsize=64)
@@ -187,10 +300,15 @@ class RandomEffectCoordinate:
     re_data: RandomEffectDataset
     loss_name: str
     config: OptimizerConfig
+    mesh: Optional[Mesh] = None  # 1-D entity-axis mesh -> shard_map solve
 
     def __post_init__(self):
         self.config.validate(self.loss_name)
         key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
+        if self.mesh is not None:
+            self._sharded_solver = _re_solver_sharded(
+                key_cfg, self.loss_name, self.mesh, self.mesh.axis_names[0]
+            )
         self._solver = _re_solver(key_cfg, self.loss_name)
         self._scorer = _re_scorer()
         self._obj = make_objective(
@@ -227,14 +345,23 @@ class RandomEffectCoordinate:
         self, model: RandomEffectModel, residual_scores: Optional[Array]
     ) -> RandomEffectModel:
         new_buckets = []
+        n_dev = 0 if self.mesh is None else int(self.mesh.devices.size)
         for b, bm in zip(self.re_data.buckets, model.buckets):
             bucket = (
                 b if residual_scores is None else b.with_extra_offsets(residual_scores)
             )
-            res = self._solver(
-                self._obj, bucket.entity_batch(), bm.coefficients, self._l1
-            )
-            new_buckets.append(dataclasses.replace(bm, coefficients=res.w))
+            bb = bucket.entity_batch()
+            w0 = bm.coefficients
+            if self.mesh is None:
+                res = self._solver(self._obj, bb, w0, self._l1)
+                w = res.w
+            else:
+                num_e = w0.shape[0]
+                total = -(-num_e // n_dev) * n_dev
+                bb_p, w0_p = _pad_entities(bb, w0, total)
+                res = self._sharded_solver(self._obj, bb_p, w0_p, self._l1)
+                w = res.w[:num_e]
+            new_buckets.append(dataclasses.replace(bm, coefficients=w))
         return dataclasses.replace(model, buckets=tuple(new_buckets))
 
     def score(self, model: RandomEffectModel) -> Array:

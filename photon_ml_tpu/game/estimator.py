@@ -16,6 +16,8 @@ import dataclasses
 import os
 from typing import Mapping, Optional, Sequence
 
+from jax.sharding import Mesh
+
 from photon_ml_tpu.data.normalization import (
     NormalizationContext,
     NormalizationType,
@@ -35,6 +37,7 @@ from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.game.random_effect_data import build_random_effect_dataset
 from photon_ml_tpu.optim.factory import OptimizerConfig
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, ENTITY_AXIS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,7 +98,15 @@ class GameEstimator:
     def __init__(self, config: GameConfig):
         self.config = config
 
-    def _build_coordinates(self, data: GameDataset) -> dict:
+    def _build_coordinates(self, data: GameDataset, mesh: Optional[Mesh]) -> dict:
+        # One physical mesh, two logical 1-D views over the same devices:
+        # FE rows shard over the 'data' axis, RE entity batches over the
+        # 'entity' axis (SURVEY.md §2.f). Views are free — no data movement.
+        data_mesh = entity_mesh = None
+        if mesh is not None:
+            devices = mesh.devices.reshape(-1)
+            data_mesh = Mesh(devices, (DATA_AXIS,))
+            entity_mesh = Mesh(devices, (ENTITY_AXIS,))
         coords = {}
         for name, c in self.config.coordinates.items():
             if isinstance(c, FixedEffectConfig):
@@ -108,6 +119,7 @@ class GameEstimator:
                     config=c.optimizer,
                     seed=c.down_sampling_seed,
                     normalization=norm,
+                    mesh=data_mesh,
                 )
             elif isinstance(c, RandomEffectConfig):
                 red = build_random_effect_dataset(
@@ -123,6 +135,7 @@ class GameEstimator:
                     re_data=red,
                     loss_name=self.config.task,
                     config=c.optimizer,
+                    mesh=entity_mesh,
                 )
             else:
                 raise TypeError(
@@ -148,14 +161,21 @@ class GameEstimator:
         validation_data: Optional[GameDataset] = None,
         initial_models: Optional[Mapping[str, object]] = None,
         output_dir: Optional[str] = None,
+        mesh: Optional[Mesh] = None,
     ) -> GameFitResult:
         """Train; optionally save final + best models under ``output_dir``.
+
+        With ``mesh`` (any device mesh; its flattened device list is used),
+        fixed-effect solves shard examples over the devices (DP via
+        distributed_solve) and random-effect bucket solves shard the entity
+        axis (shard_map, no cross-entity comms) — the GAME analog of the
+        reference's cluster mode. Results match the single-device fit.
 
         Output layout mirrors the reference training driver
         (cli/game/training/Driver.scala:262-312): ``<output_dir>/final`` and
         ``<output_dir>/best`` model directories.
         """
-        coordinates = self._build_coordinates(data)
+        coordinates = self._build_coordinates(data, mesh)
         validation = None
         if validation_data is not None:
             if not self.config.evaluators:

@@ -153,11 +153,11 @@ def test_from_batch_roundtrip(rng):
 
 
 def test_bounds_validation():
-    with pytest.raises(ValueError, match="column index out of range"):
+    with pytest.raises(ValueError, match="feature indices"):
         TiledBatch.from_coo(
             values=np.ones(2), rows=np.array([0, 1]), cols=np.array([0, 9]),
             labels=np.zeros(2), num_features=5)
-    with pytest.raises(ValueError, match="row index out of range"):
+    with pytest.raises(ValueError, match="row indices"):
         TiledBatch.from_coo(
             values=np.ones(2), rows=np.array([0, 7]), cols=np.array([0, 1]),
             labels=np.zeros(2), num_features=5)
