@@ -50,6 +50,9 @@ class FixedEffectConfig:
     normalization: NormalizationType | str = NormalizationType.NONE
     intercept_index: Optional[int] = None
     down_sampling_seed: int = 0
+    # training layout: "auto" picks the tiled one-hot-matmul pallas fast
+    # path on TPU and padded-COO elsewhere; "tiled"/"coo" force it
+    layout: str = "auto"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,6 +123,7 @@ class GameEstimator:
                     seed=c.down_sampling_seed,
                     normalization=norm,
                     mesh=data_mesh,
+                    layout=c.layout,
                 )
             elif isinstance(c, RandomEffectConfig):
                 red = build_random_effect_dataset(

@@ -121,7 +121,14 @@ def build_random_effect_dataset(
     seed: int = 0,
     dtype=jnp.float32,
 ) -> RandomEffectDataset:
-    """Group, cap, project, and bucket one random-effect coordinate's data."""
+    """Group, cap, project, and bucket one random-effect coordinate's data.
+
+    Fully vectorized host build: sorting/searchsorted/bincount over bulk
+    arrays with one small Python loop over geometry CLASSES (tens), never
+    over entities — the ingest-rate answer to the reference's cluster-side
+    groupByKey (RandomEffectDataSetPartitioner.scala:96-148). Builds 100K
+    entities / 1M rows in seconds (tests/test_re_build.py measures).
+    """
     if id_name not in data.id_columns:
         raise KeyError(f"unknown id column '{id_name}'; have {sorted(data.id_columns)}")
     idc = data.id_columns[id_name]
@@ -133,78 +140,117 @@ def build_random_effect_dataset(
     vals = np.asarray(batch.values)
     rows = np.asarray(batch.rows)
     cols = np.asarray(batch.cols)
-    # valid nnz only (value != 0 excludes padding)
-    live = vals != 0
+    # valid nnz only (value != 0 excludes padding); drop padded-row nnz
+    live = (vals != 0) & (rows < n)
     vals, rows, cols = vals[live], rows[live], cols[live]
-    # keep only nnz of real (non-padded) example rows
-    in_range = rows < n
-    vals, rows, cols = vals[in_range], rows[in_range], cols[in_range]
 
-    # --- group example rows by entity ---
-    codes = idc.codes  # [n]
-    order = np.argsort(codes, kind="stable")
-    sorted_codes = codes[order]
-    uniq_codes, starts = np.unique(sorted_codes, return_index=True)
-    ends = np.append(starts[1:], n)
+    codes = np.asarray(idc.codes)  # [n]
 
+    # --- active/passive row selection (vectorized reservoir cap) ---
+    # group rows by entity with a random within-group order: rank < cap keeps
+    # a uniform sample per entity (the reservoir-with-rescale semantics of
+    # RandomEffectDataSet.scala:294-357)
+    rand_key = rng.random(n)
+    grp_order = np.lexsort((rand_key, codes))  # entity-grouped, random within
+    g_codes = codes[grp_order]
+    uniq_codes, grp_starts, grp_counts = np.unique(
+        g_codes, return_index=True, return_counts=True
+    )
+    ent_of_pos = np.searchsorted(uniq_codes, g_codes)
+    rank_in_ent = np.arange(n) - grp_starts[ent_of_pos]
+
+    counts_of_pos = grp_counts[ent_of_pos]
+    active_pos = counts_of_pos >= min_rows_per_entity
     weights = data.weight.copy()
-    active_sel_per_entity: dict[int, np.ndarray] = {}
-    passive: list[np.ndarray] = []
-    for code, s, e in zip(uniq_codes, starts, ends):
-        members = order[s:e]
-        if len(members) < min_rows_per_entity:
-            passive.append(members)
-            continue
-        cap = active_rows_per_entity
-        if cap is not None and len(members) > cap:
-            keep = rng.choice(members, size=cap, replace=False)
-            keep_set = np.zeros(n, bool)
-            keep_set[keep] = True
-            dropped = members[~keep_set[members]]
-            passive.append(dropped)
-            # weight rescale so the capped sample represents the full count
-            # (RandomEffectDataSet.scala:294-357)
-            weights[keep] *= len(members) / cap
-            members = np.sort(keep)
-        active_sel_per_entity[int(code)] = members
+    cap = active_rows_per_entity
+    if cap is not None:
+        capped = counts_of_pos > cap
+        active_pos &= ~capped | (rank_in_ent < cap)
+        # weight rescale so the capped sample represents the full count
+        resc = capped & (rank_in_ent < cap)
+        weights[grp_order[resc]] *= counts_of_pos[resc] / cap
+    act_rows_unsorted = grp_order[active_pos]
+    passive_rows = np.sort(grp_order[~active_pos])
 
-    # --- per-entity projection + geometry ---
-    nnz_by_row_order = np.argsort(rows, kind="stable")
-    r_sorted = rows[nnz_by_row_order]
-    row_nnz_starts = np.searchsorted(r_sorted, np.arange(n))
-    row_nnz_ends = np.searchsorted(r_sorted, np.arange(n) + 1)
+    # --- regroup active rows sorted by (entity, row id) ---
+    act_codes_u = codes[act_rows_unsorted]
+    o = np.lexsort((act_rows_unsorted, act_codes_u))
+    act_rows = act_rows_unsorted[o]  # member rows, entity-major, row-sorted
+    act_codes = act_codes_u[o]
+    act_uniq, act_starts, act_counts = np.unique(
+        act_codes, return_index=True, return_counts=True
+    )
+    n_act = len(act_rows)
+    n_ent = len(act_uniq)
+    ent_of_row = np.searchsorted(act_uniq, act_codes)  # [n_act]
+    local_row = np.arange(n_act) - act_starts[ent_of_row]
 
-    entities = []
-    for code, members in active_sel_per_entity.items():
-        nnz_idx = np.concatenate(
-            [nnz_by_row_order[row_nnz_starts[m]: row_nnz_ends[m]] for m in members]
-        ) if len(members) else np.zeros(0, np.int64)
-        g_cols = cols[nnz_idx]
-        proj = np.unique(g_cols)  # sorted global ids observed by this entity
-        entities.append(
-            dict(
-                code=code,
-                members=members,
-                nnz_idx=nnz_idx,
-                proj=proj,
-                R=_next_pow2(len(members)),
-                K=_next_pow2(max(len(proj), 1)),
-                NZ=_next_pow2(max(len(nnz_idx), 1)),
-            )
-        )
+    # per global row: its local row id and entity index (-1 if inactive)
+    row_local = np.full(n, -1, np.int64)
+    row_local[act_rows] = local_row
+    row_ent = np.full(n, -1, np.int64)
+    row_ent[act_rows] = ent_of_row
 
-    # --- bucket by geometry class ---
-    by_class: dict[tuple[int, int, int], list[dict]] = {}
-    for ent in entities:
-        by_class.setdefault((ent["R"], ent["K"], ent["NZ"]), []).append(ent)
+    # --- nnz of active rows, sorted by (entity, local row) ---
+    keep_nnz = row_ent[rows] >= 0
+    nv, nr, nc = vals[keep_nnz], rows[keep_nnz], cols[keep_nnz]
+    ne = row_ent[nr]
+    nlr = row_local[nr]
+    o2 = np.lexsort((nlr, ne))  # segment_sum contract: rows sorted per entity
+    nv, nc, ne, nlr = nv[o2], nc[o2], ne[o2], nlr[o2]
+    nnz_counts = np.bincount(ne, minlength=n_ent).astype(np.int64)
+    nnz_starts = np.concatenate([[0], np.cumsum(nnz_counts)[:-1]])
+    slot = np.arange(len(nv)) - nnz_starts[ne]
 
-    buckets = []
+    # --- per-entity projection: unique observed global cols ---
+    pair_key = ne * np.int64(num_global) + nc
+    uniq_pairs = np.unique(pair_key)
+    proj_ent = uniq_pairs // num_global
+    proj_col = (uniq_pairs % num_global).astype(np.int64)
+    proj_counts = np.bincount(proj_ent, minlength=n_ent).astype(np.int64)
+    proj_starts = np.concatenate([[0], np.cumsum(proj_counts)[:-1]])
+    proj_slot = np.arange(len(uniq_pairs)) - proj_starts[proj_ent]
+    # local col id of each nnz = rank of its col in its entity's projection
+    local_col = np.searchsorted(uniq_pairs, pair_key) - nnz_starts_like(
+        proj_starts, ne
+    )
+
+    # --- geometry classes ---
+    Rs = _next_pow2_arr(act_counts)
+    Ks = _next_pow2_arr(np.maximum(proj_counts, 1))
+    NZs = _next_pow2_arr(np.maximum(nnz_counts, 1))
+    geom = np.stack([Rs, Ks, NZs], axis=1)
+    classes, class_of_ent = np.unique(geom, axis=0, return_inverse=True)
+    # sort classes lexicographically by (R, K, NZ) to keep bucket order
+    class_order = np.lexsort((classes[:, 2], classes[:, 1], classes[:, 0]))
+    class_rank = np.empty(len(classes), np.int64)
+    class_rank[class_order] = np.arange(len(classes))
+    class_of_ent = class_rank[class_of_ent]
+    classes = classes[class_order]
+
+    # position of each entity within its bucket (order of appearance =
+    # ascending entity code, since act_uniq is sorted)
+    ent_pos = np.zeros(n_ent, np.int64)
+    for b_idx in range(len(classes)):
+        sel = class_of_ent == b_idx
+        ent_pos[sel] = np.arange(int(sel.sum()))
+
     num_entities = idc.num_entities
     entity_bucket = np.full(num_entities, -1, np.int32)
     entity_pos = np.full(num_entities, -1, np.int32)
+    entity_bucket[act_uniq] = class_of_ent
+    entity_pos[act_uniq] = ent_pos
 
-    for b_idx, ((R, K, NZ), ents) in enumerate(sorted(by_class.items())):
-        E = len(ents)
+    response = data.response
+    offset = data.offset
+
+    buckets = []
+    for b_idx, (R, K, NZ) in enumerate(classes):
+        R, K, NZ = int(R), int(K), int(NZ)
+        esel = class_of_ent == b_idx
+        E = int(esel.sum())
+        bcode = act_uniq[esel].astype(np.int32)
+
         bv = np.zeros((E, NZ))
         br = np.full((E, NZ), R - 1, np.int32)
         bc = np.zeros((E, NZ), np.int32)
@@ -212,27 +258,32 @@ def build_random_effect_dataset(
         bo = np.zeros((E, R))
         bw = np.zeros((E, R))
         bp = np.full((E, K), num_global, np.int32)
-        bcode = np.zeros(E, np.int32)
         brix = np.full((E, R), -1, np.int32)
-        for i, ent in enumerate(ents):
-            m = ent["members"]
-            nz = ent["nnz_idx"]
-            local_row_of = {int(g): j for j, g in enumerate(m)}
-            bv[i, : len(nz)] = vals[nz]
-            br[i, : len(nz)] = [local_row_of[int(r)] for r in rows[nz]]
-            bc[i, : len(nz)] = np.searchsorted(ent["proj"], cols[nz])
-            bl[i, : len(m)] = data.response[m]
-            bo[i, : len(m)] = data.offset[m]
-            bw[i, : len(m)] = weights[m]
-            bp[i, : len(ent["proj"])] = ent["proj"]
-            bcode[i] = ent["code"]
-            brix[i, : len(m)] = m
-            entity_bucket[ent["code"]] = b_idx
-            entity_pos[ent["code"]] = i
-        # sort nnz within each entity by local row (segment_sum contract)
-        for i in range(E):
-            o = np.argsort(br[i], kind="stable")
-            bv[i], br[i], bc[i] = bv[i][o], br[i][o], bc[i][o]
+
+        # rows of this class's entities
+        rsel = esel[ent_of_row]
+        d_e = ent_pos[ent_of_row[rsel]]
+        d_r = local_row[rsel]
+        src = act_rows[rsel]
+        bl[d_e, d_r] = response[src]
+        bo[d_e, d_r] = offset[src]
+        bw[d_e, d_r] = weights[src]
+        brix[d_e, d_r] = src
+
+        # nnz of this class's entities
+        zsel = esel[ne]
+        z_e = ent_pos[ne[zsel]]
+        z_s = slot[zsel]
+        bv[z_e, z_s] = nv[zsel]
+        br[z_e, z_s] = nlr[zsel]
+        bc[z_e, z_s] = local_col[zsel]
+
+        # projections of this class's entities
+        psel = esel[proj_ent]
+        p_e = ent_pos[proj_ent[psel]]
+        p_s = proj_slot[psel]
+        bp[p_e, p_s] = proj_col[psel]
+
         buckets.append(
             EntityBucket(
                 values=jnp.asarray(bv, dtype),
@@ -256,8 +307,20 @@ def build_random_effect_dataset(
         num_entities=num_entities,
         entity_bucket=entity_bucket,
         entity_pos=entity_pos,
-        passive_rows=(
-            np.concatenate(passive) if passive else np.zeros(0, np.int64)
-        ),
+        passive_rows=passive_rows.astype(np.int64),
         num_global_features=num_global,
     )
+
+
+def nnz_starts_like(starts: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Gather segment starts for each element's segment id."""
+    return starts[idx]
+
+
+def _next_pow2_arr(x: np.ndarray) -> np.ndarray:
+    """Vectorized _next_pow2 over an int array."""
+    x = np.asarray(x, np.int64)
+    out = np.ones_like(x)
+    nz = x > 1
+    out[nz] = 1 << np.ceil(np.log2(x[nz])).astype(np.int64)
+    return out

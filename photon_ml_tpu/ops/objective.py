@@ -130,14 +130,18 @@ class GLMObjective:
     def hessian_vector(
         self, w: Array, v: Array, batch: SparseBatch, axis_name: Optional[str] = None
     ) -> Array:
-        """H(w) @ v  =  sum_i weight_i * l''(z_i) * (x'_i . v) * x'_i  + l2*v."""
+        """H(w) @ v  =  sum_i weight_i * l''(z_i) * (x'_i . v) * x'_i  + l2*v.
+
+        One layout-level sweep (TiledBatch fuses gather z/u + scatter into a
+        single pallas pass — TRON's truncated-CG hot op).
+        """
         v_eff, v_shift = self._effective(v)
         w_eff, w_shift = self._effective(w)
-        z, xv = batch.margins_pair(w_eff, w_shift, v_eff, v_shift)
-        d2_row = batch.weights * self.loss.d2z(z, batch.labels)
-        q = d2_row * xv
+        raw_hv, q_total = batch.fused_hessian_vector(
+            w_eff, w_shift, v_eff, v_shift, self.loss_name
+        )
         hv = self._psum(
-            self._back_transform_vec(batch.scatter_features(q), jnp.sum(q)), axis_name
+            self._back_transform_vec(raw_hv, q_total), axis_name
         )
         return hv + self.l2_weight.astype(w.dtype) * v
 

@@ -229,6 +229,20 @@ class SparseBatch:
             jnp.sum(g_row),
         )
 
+    def fused_hessian_vector(
+        self, w: Array, shift, v: Array, v_shift, loss_name: str
+    ) -> tuple[Array, Array]:
+        """(raw Hv scatter sum_i wgt_i*l''(z_i)*(x_i.v)*x_i, sum_i q_i).
+
+        TiledBatch computes this in one fused pallas sweep; this is the
+        equivalent composition for the padded-COO layout.
+        """
+        from photon_ml_tpu.ops.losses import get_loss
+
+        z, xv = self.margins_pair(w, shift, v, v_shift)
+        q = self.weights * get_loss(loss_name).d2z(z, self.labels) * xv
+        return self.scatter_features(q), jnp.sum(q)
+
     def scatter_features(self, per_row: Array) -> Array:
         """Compute sum_i per_row[i] * x_i as a dense feature-space vector.
 

@@ -203,6 +203,39 @@ def _value_grad_kernel(loss_name: str, use_offsets: bool, *refs):
     _scatter_accum(out_g_ref, per_slot, mask_hi, mask_lo)
 
 
+def _hv_kernel(loss_name: str, use_offsets: bool, *refs):
+    """Fused Hessian-vector sweep: gather z = margins(w) and u = dot(v) from
+    the same masks, form q = weight * l''(z) * u, scatter q into feature
+    space and accumulate sum(q) — TRON's CG step in ONE data pass (the
+    composed margins_pair + scatter path costs two)."""
+    (vals_ref, hi_ref, lo_ref, rlo_ref, lab_ref, wgt_ref, off_ref,
+     w_ref, v_ref, shift_ref, out_s_ref, out_g_ref) = refs
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_s_ref[:] = jnp.zeros_like(out_s_ref)
+        out_g_ref[:] = jnp.zeros_like(out_g_ref)
+
+    S = vals_ref.shape[2]
+    B = w_ref.shape[0]
+    mask_hi, mask_lo, mask_r = _masks(hi_ref, lo_ref, rlo_ref, S, B)
+    vals = vals_ref[0, 0, :]
+
+    z = _row_margins(vals, mask_r, w_ref, mask_hi, mask_lo) + shift_ref[0, 0]
+    if use_offsets:
+        z = z + off_ref[0, :, :]
+    u = _row_margins(vals, mask_r, v_ref, mask_hi, mask_lo) + shift_ref[0, 1]
+
+    loss = get_loss(loss_name)
+    q_row = wgt_ref[0, :, :] * loss.d2z(z, lab_ref[0, :, :]) * u   # [1, R]
+    out_s_ref[:] = out_s_ref[:] + jnp.stack(
+        [jnp.sum(q_row), jnp.float32(0.0)]).reshape(1, 2)
+
+    per_slot = jnp.sum(q_row * mask_r, axis=1) * vals
+    _scatter_accum(out_g_ref, per_slot, mask_hi, mask_lo)
+
+
 def _spec_s(S):
     return pl.BlockSpec((1, 1, S), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
 
@@ -249,6 +282,23 @@ def _scatter_call(T, S, B, square, interpret):
         in_specs=[_spec_s(S)] * 4 + [_spec_r()],
         out_specs=_spec_acc((B, LANE)),
         out_shape=jax.ShapeDtypeStruct((B, LANE), jnp.float32),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _hv_call(T, S, B, loss_name, use_offsets, interpret):
+    kern = functools.partial(_hv_kernel, loss_name, use_offsets)
+    return pl.pallas_call(
+        kern,
+        grid=(T,),
+        in_specs=[_spec_s(S)] * 4 + [_spec_r()] * 3 + [_spec_w(B)] * 2
+        + [pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM)],
+        out_specs=[_spec_acc((1, 2)), _spec_acc((B, LANE))],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
+            jax.ShapeDtypeStruct((B, LANE), jnp.float32),
+        ],
         interpret=interpret,
     )
 
@@ -506,6 +556,22 @@ class TiledBatch:
         sums, g = call(*self._slot_args(), self.labels3, self.weights3,
                        self.offsets3, self._w2(w), sh.reshape(1, 2))
         return sums[0, 0], g.reshape(-1)[: self.num_features], sums[0, 1]
+
+    def fused_hessian_vector(
+        self, w: Array, shift, v: Array, v_shift, loss_name: str
+    ) -> tuple[Array, Array]:
+        """(raw Hv scatter sum_i wgt_i*l''(z_i)*(x_i.v)*x_i, sum of the
+        per-row q = wgt*l''*u terms) in ONE fused sweep (TRON CG fast path).
+        Caller applies normalization back-transform and the L2 term."""
+        T, _, S = self.vals.shape
+        call = _hv_call(T, S, self.num_blocks, loss_name, True, _interpret())
+        sh = jnp.stack([
+            jnp.asarray(shift, jnp.float32), jnp.asarray(v_shift, jnp.float32)
+        ])
+        sums, g = call(*self._slot_args(), self.labels3, self.weights3,
+                       self.offsets3, self._w2(w), self._w2(v),
+                       sh.reshape(1, 2))
+        return g.reshape(-1)[: self.num_features], sums[0, 0]
 
     def feature_moment_sums(self) -> tuple[Array, Array, Array]:
         """Per-feature (sum x, sum x^2, count nonzero) over valid rows."""

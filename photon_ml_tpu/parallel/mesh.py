@@ -121,7 +121,44 @@ def shard_rows(batch: SparseBatch, num_shards: int) -> SparseBatch:
     )
 
 
-def put_sharded(stacked: SparseBatch, mesh: Mesh, axis: str = DATA_AXIS) -> SparseBatch:
-    """Place a host-stacked batch so shard i's block lives on device i."""
+def put_sharded(stacked, mesh: Mesh, axis: str = DATA_AXIS):
+    """Place a host-stacked batch (any layout pytree with a leading shard
+    axis on every leaf) so shard i's block lives on device i."""
     sharding = NamedSharding(mesh, P(axis))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
+
+
+def shard_tiles(tiled, num_shards: int):
+    """Host-side: split a TiledBatch into ``num_shards`` contiguous tile
+    groups stacked on a new leading axis (the tiled analog of shard_rows —
+    tiles are independent, so any contiguous grouping is a valid row shard).
+
+    Tile count is padded to a multiple of ``num_shards`` with empty tiles
+    (vals 0, hi = num_blocks sentinel so gathers contribute nothing,
+    weights 0).
+    """
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.tiled import TiledBatch
+
+    T = tiled.num_tiles
+    Tp = _round_up(T, num_shards)
+    per = Tp // num_shards
+
+    def stack(x, fill):
+        a = np.asarray(x)
+        if Tp != T:
+            pad = np.full((Tp - T,) + a.shape[1:], fill, a.dtype)
+            a = np.concatenate([a, pad], axis=0)
+        return jnp.asarray(a.reshape((num_shards, per) + a.shape[1:]))
+
+    return TiledBatch(
+        vals=stack(tiled.vals, 0.0),
+        hi=stack(tiled.hi, tiled.num_blocks),
+        lo=stack(tiled.lo, 0),
+        rlo=stack(tiled.rlo, 0),
+        labels3=stack(tiled.labels3, 0.0),
+        offsets3=stack(tiled.offsets3, 0.0),
+        weights3=stack(tiled.weights3, 0.0),
+        num_features=tiled.num_features,
+    )

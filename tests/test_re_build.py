@@ -1,0 +1,139 @@
+"""Random-effect dataset builder: correctness vs a per-entity reference
+reconstruction, and ingest-rate at scale (VERDICT r2 item 5 — the build must
+be bulk-numpy, not per-entity Python)."""
+
+import time
+
+import numpy as np
+
+from photon_ml_tpu.game import build_game_dataset, build_random_effect_dataset
+from photon_ml_tpu.ops.sparse import SparseBatch
+
+
+def _dataset(rng, n, n_entities, n_features, density=0.4):
+    X = rng.normal(size=(n, n_features)) * (rng.random((n, n_features)) < density)
+    y = (rng.random(n) > 0.5).astype(float)
+    ids = rng.integers(0, n_entities, size=n)
+    offs = rng.normal(size=n)
+    wgts = rng.random(n) + 0.5
+    gds = build_game_dataset(
+        response=y,
+        feature_shards={"s": SparseBatch.from_dense(X, y)},
+        id_columns={"eid": ids},
+        offset=offs,
+        weight=wgts,
+    )
+    return gds, X, ids
+
+
+def test_buckets_match_per_entity_reference(rng):
+    """Every entity's padded bucket problem must equal the direct per-entity
+    extraction: dense features in LOCAL space, labels/offsets/weights in
+    member-row order, projection = sorted observed global cols."""
+    gds, X, ids = _dataset(rng, n=200, n_entities=23, n_features=12)
+    red = build_random_effect_dataset(gds, "eid", "s")
+    codes = gds.id_columns["eid"].codes
+
+    seen = 0
+    for code in np.unique(codes):
+        b_idx = red.entity_bucket[code]
+        pos = red.entity_pos[code]
+        assert b_idx >= 0
+        b = red.buckets[b_idx]
+        members = np.sort(np.where(codes == code)[0])
+
+        # labels/offsets/weights/row_index in member order
+        R = b.rows_per_entity
+        np.testing.assert_array_equal(
+            np.asarray(b.row_index)[pos, : len(members)], members)
+        np.testing.assert_allclose(
+            np.asarray(b.labels)[pos, : len(members)],
+            gds.response[members], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(b.offsets)[pos, : len(members)],
+            gds.offset[members], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(b.weights)[pos, : len(members)],
+            gds.weight[members], rtol=1e-6)
+        assert np.all(np.asarray(b.weights)[pos, len(members):] == 0)
+
+        # projection = sorted unique observed global cols
+        obs = np.unique(np.nonzero(X[members])[1])
+        proj = np.asarray(b.projection)[pos]
+        np.testing.assert_array_equal(proj[: len(obs)], obs)
+        assert np.all(proj[len(obs):] == red.num_global_features)
+
+        # dense reconstruction in local space
+        dense_local = np.zeros((R, b.num_local_features))
+        v = np.asarray(b.values)[pos]
+        r = np.asarray(b.rows)[pos]
+        c = np.asarray(b.cols)[pos]
+        np.add.at(dense_local, (r, c), v)
+        expected = np.zeros((R, b.num_local_features))
+        expected[: len(members), : len(obs)] = X[members][:, obs]
+        np.testing.assert_allclose(dense_local, expected, rtol=1e-5, atol=1e-6)
+
+        # nnz sorted by local row within the entity (segment_sum contract)
+        live = v != 0
+        assert np.all(np.diff(r[live]) >= 0)
+        seen += 1
+    assert seen == 23
+
+
+def test_cap_and_min_rows_vectorized(rng):
+    gds, X, ids = _dataset(rng, n=500, n_entities=10, n_features=8)
+    red = build_random_effect_dataset(
+        gds, "eid", "s", active_rows_per_entity=20, min_rows_per_entity=5)
+    codes = gds.id_columns["eid"].codes
+    n_active = 0
+    for code in np.unique(codes):
+        members = np.where(codes == code)[0]
+        b_idx = red.entity_bucket[code]
+        if len(members) < 5:
+            assert b_idx == -1
+            continue
+        b = red.buckets[b_idx]
+        pos = red.entity_pos[code]
+        kept = np.asarray(b.row_index)[pos]
+        kept = kept[kept >= 0]
+        n_kept = min(len(members), 20)
+        assert len(kept) == n_kept
+        assert set(kept).issubset(set(members))
+        # weight rescale on capped entities: kept weights *= count/cap
+        if len(members) > 20:
+            np.testing.assert_allclose(
+                np.asarray(b.weights)[pos, : n_kept],
+                gds.weight[kept] * (len(members) / 20), rtol=1e-5)
+        n_active += len(kept)
+    assert n_active + len(red.passive_rows) == 500
+
+
+def test_build_rate_100k_entities_1m_rows(rng):
+    """Ingest rate: 100K entities / 1M rows / ~10M nnz must build in bulk
+    numpy time (seconds), not per-entity Python time (minutes)."""
+    n, n_entities, nnz_per_row = 1_000_000, 100_000, 10
+    n_features = 50
+    nnz = n * nnz_per_row
+    rows = np.repeat(np.arange(n, dtype=np.int64), nnz_per_row)
+    cols = rng.integers(0, n_features, size=nnz)
+    values = rng.normal(size=nnz)
+    # ensure one nnz per (row, col) pair: dedupe by unique key
+    key = rows * n_features + cols
+    _, first = np.unique(key, return_index=True)
+    rows, cols, values = rows[first], cols[first], values[first]
+    y = (rng.random(n) > 0.5).astype(float)
+    ids = rng.integers(0, n_entities, size=n)
+    batch = SparseBatch.from_coo(values, rows, cols, y, num_features=n_features)
+    gds = build_game_dataset(
+        response=y, feature_shards={"s": batch}, id_columns={"eid": ids})
+
+    t0 = time.perf_counter()
+    red = build_random_effect_dataset(gds, "eid", "s")
+    elapsed = time.perf_counter() - t0
+    total_active = sum(
+        int((np.asarray(b.row_index) >= 0).sum()) for b in red.buckets)
+    assert total_active == n
+    # generous bound: catches any regression to per-entity looping, which
+    # takes minutes at this size
+    assert elapsed < 120, f"RE build took {elapsed:.1f}s"
+    print(f"RE build: {n} rows / {n_entities} entities in {elapsed:.2f}s")
