@@ -21,3 +21,8 @@ from photon_ml_tpu.ops.losses import (  # noqa: F401
 )
 from photon_ml_tpu.ops.sparse import SparseBatch  # noqa: F401
 from photon_ml_tpu.ops.objective import GLMObjective  # noqa: F401
+from photon_ml_tpu.training import (  # noqa: F401
+    SweepEntry,
+    select_best_model,
+    train_glm,
+)

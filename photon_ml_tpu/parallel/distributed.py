@@ -114,10 +114,13 @@ def distributed_solve(
 
 
 @lru_cache(maxsize=64)
-def _build_value_and_grad(mesh: Mesh, axis: str):
+def _build_sharded_eval(mesh: Mesh, axis: str, method_name: str):
+    """Sharded evaluation of one GLMObjective method (value_and_grad /
+    hessian_diagonal / ...): per-shard partial sums psum'd over ``axis``."""
+
     def f(obj_in, w_in, b):
         b = jax.tree.map(lambda x: x[0], b)
-        return obj_in.value_and_grad(w_in, b, axis_name=axis)
+        return getattr(obj_in, method_name)(w_in, b, axis_name=axis)
 
     def wrapped(obj, w, stacked_batch):
         batch_specs = jax.tree.map(lambda _: P(axis), stacked_batch)
@@ -140,4 +143,16 @@ def distributed_value_and_grad(
     axis: str = DATA_AXIS,
 ) -> tuple[Array, Array]:
     """Standalone sharded objective evaluation (diagnostics / evaluators)."""
-    return _build_value_and_grad(mesh, axis)(obj, w, stacked_batch)
+    return _build_sharded_eval(mesh, axis, "value_and_grad")(obj, w, stacked_batch)
+
+
+def distributed_hessian_diagonal(
+    obj: GLMObjective,
+    w: Array,
+    stacked_batch: SparseBatch,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+) -> Array:
+    """Sharded diag H(w), for coefficient variances
+    (DistributedOptimizationProblem.scala computeVariances analog)."""
+    return _build_sharded_eval(mesh, axis, "hessian_diagonal")(obj, w, stacked_batch)
