@@ -27,7 +27,12 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.data.projection import ProjectionMatrix
 from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.game.factored import (
+    FactoredRandomEffectModel,
+    MatrixFactorizationModel,
+)
 from photon_ml_tpu.game.models import (
     FixedEffectModel,
     GameModel,
@@ -156,6 +161,66 @@ def _load_random_effect(path: str, spec: dict) -> RandomEffectModel:
         )
 
 
+def _save_factored_random_effect(model: FactoredRandomEffectModel, path: str) -> dict:
+    os.makedirs(path, exist_ok=True)
+    _write_npz(
+        os.path.join(path, "model.npz"),
+        projection=np.asarray(model.projection.matrix, np.float32),
+        latent=np.asarray(model.latent, np.float32),
+        entity_flat=np.asarray(model.entity_flat, np.int64),
+        vocab=np.asarray(model.vocab),
+    )
+    return {
+        "type": "factored_random_effect",
+        "shard_name": model.shard_name,
+        "id_name": model.id_name,
+        "latent_dim": int(model.latent_dim),
+        "num_entities": int(len(model.vocab)),
+    }
+
+
+def _load_factored_random_effect(path: str, spec: dict) -> FactoredRandomEffectModel:
+    with np.load(os.path.join(path, "model.npz"), allow_pickle=False) as z:
+        return FactoredRandomEffectModel(
+            id_name=spec["id_name"],
+            shard_name=spec["shard_name"],
+            projection=ProjectionMatrix(matrix=jnp.asarray(z["projection"])),
+            latent=jnp.asarray(z["latent"]),
+            entity_flat=z["entity_flat"],
+            vocab=z["vocab"],
+        )
+
+
+def _save_matrix_factorization(model: MatrixFactorizationModel, path: str) -> dict:
+    """LatentFactorAvro analog (ModelProcessingUtils.scala:449-515)."""
+    os.makedirs(path, exist_ok=True)
+    _write_npz(
+        os.path.join(path, "model.npz"),
+        row_factors=np.asarray(model.row_factors, np.float32),
+        col_factors=np.asarray(model.col_factors, np.float32),
+        row_vocab=np.asarray(model.row_vocab),
+        col_vocab=np.asarray(model.col_vocab),
+    )
+    return {
+        "type": "matrix_factorization",
+        "row_effect": model.row_effect,
+        "col_effect": model.col_effect,
+        "num_latent_factors": int(model.num_latent_factors),
+    }
+
+
+def _load_matrix_factorization(path: str, spec: dict) -> MatrixFactorizationModel:
+    with np.load(os.path.join(path, "model.npz"), allow_pickle=False) as z:
+        return MatrixFactorizationModel(
+            row_effect=spec["row_effect"],
+            col_effect=spec["col_effect"],
+            row_factors=jnp.asarray(z["row_factors"]),
+            col_factors=jnp.asarray(z["col_factors"]),
+            row_vocab=z["row_vocab"],
+            col_vocab=z["col_vocab"],
+        )
+
+
 def save_game_model(
     model: GameModel, path: str, extra_metadata: Optional[dict] = None
 ) -> None:
@@ -175,6 +240,14 @@ def save_game_model(
         elif isinstance(sub, RandomEffectModel):
             coords[name] = _save_random_effect(
                 sub, os.path.join(path, "random-effect", name)
+            )
+        elif isinstance(sub, FactoredRandomEffectModel):
+            coords[name] = _save_factored_random_effect(
+                sub, os.path.join(path, "factored-random-effect", name)
+            )
+        elif isinstance(sub, MatrixFactorizationModel):
+            coords[name] = _save_matrix_factorization(
+                sub, os.path.join(path, "matrix-factorization", name)
             )
         else:
             raise TypeError(
@@ -208,6 +281,14 @@ def load_game_model(path: str) -> GameModel:
         elif spec["type"] == "random_effect":
             models[name] = _load_random_effect(
                 os.path.join(path, "random-effect", name), spec
+            )
+        elif spec["type"] == "factored_random_effect":
+            models[name] = _load_factored_random_effect(
+                os.path.join(path, "factored-random-effect", name), spec
+            )
+        elif spec["type"] == "matrix_factorization":
+            models[name] = _load_matrix_factorization(
+                os.path.join(path, "matrix-factorization", name), spec
             )
         else:
             raise ValueError(f"unknown coordinate type '{spec['type']}'")

@@ -1,0 +1,77 @@
+"""Gaussian random projection matrices for random-effect feature-space
+reduction and factored-random-effect latent spaces.
+
+Reference analog: photon-api projector/ProjectionMatrix.scala:95-124 —
+entries drawn N(0, 1) scaled by 1/projected_dim (the reference deliberately
+uses std = k rather than sqrt(k) to keep entries small), clipped to
+[-1, 1], with an optional intercept passthrough row (all zeros except a 1
+in the intercept column). On TPU the projection is just a dense [k, d]
+matmul / per-nnz column gather — no broadcast object needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ProjectionMatrix:
+    """A dense projection x -> A @ x  (A: [projected_dim, original_dim]).
+
+    ``project_coefficients`` maps a model trained in projected space back
+    to original space (ProjectionMatrix.scala projectCoefficients:
+    w_original = A^T w_projected).
+    """
+
+    matrix: Array  # f[k, d]
+
+    @property
+    def projected_dim(self) -> int:
+        return self.matrix.shape[0]
+
+    @property
+    def original_dim(self) -> int:
+        return self.matrix.shape[1]
+
+    def project_features(self, x: Array) -> Array:
+        return self.matrix @ x
+
+    def project_coefficients(self, w_projected: Array) -> Array:
+        return self.matrix.T @ w_projected
+
+    def extended(self) -> Array:
+        """Matrix with one extra all-zero column at index ``original_dim``
+        so sentinel feature ids (= d, the padding convention of
+        EntityBucket.projection) gather zeros."""
+        return jnp.pad(self.matrix, ((0, 0), (0, 1)))
+
+
+def build_gaussian_projection_matrix(
+    projected_dim: int,
+    original_dim: int,
+    intercept_index: Optional[int] = None,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> ProjectionMatrix:
+    """Random Gaussian projection (ProjectionMatrix.scala:95-124): entries
+    N(0, 1)/projected_dim clipped to [-1, 1]. With ``intercept_index``, an
+    extra passthrough row keeps the intercept feature intact (the
+    reference's isKeepingInterceptTerm dummy row)."""
+    if projected_dim < 1 or original_dim < 1:
+        raise ValueError("projection dims must be positive")
+    rng = np.random.default_rng(seed)
+    m = rng.standard_normal((projected_dim, original_dim)) / projected_dim
+    m = np.clip(m, -1.0, 1.0)
+    if intercept_index is not None:
+        passthrough = np.zeros((1, original_dim))
+        passthrough[0, intercept_index] = 1.0
+        m = np.concatenate([m, passthrough], axis=0)
+    return ProjectionMatrix(matrix=jnp.asarray(m, dtype))

@@ -23,7 +23,13 @@ from photon_ml_tpu.game.random_effect_data import (  # noqa: F401
     RandomEffectDataset,
     build_random_effect_dataset,
 )
+from photon_ml_tpu.game.factored import (  # noqa: F401
+    FactoredRandomEffectCoordinate,
+    FactoredRandomEffectModel,
+    MatrixFactorizationModel,
+)
 from photon_ml_tpu.game.estimator import (  # noqa: F401
+    FactoredRandomEffectConfig,
     FixedEffectConfig,
     GameConfig,
     GameEstimator,

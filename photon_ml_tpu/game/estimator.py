@@ -33,6 +33,7 @@ from photon_ml_tpu.game.coordinates import (
     FixedEffectCoordinate,
     RandomEffectCoordinate,
 )
+from photon_ml_tpu.game.factored import FactoredRandomEffectCoordinate
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.game.random_effect_data import build_random_effect_dataset
@@ -69,6 +70,23 @@ class RandomEffectConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectConfig:
+    """One factored (matrix-factorization) random-effect coordinate
+    (FactoredRandomEffectOptimizationProblem + MFOptimizationConfiguration
+    analog: latent_dim = numLatentFactors, mf_iterations = numIterations)."""
+
+    shard_name: str
+    id_name: str
+    latent_dim: int
+    mf_iterations: int = 1
+    re_optimizer: OptimizerConfig = OptimizerConfig()
+    latent_optimizer: OptimizerConfig = OptimizerConfig()
+    active_rows_per_entity: Optional[int] = None
+    min_rows_per_entity: int = 1
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
 class GameConfig:
     """Full training configuration (GameParams analog).
 
@@ -78,7 +96,9 @@ class GameConfig:
     """
 
     task: str
-    coordinates: Mapping[str, FixedEffectConfig | RandomEffectConfig]
+    coordinates: Mapping[
+        str, FixedEffectConfig | RandomEffectConfig | FactoredRandomEffectConfig
+    ]
     num_iterations: int = 1
     evaluators: Sequence[str] = ()
 
@@ -139,6 +159,26 @@ class GameEstimator:
                     re_data=red,
                     loss_name=self.config.task,
                     config=c.optimizer,
+                    mesh=entity_mesh,
+                )
+            elif isinstance(c, FactoredRandomEffectConfig):
+                red = build_random_effect_dataset(
+                    data,
+                    c.id_name,
+                    c.shard_name,
+                    active_rows_per_entity=c.active_rows_per_entity,
+                    min_rows_per_entity=c.min_rows_per_entity,
+                )
+                coords[name] = FactoredRandomEffectCoordinate(
+                    name=name,
+                    data=data,
+                    re_data=red,
+                    loss_name=self.config.task,
+                    re_config=c.re_optimizer,
+                    latent_config=c.latent_optimizer,
+                    latent_dim=c.latent_dim,
+                    mf_iterations=c.mf_iterations,
+                    seed=c.seed,
                     mesh=entity_mesh,
                 )
             else:
@@ -223,17 +263,8 @@ class GameEstimator:
 def _config_metadata(config: GameConfig) -> dict:
     """JSON-safe description of the training config (model-metadata analog)."""
 
-    def describe(c):
-        out = {"shard_name": c.shard_name}
-        if isinstance(c, RandomEffectConfig):
-            out["type"] = "random_effect"
-            out["id_name"] = c.id_name
-            out["active_rows_per_entity"] = c.active_rows_per_entity
-        else:
-            out["type"] = "fixed_effect"
-            out["normalization"] = str(NormalizationType(c.normalization).value)
-        opt = c.optimizer
-        out["optimizer"] = {
+    def describe_opt(opt):
+        return {
             "type": str(opt.optimizer_type.value),
             "max_iterations": opt.max_iterations,
             "tolerance": opt.tolerance,
@@ -241,6 +272,26 @@ def _config_metadata(config: GameConfig) -> dict:
             "regularization_weight": opt.regularization_weight,
             "down_sampling_rate": opt.down_sampling_rate,
         }
+
+    def describe(c):
+        out = {"shard_name": c.shard_name}
+        if isinstance(c, RandomEffectConfig):
+            out["type"] = "random_effect"
+            out["id_name"] = c.id_name
+            out["active_rows_per_entity"] = c.active_rows_per_entity
+            out["optimizer"] = describe_opt(c.optimizer)
+        elif isinstance(c, FactoredRandomEffectConfig):
+            out["type"] = "factored_random_effect"
+            out["id_name"] = c.id_name
+            out["active_rows_per_entity"] = c.active_rows_per_entity
+            out["latent_dim"] = c.latent_dim
+            out["mf_iterations"] = c.mf_iterations
+            out["optimizer"] = describe_opt(c.re_optimizer)
+            out["latent_optimizer"] = describe_opt(c.latent_optimizer)
+        else:
+            out["type"] = "fixed_effect"
+            out["normalization"] = str(NormalizationType(c.normalization).value)
+            out["optimizer"] = describe_opt(c.optimizer)
         return out
 
     return {

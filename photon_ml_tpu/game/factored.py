@@ -1,0 +1,514 @@
+"""Factored random effects (the matrix-factorization coordinate) and the
+standalone matrix-factorization scoring model.
+
+Reference analog: photon-api algorithm/FactoredRandomEffectCoordinate.scala
+:39-287 and model/MatrixFactorizationModel.scala:35-64. The factored
+coordinate represents each entity's model as a K-dim latent vector c_e plus
+a SHARED latent projection matrix A [K, d]; a row of entity e scores
+(A x) . c_e. Training alternates (numIterations times):
+
+  1. latent-space RE solve: project each entity's data through A and run the
+     per-entity GLM solves in R^K (reusing RandomEffectCoordinate.updateModel
+     in the reference, :111-130; here the vmapped bucket solver),
+  2. latent matrix refit: fix the c_e and refit vec(A) as ONE distributed
+     GLM over kronecker(x, c_e) features (updateLatentProjectionMatrix
+     :226-255, kroneckerProductFeaturesAndCoefficients :269-287).
+
+TPU-first shape trick: the kronecker-expanded design has STATIC structure —
+for nnz (row i, col j, value v) of entity e, the expanded entries are
+(i, j*K + l, v * c_e[l]) for l < K. The (rows, cols) index arrays are built
+once at coordinate construction; each refit only recomputes the VALUES by a
+[m, K] gather of the current latent table — no data movement, no reshuffle,
+one jit-compiled solve per refit (vs the reference's regenerated + reshuffled
+RDD per iteration). The reference's sparsityToleranceThreshold (drop tiny
+products) does not apply: XLA needs static shapes, and zero values are inert.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from photon_ml_tpu.data.projection import (
+    ProjectionMatrix,
+    build_gaussian_projection_matrix,
+)
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.game.models import map_vocab_codes
+from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.optim.adapter import glm_adapter
+from photon_ml_tpu.optim.factory import OptimizerConfig, dispatch_solve
+from photon_ml_tpu.parallel.distributed import distributed_solve
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectModel:
+    """Latent per-entity vectors + the shared projection matrix.
+
+    ``latent`` is one flat [n_active_entities, K] table (entities of every
+    geometry bucket concatenated); ``entity_flat`` maps a TRAINING entity
+    code to its row (-1 = entity unseen / inactive). Models always score in
+    projected space: score = (A x) . c_e (FactoredRandomEffectModel
+    .toRandomEffectModel + RandomEffectCoordinate.score in the reference).
+    """
+
+    id_name: str
+    shard_name: str
+    projection: ProjectionMatrix  # A: [K, d]
+    latent: Array  # f[n_flat, K]
+    entity_flat: np.ndarray  # host i64[num_entities] code -> flat row | -1
+    vocab: np.ndarray  # training id vocabulary
+
+    @property
+    def latent_dim(self) -> int:
+        return self.latent.shape[1]
+
+    def score(self, data: GameDataset) -> Array:
+        """[n_pad] scores; entities without a latent vector score 0."""
+        if data.id_columns.get(self.id_name) is None:
+            raise KeyError(f"scoring data lacks id column '{self.id_name}'")
+        batch = data.shard(self.shard_name)
+        n = data.num_rows
+        idc = data.id_columns[self.id_name]
+        codes = map_vocab_codes(self.vocab, idc.vocab[idc.codes])
+        flat_of_row = np.where(codes >= 0, self.entity_flat[np.maximum(codes, 0)], -1)
+
+        vals = np.asarray(batch.values)
+        rows = np.asarray(batch.rows)
+        cols = np.asarray(batch.cols)
+        live = (vals != 0) & (rows < n)
+        v = jnp.asarray(vals[live], batch.dtype)
+        r = jnp.asarray(rows[live], jnp.int32)
+        g = jnp.asarray(cols[live], jnp.int32)
+        f = jnp.asarray(flat_of_row[rows[live]], jnp.int32)
+
+        c = self.latent[jnp.maximum(f, 0)]  # [m, K]
+        a = self.projection.matrix.T[g]  # [m, K]
+        contrib = jnp.where(f >= 0, v * jnp.sum(c * a, axis=1), 0.0)
+        return jnp.zeros((batch.num_rows,), batch.dtype).at[r].add(contrib)
+
+    def effective_coefficients(self, entity_value) -> Optional[Array]:
+        """Original-space d-dim coefficients A^T c_e for one entity (the
+        projectCoefficients view), or None if the entity is unseen."""
+        code = map_vocab_codes(self.vocab, np.asarray([entity_value]))[0]
+        if code < 0 or self.entity_flat[code] < 0:
+            return None
+        return self.projection.project_coefficients(
+            self.latent[int(self.entity_flat[code])]
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class MatrixFactorizationModel:
+    """Row/column latent-factor scoring model
+    (model/MatrixFactorizationModel.scala:35-64): score(datum) =
+    rowFactors[row_id] . colFactors[col_id]; rows/cols unseen in either
+    vocabulary score 0."""
+
+    row_effect: str  # id column naming matrix rows (e.g. "userId")
+    col_effect: str  # id column naming matrix cols (e.g. "movieId")
+    row_factors: Array  # f[n_row_entities, K]
+    col_factors: Array  # f[n_col_entities, K]
+    row_vocab: np.ndarray
+    col_vocab: np.ndarray
+
+    @property
+    def num_latent_factors(self) -> int:
+        return self.row_factors.shape[1]
+
+    def score(self, data: GameDataset) -> Array:
+        for eff in (self.row_effect, self.col_effect):
+            if data.id_columns.get(eff) is None:
+                raise KeyError(f"scoring data lacks id column '{eff}'")
+        rc = data.id_columns[self.row_effect]
+        cc = data.id_columns[self.col_effect]
+        r_codes = map_vocab_codes(self.row_vocab, rc.vocab[rc.codes])
+        c_codes = map_vocab_codes(self.col_vocab, cc.vocab[cc.codes])
+        ok = (r_codes >= 0) & (c_codes >= 0)
+        rf = self.row_factors[jnp.asarray(np.maximum(r_codes, 0), jnp.int32)]
+        cf = self.col_factors[jnp.asarray(np.maximum(c_codes, 0), jnp.int32)]
+        s = jnp.where(jnp.asarray(ok), jnp.sum(rf * cf, axis=1), 0.0)
+        # align with the padded row count every score path uses
+        n_pad = data.shard(next(iter(data.feature_shards))).num_rows
+        return jnp.pad(s, (0, n_pad - s.shape[0]))
+
+
+# ---------------------------------------------------------------------------
+# coordinate
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=64)
+def _latent_design_fn(R: int):
+    """[E]-vmapped projector: per-entity dense latent design X~ [R, K] from
+    local sparse data and A (extended with a zero sentinel column)."""
+
+    def one(values, rows, cols, projection, a_ext):
+        g = projection[cols]  # [NZ] global ids (sentinel -> zero col)
+        a = a_ext[:, g]  # [K, NZ]
+        return jax.ops.segment_sum((values[None, :] * a).T, rows, num_segments=R)
+
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, 0, 0, None)))
+
+
+@lru_cache(maxsize=64)
+def _latent_fit_solver(config: OptimizerConfig, loss_name: str):
+    def run(obj, batch, w0, l1):
+        return dispatch_solve(glm_adapter(obj, batch), w0, config, l1)
+
+    return jax.jit(run)
+
+
+@jax.jit
+def _kron_values(vals, ent, latent):
+    return (vals[:, None] * latent[ent]).reshape(-1)
+
+
+@dataclasses.dataclass
+class FactoredRandomEffectCoordinate:
+    """Alternating latent RE solve + latent-matrix GLM refit
+    (FactoredRandomEffectCoordinate.scala:111-147).
+
+    ``latent_dim`` is the latent-space dimension K and ``mf_iterations`` the
+    alternation count (MFOptimizationConfiguration analog);
+    ``re_config``/``latent_config`` are the per-entity and latent-matrix
+    optimizer configs (FactoredRandomEffectOptimizationProblem)."""
+
+    name: str
+    data: GameDataset
+    re_data: RandomEffectDataset
+    loss_name: str
+    re_config: OptimizerConfig
+    latent_config: OptimizerConfig
+    latent_dim: int
+    mf_iterations: int = 1
+    seed: int = 0
+    mesh: Optional[Mesh] = None  # 1-D mesh: entity-shards the latent RE
+    # solves (shard_map, no collectives) and data-parallels the latent
+    # matrix refit (distributed_solve) over the same devices
+
+    def __post_init__(self):
+        if self.latent_dim < 1:
+            raise ValueError("latent_dim must be >= 1")
+        if self.mf_iterations < 1:
+            raise ValueError("mf_iterations must be >= 1")
+        self.re_config.validate(self.loss_name)
+        self.latent_config.validate(self.loss_name)
+        k = self.latent_dim
+        d = self.re_data.num_global_features
+        buckets = self.re_data.buckets
+        self._batch = self.data.shard(self.re_data.shard_name)
+        n_pad = self._batch.num_rows
+        n = self.data.num_rows
+
+        # flat latent-table layout: bucket entities concatenated in order
+        sizes = [b.num_entities for b in buckets]
+        self._flat_offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+        self._n_flat = int(self._flat_offsets[-1])
+        eb, ep = self.re_data.entity_bucket, self.re_data.entity_pos
+        self._entity_flat = np.where(
+            eb >= 0, self._flat_offsets[np.maximum(eb, 0)] + ep, -1
+        ).astype(np.int64)
+
+        # --- static kronecker structure (host, once) ---
+        g_rows, g_cols, g_vals, g_ent = [], [], [], []
+        for b_idx, b in enumerate(buckets):
+            rows_l = np.asarray(b.rows)  # [E, NZ] local rows
+            row_index = np.asarray(b.row_index)  # [E, R]
+            gr = np.take_along_axis(row_index, rows_l, axis=1)  # [E, NZ]
+            gc = np.take_along_axis(
+                np.asarray(b.projection), np.asarray(b.cols), axis=1
+            )
+            vals = np.asarray(b.values)
+            ent = np.broadcast_to(
+                (self._flat_offsets[b_idx] + np.arange(b.num_entities))[:, None],
+                gr.shape,
+            )
+            # padding nnz: value 0 -> contributions vanish; clamp indices
+            # into range so gathers stay valid
+            gr = np.where((gr < 0) | (vals == 0), n_pad - 1, gr)
+            gc = np.where(gc >= d, 0, gc)
+            g_rows.append(gr.reshape(-1))
+            g_cols.append(gc.reshape(-1))
+            g_vals.append(vals.reshape(-1))
+            g_ent.append(ent.reshape(-1))
+        g_rows = np.concatenate(g_rows) if g_rows else np.zeros(0, np.int64)
+        g_cols = np.concatenate(g_cols) if g_cols else np.zeros(0, np.int64)
+        g_vals = np.concatenate(g_vals) if g_vals else np.zeros(0)
+        g_ent = np.concatenate(g_ent) if g_ent else np.zeros(0, np.int64)
+        m = len(g_vals)
+
+        self._kron_vals = jnp.asarray(g_vals, self._batch.dtype)
+        self._kron_ent = jnp.asarray(g_ent, jnp.int32)
+        kron_rows = np.repeat(g_rows, k)
+        kron_cols = (g_cols[:, None] * k + np.arange(k)[None, :]).reshape(-1)
+
+        # active-row labels/weights/base-offsets scattered from the buckets
+        # (weights carry the active-data cap rescale; passive rows weight 0)
+        lab = np.zeros(n_pad)
+        wgt = np.zeros(n_pad)
+        off = np.zeros(n_pad)
+        for b in buckets:
+            ri = np.asarray(b.row_index)
+            valid = ri >= 0
+            lab[ri[valid]] = np.asarray(b.labels)[valid]
+            wgt[ri[valid]] = np.asarray(b.weights)[valid]
+            off[ri[valid]] = np.asarray(b.offsets)[valid]
+        self._base_offsets = off
+
+        # order nnz by row for segment-sum friendliness
+        o = np.argsort(kron_rows, kind="stable")
+        self._kron_perm = jnp.asarray(
+            (np.arange(m)[:, None] * k + np.arange(k)[None, :]).reshape(-1)[o],
+            jnp.int32,
+        )
+        self._num_kron_features = d * k
+
+        key_re = dataclasses.replace(self.re_config, regularization_weight=0.0)
+        key_lat = dataclasses.replace(self.latent_config, regularization_weight=0.0)
+        # the per-entity bucket solver is shared with RandomEffectCoordinate
+        # (identical dispatch; one lru_cache entry for both coordinate types)
+        from photon_ml_tpu.game.coordinates import _re_solver, _re_solver_sharded
+
+        self._re_solver = _re_solver(key_re, self.loss_name)
+        self._lat_solver = _latent_fit_solver(key_lat, self.loss_name)
+        if self.mesh is not None:
+            self._axis = self.mesh.axis_names[0]
+            self._n_dev = int(self.mesh.devices.size)
+            self._re_solver_sharded = _re_solver_sharded(
+                key_re, self.loss_name, self.mesh, self._axis
+            )
+            # mesh mode never materializes the single-device kron template
+            self._latent_template = None
+            self._build_stacked_latent(kron_rows[o], kron_cols[o], lab, wgt)
+        else:
+            self._latent_template = SparseBatch(
+                values=jnp.zeros((m * k,), self._batch.dtype),
+                rows=jnp.asarray(kron_rows[o], jnp.int32),
+                cols=jnp.asarray(kron_cols[o], jnp.int32),
+                labels=jnp.asarray(lab, self._batch.dtype),
+                offsets=jnp.asarray(off, self._batch.dtype),
+                weights=jnp.asarray(wgt, self._batch.dtype),
+                num_features=d * k,
+            )
+        self._re_obj = make_objective(
+            self.loss_name,
+            l2_weight=self.re_config.regularization.l2_weight(
+                self.re_config.regularization_weight
+            ),
+        )
+        self._re_l1 = jnp.float32(
+            self.re_config.regularization.l1_weight(
+                self.re_config.regularization_weight
+            )
+        )
+        self._lat_obj = make_objective(
+            self.loss_name,
+            l2_weight=self.latent_config.regularization.l2_weight(
+                self.latent_config.regularization_weight
+            ),
+        )
+        self._lat_l1 = jnp.float32(
+            self.latent_config.regularization.l1_weight(
+                self.latent_config.regularization_weight
+            )
+        )
+
+    def _build_stacked_latent(self, rows_np, cols_np, lab, wgt) -> None:
+        """Pre-shard the STATIC kronecker structure over the mesh: contiguous
+        row blocks per device with local row ids, plus an index map so each
+        refit only gathers the fresh values into place (the per-iteration
+        analog of FixedEffectCoordinate._restack)."""
+        n_dev = self._n_dev
+        n_pad = self._batch.num_rows
+        rows_per = -(-n_pad // n_dev)
+        shard_of = np.minimum(rows_np // rows_per, n_dev - 1)
+        counts = np.bincount(shard_of, minlength=n_dev)
+        nnz_max = max(int(counts.max()), 1)
+
+        idx_map = np.full((n_dev, nnz_max), -1, np.int64)
+        srows = np.full((n_dev, nnz_max), rows_per - 1, np.int32)
+        scols = np.zeros((n_dev, nnz_max), np.int32)
+        for s in range(n_dev):
+            sel = np.nonzero(shard_of == s)[0]
+            idx_map[s, : len(sel)] = sel
+            srows[s, : len(sel)] = rows_np[sel] - s * rows_per
+            scols[s, : len(sel)] = cols_np[sel]
+
+        def rowwise(a):
+            out = np.zeros((n_dev * rows_per,))
+            out[: len(a)] = a
+            return jnp.asarray(out.reshape(n_dev, rows_per), self._batch.dtype)
+
+        self._stacked_rows_per = rows_per
+        self._stacked_idx = jnp.asarray(idx_map, jnp.int32)
+        self._stacked_template = SparseBatch(
+            values=jnp.zeros((n_dev, nnz_max), self._batch.dtype),
+            rows=jnp.asarray(srows),
+            cols=jnp.asarray(scols),
+            labels=rowwise(lab),
+            offsets=rowwise(self._base_offsets),
+            weights=rowwise(wgt),
+            num_features=self._num_kron_features,
+        )
+
+    # -- model plumbing ------------------------------------------------------
+
+    def initialize_model(self) -> FactoredRandomEffectModel:
+        """Zero latent vectors + a Gaussian random projection
+        (FactoredRandomEffectCoordinate.initializeModel:190-212, which seeds
+        A with buildRandomProjectionBroadcastProjector, no intercept row)."""
+        proj = build_gaussian_projection_matrix(
+            self.latent_dim,
+            self.re_data.num_global_features,
+            intercept_index=None,
+            seed=self.seed,
+        )
+        return FactoredRandomEffectModel(
+            id_name=self.re_data.id_name,
+            shard_name=self.re_data.shard_name,
+            projection=proj,
+            latent=jnp.zeros((self._n_flat, self.latent_dim), jnp.float32),
+            entity_flat=self._entity_flat,
+            vocab=self.data.id_columns[self.re_data.id_name].vocab,
+        )
+
+    def _bucket_slice(self, latent: Array, b_idx: int) -> Array:
+        lo = int(self._flat_offsets[b_idx])
+        hi = int(self._flat_offsets[b_idx + 1])
+        return latent[lo:hi]
+
+    def _latent_re_step(
+        self, latent: Array, a_ext: Array, residual: Optional[Array]
+    ) -> Array:
+        """One pass of per-entity solves in latent space over all buckets."""
+        k = self.latent_dim
+        parts = []
+        for b_idx, b in enumerate(self.re_data.buckets):
+            bucket = b if residual is None else b.with_extra_offsets(residual)
+            E, R = b.num_entities, b.rows_per_entity
+            X = _latent_design_fn(R)(
+                b.values, b.rows, b.cols, b.projection, a_ext
+            )  # [E, R, K]
+            dense = SparseBatch(
+                values=X.reshape(E, R * k),
+                rows=jnp.broadcast_to(
+                    jnp.repeat(jnp.arange(R, dtype=jnp.int32), k), (E, R * k)
+                ),
+                cols=jnp.broadcast_to(
+                    jnp.tile(jnp.arange(k, dtype=jnp.int32), R), (E, R * k)
+                ),
+                labels=bucket.labels,
+                offsets=bucket.offsets,
+                weights=bucket.weights,
+                num_features=k,
+            )
+            w0 = self._bucket_slice(latent, b_idx)
+            if self.mesh is None:
+                res = self._re_solver(self._re_obj, dense, w0, self._re_l1)
+                w = res.w
+            else:
+                total = -(-E // self._n_dev) * self._n_dev
+                from photon_ml_tpu.game.coordinates import _pad_entities
+
+                dense_p, w0_p = _pad_entities(dense, w0, total)
+                res = self._re_solver_sharded(
+                    self._re_obj, dense_p, w0_p, self._re_l1
+                )
+                w = res.w[:E]
+            parts.append(w)
+        return jnp.concatenate(parts, axis=0) if parts else latent
+
+    def _latent_matrix_step(
+        self, latent: Array, a: Array, residual: Optional[Array]
+    ) -> Array:
+        """Refit vec(A) as one GLM over the static kronecker structure."""
+        vals = _kron_values(self._kron_vals, self._kron_ent, latent)
+        vals = vals[self._kron_perm]
+        w0 = a.T.reshape(-1)  # vec layout matches cols j*K + l
+        k = self.latent_dim
+        if self.mesh is not None:
+            # scatter the fresh values into the pre-sharded static layout;
+            # everything else about the stacked batch is fixed
+            sv = jnp.where(
+                self._stacked_idx >= 0,
+                vals[jnp.maximum(self._stacked_idx, 0)],
+                0.0,
+            )
+            stacked = dataclasses.replace(self._stacked_template, values=sv)
+            if residual is not None:
+                off = jnp.asarray(self._base_offsets, sv.dtype) + residual
+                total = self._n_dev * self._stacked_rows_per
+                off = jnp.pad(off, (0, total - off.shape[0]))
+                stacked = dataclasses.replace(
+                    stacked, offsets=off.reshape(self._n_dev, -1)
+                )
+            res = distributed_solve(
+                self.loss_name,
+                stacked,
+                self.latent_config,
+                w0,
+                self.mesh,
+                axis=self._axis,
+            )
+            return res.w.reshape(-1, k).T
+        batch = dataclasses.replace(self._latent_template, values=vals)
+        if residual is not None:
+            off = jnp.asarray(self._base_offsets, batch.dtype) + residual
+            batch = dataclasses.replace(batch, offsets=off)
+        res = self._lat_solver(self._lat_obj, batch, w0, self._lat_l1)
+        return res.w.reshape(-1, k).T  # [K, d]
+
+    def update_model(
+        self,
+        model: FactoredRandomEffectModel,
+        residual_scores: Optional[Array],
+    ) -> FactoredRandomEffectModel:
+        latent = model.latent
+        a = model.projection.matrix
+        for _ in range(self.mf_iterations):
+            a_ext = ProjectionMatrix(matrix=a).extended()
+            latent = self._latent_re_step(latent, a_ext, residual_scores)
+            a = self._latent_matrix_step(latent, a, residual_scores)
+        return dataclasses.replace(
+            model, latent=latent, projection=ProjectionMatrix(matrix=a)
+        )
+
+    def score(self, model: FactoredRandomEffectModel) -> Array:
+        """Training-data scores: bucket fast path for active rows, generic
+        model path for passive rows."""
+        a_ext = model.projection.extended()
+        n_pad = self._batch.num_rows
+        scores = jnp.zeros((n_pad,), jnp.float32)
+        for b_idx, b in enumerate(self.re_data.buckets):
+            R = b.rows_per_entity
+            X = _latent_design_fn(R)(
+                b.values, b.rows, b.cols, b.projection, a_ext
+            )  # [E, R, K]
+            c = self._bucket_slice(model.latent, b_idx)  # [E, K]
+            margins = jnp.einsum("erk,ek->er", X, c)
+            idx = b.row_index.reshape(-1)
+            scores = scores.at[jnp.maximum(idx, 0)].add(
+                jnp.where(idx >= 0, margins.reshape(-1), 0.0)
+            )
+        if len(self.re_data.passive_rows):
+            passive = model.score(self.data)
+            mask = np.zeros(n_pad, bool)
+            mask[self.re_data.passive_rows] = True
+            scores = jnp.where(jnp.asarray(mask), passive, scores)
+        return scores

@@ -24,6 +24,16 @@ from photon_ml_tpu.ops.losses import get_loss
 Array = jax.Array
 
 
+def map_vocab_codes(vocab: np.ndarray, values: np.ndarray) -> np.ndarray:
+    """Map raw id values to codes in a (sorted unique) vocabulary; -1 for
+    values the vocabulary has never seen. Entity identity is the id VALUE,
+    not a dataset-local integer code (the RDD analog joins by id string)."""
+    pos = np.searchsorted(vocab, values)
+    pos_c = np.minimum(pos, len(vocab) - 1)
+    hit = vocab[pos_c] == values
+    return np.where(hit, pos_c, -1)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class FixedEffectModel:
@@ -65,17 +75,9 @@ class RandomEffectModel:
     vocab: np.ndarray  # training id vocabulary (sorted unique values)
 
     def _codes_for(self, data: GameDataset) -> np.ndarray:
-        """Map a dataset's entity VALUES to training codes (-1 if unseen).
-
-        Entity identity is the id value, not the dataset-local integer code —
-        a scoring dataset has its own vocabulary (the RDD analog joins by
-        entity id string, RandomEffectModel.scala)."""
+        """Map a dataset's entity VALUES to training codes (-1 if unseen)."""
         idc = data.id_columns[self.id_name]
-        values = idc.vocab[idc.codes]  # [n] original values
-        pos = np.searchsorted(self.vocab, values)
-        pos_c = np.minimum(pos, len(self.vocab) - 1)
-        hit = self.vocab[pos_c] == values
-        return np.where(hit, pos_c, -1)
+        return map_vocab_codes(self.vocab, idc.vocab[idc.codes])
 
     def score(self, data: GameDataset) -> Array:
         """Scores for every example row; entities without a model score 0.

