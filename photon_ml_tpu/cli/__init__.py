@@ -1,0 +1,1 @@
+"""CLI drivers (photon-client cli/ analog): train + score entry points."""

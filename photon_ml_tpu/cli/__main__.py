@@ -1,0 +1,30 @@
+"""CLI dispatcher: python -m photon_ml_tpu.cli {train|score} ...
+
+Reference analog: the photon-client spark-submit mains
+(cli/game/training/Driver.scala:327, cli/game/scoring/Driver.scala:255)."""
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m photon_ml_tpu.cli {train|score} [options]")
+        print("  train --config <json> [--output-dir <dir>]")
+        print("  score --model-dir <dir> --config <json> [--output <avro>]")
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "train":
+        from photon_ml_tpu.cli.train import main as train_main
+
+        return train_main(rest)
+    if cmd == "score":
+        from photon_ml_tpu.cli.score import main as score_main
+
+        return score_main(rest)
+    print(f"unknown command '{cmd}' (expected train|score)", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,118 @@
+"""GAME scoring driver.
+
+Reference analog: photon-client cli/game/scoring/Driver.scala:51-201 —
+load model -> read data (response optional) -> score -> save
+ScoringResultAvro -> optional evaluation:
+
+    python -m photon_ml_tpu.cli score --model-dir out/model/best \\
+        --config score.json [--output scores.avro] [--evaluators auc rmse]
+
+The config's "input" block uses the same schema as the training driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.cli.train import read_input
+from photon_ml_tpu.utils import setup_logging, timed
+
+
+def run(
+    model_dir: str,
+    input_spec: Mapping,
+    output_path: Optional[str] = None,
+    evaluators: Sequence[str] = (),
+    model_id: str = "",
+) -> dict:
+    import os
+
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.data.model_store import load_game_model
+    from photon_ml_tpu.evaluation import EVALUATORS
+
+    # reuse the TRAINING feature space saved next to the model, so feature
+    # ids line up with the stored coefficients (prepareFeatureMaps analog)
+    index_maps = None
+    idx_dir = os.path.join(model_dir, "feature-indexes")
+    if os.path.isdir(idx_dir):
+        index_maps = {
+            shard: IndexMap.load(os.path.join(idx_dir, shard))
+            for shard in sorted(os.listdir(idx_dir))
+        }
+
+    with timed("read scoring data"):
+        data, _ = read_input(
+            input_spec, is_response_required=False, index_maps=index_maps
+        )
+    with timed("load model"):
+        model = load_game_model(model_dir)
+    with timed("score"):
+        raw = np.asarray(model.score(data))[: data.num_rows]
+    # saved scores include the offset (scoring Driver.scala:139-146)
+    scores = raw + data.offset
+
+    if output_path is not None:
+        from photon_ml_tpu.data.avro import write_scoring_results
+
+        with timed("save scores"):
+            write_scoring_results(
+                output_path,
+                scores,
+                model_id=model_id,
+                labels=data.response,
+                weights=data.weight,
+            )
+
+    metrics = {}
+    for name in evaluators:
+        fn = EVALUATORS.get(name)
+        if fn is None:
+            raise ValueError(f"unknown evaluator '{name}'")
+        metrics[name] = float(
+            fn(
+                np.asarray(scores, np.float32),
+                np.asarray(data.response, np.float32),
+                np.asarray(data.weight, np.float32),
+            )
+        )
+
+    return {
+        "num_rows": data.num_rows,
+        "output": output_path,
+        "metrics": metrics,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli score", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("--model-dir", required=True, help="saved GAME model dir")
+    parser.add_argument("--config", required=True, help="JSON config with input block")
+    parser.add_argument("--output", help="ScoringResultAvro output path")
+    parser.add_argument("--evaluators", nargs="*", default=[])
+    parser.add_argument("--model-id", default="")
+    args = parser.parse_args(argv)
+
+    setup_logging()
+    with open(args.config) as f:
+        config = json.load(f)
+    input_spec = config["input"] if "input" in config else config
+    summary = run(
+        args.model_dir,
+        input_spec,
+        output_path=args.output,
+        evaluators=args.evaluators,
+        model_id=args.model_id,
+    )
+    print(json.dumps(summary, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

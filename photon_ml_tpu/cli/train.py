@@ -1,0 +1,168 @@
+"""GAME training driver.
+
+Reference analog: photon-client cli/game/training/Driver.scala:58-87 — the
+staged run (prepare features -> read train/validation -> stats ->
+normalization -> GameEstimator.fit -> select best -> save) becomes one
+timed pipeline driven by a JSON config:
+
+    python -m photon_ml_tpu.cli train --config train.json
+
+Config document (coordinates order = updating sequence):
+
+    {
+      "task": "logistic",
+      "input": {"format": "avro", "paths": ["train/"],
+                "feature_shards": {"global": ["features"]},
+                "id_columns": ["userId"], "add_intercept": true},
+      "validation": {"paths": ["validate/"]},
+      "coordinates": {"fixed": {"type": "fixed_effect",
+                                "shard_name": "global",
+                                "optimizer": {"regularization": "l2",
+                                               "regularization_weight": 1.0}}},
+      "num_iterations": 1,
+      "evaluators": ["auc"],
+      "output_dir": "out/model"
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Mapping, Optional
+
+import numpy as np
+
+from photon_ml_tpu.config import parse_game_config
+from photon_ml_tpu.game.dataset import GameDataset, build_game_dataset
+from photon_ml_tpu.game.estimator import GameEstimator
+from photon_ml_tpu.utils import setup_logging, timed
+
+
+def read_input(
+    spec: Mapping,
+    is_response_required: bool = True,
+    index_maps: Optional[Mapping] = None,
+) -> tuple[GameDataset, Optional[Mapping]]:
+    """Materialize a GameDataset from an input spec ({format, paths, ...}).
+
+    Returns (dataset, index_maps). For Avro, ``index_maps`` (per shard) pin
+    the feature space — REQUIRED at scoring time so ids match training
+    (the reference ships PalDB index maps next to the model for exactly
+    this, cli/game/GAMEDriver prepareFeatureMaps); built by scanning when
+    absent and returned so the training driver can persist them.
+    """
+    spec = dict(spec)
+    fmt = spec.pop("format", "avro")
+    paths = spec.pop("paths")
+    if fmt == "avro":
+        from photon_ml_tpu.data.avro import (
+            build_index_map_from_avro,
+            read_game_dataset_from_avro,
+        )
+
+        shards = spec.pop("feature_shards", None)
+        shards = {
+            k: tuple(v) for k, v in (shards or {"features": ("features",)}).items()
+        }
+        add_intercept = bool(spec.pop("add_intercept", True))
+        if index_maps is None:
+            index_maps = {
+                shard: build_index_map_from_avro(
+                    paths, bags, add_intercept=add_intercept
+                )
+                for shard, bags in shards.items()
+            }
+        data = read_game_dataset_from_avro(
+            paths,
+            feature_shards=shards,
+            index_maps=index_maps,
+            id_columns=tuple(spec.pop("id_columns", ())),
+            add_intercept=add_intercept,
+            is_response_required=is_response_required,
+        )
+        return data, index_maps
+    if fmt == "libsvm":
+        from photon_ml_tpu.data.libsvm import read_libsvm
+
+        if isinstance(paths, (list, tuple)):
+            if len(paths) != 1:
+                raise ValueError("libsvm input takes exactly one path")
+            paths = paths[0]
+        lib = read_libsvm(paths)
+        batch = lib.to_batch(add_intercept=bool(spec.pop("add_intercept", True)))
+        labels = np.asarray(lib.labels)
+        if spec.pop("binarize_labels", True):
+            labels = (labels > 0).astype(np.float64)
+        shard = spec.pop("shard_name", "features")
+        return (
+            build_game_dataset(response=labels, feature_shards={shard: batch}),
+            None,
+        )
+    raise ValueError(f"unknown input format '{fmt}'")
+
+
+def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
+    """Execute the training pipeline; returns a JSON-safe summary."""
+    game_config = parse_game_config(config)
+    output_dir = output_dir or config.get("output_dir")
+
+    with timed("read training data"):
+        train_data, index_maps = read_input(config["input"])
+    validation_data = None
+    if config.get("validation"):
+        with timed("read validation data"):
+            vspec = {**config["input"], **config["validation"]}
+            # validation shares the TRAINING feature space
+            validation_data, _ = read_input(vspec, index_maps=index_maps)
+
+    estimator = GameEstimator(game_config)
+    with timed("fit"):
+        result = estimator.fit(
+            train_data,
+            validation_data=validation_data,
+            output_dir=output_dir,
+        )
+
+    if output_dir is not None and index_maps is not None:
+        # persist the feature space next to the models so scoring reproduces
+        # training-time feature ids (prepareFeatureMaps / PalDB analog)
+        import os
+
+        with timed("save index maps"):
+            for shard, imap in index_maps.items():
+                for sub in ("final", "best"):
+                    imap.save(
+                        os.path.join(output_dir, sub, "feature-indexes", shard)
+                    )
+
+    summary = {
+        "output_dir": output_dir,
+        "best_metric": result.best_metric,
+        "num_rows": train_data.num_rows,
+        "history": [
+            {k: v for k, v in entry.items()}
+            for entry in result.history
+        ],
+    }
+    return summary
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli train", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("--config", required=True, help="JSON config path")
+    parser.add_argument("--output-dir", help="override config output_dir")
+    args = parser.parse_args(argv)
+
+    setup_logging()
+    with open(args.config) as f:
+        config = json.load(f)
+    summary = run(config, output_dir=args.output_dir)
+    print(json.dumps(summary, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,113 @@
+"""JSON config parsing: the parse-side of the typed config system.
+
+Reference analog: photon-client's scopt flag parsers — GameParams
+(estimators/GameParams.scala:252-492) with its per-coordinate mini-DSL
+strings, and the legacy PhotonMLCmdLineParser. One JSON document replaces
+both (SURVEY.md §5 "Config / flag system"): it names the input data, the
+coordinates (updating-sequence order preserved from the JSON object order),
+their optimizers, evaluators, and output. `game_config_to_json` inverts the
+parse so saved model metadata can be re-parsed into a runnable config.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Mapping, Optional
+
+from photon_ml_tpu.game.estimator import (
+    FactoredRandomEffectConfig,
+    FixedEffectConfig,
+    GameConfig,
+    RandomEffectConfig,
+    _config_metadata,
+)
+from photon_ml_tpu.optim.factory import (
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+)
+
+
+def parse_optimizer_config(obj: Optional[Mapping]) -> OptimizerConfig:
+    """Parse the JSON optimizer spec (GLMOptimizationConfiguration analog:
+    the reference string DSL `maxIter,tol,lambda,downSample,optType,regType`
+    becomes named fields)."""
+    obj = dict(obj or {})
+    reg_type = RegularizationType(obj.pop("regularization", "none"))
+    reg = RegularizationContext(reg_type, alpha=float(obj.pop("alpha", 1.0)))
+    known = {
+        "type": ("optimizer_type", lambda v: OptimizerType(v)),
+        "max_iterations": ("max_iterations", int),
+        "tolerance": ("tolerance", float),
+        "regularization_weight": ("regularization_weight", float),
+        "lbfgs_history": ("lbfgs_history", int),
+        "down_sampling_rate": ("down_sampling_rate", float),
+    }
+    kwargs = {}
+    for key, (field, conv) in known.items():
+        if key in obj:
+            kwargs[field] = conv(obj.pop(key))
+    if obj:
+        raise ValueError(f"unknown optimizer config keys: {sorted(obj)}")
+    return OptimizerConfig(regularization=reg, **kwargs)
+
+
+def parse_coordinate_config(obj: Mapping):
+    obj = dict(obj)
+    ctype = obj.pop("type", "fixed_effect")
+    if ctype == "fixed_effect":
+        return FixedEffectConfig(
+            shard_name=obj.pop("shard_name"),
+            optimizer=parse_optimizer_config(obj.pop("optimizer", None)),
+            normalization=obj.pop("normalization", "none"),
+            intercept_index=obj.pop("intercept_index", None),
+            down_sampling_seed=int(obj.pop("down_sampling_seed", 0)),
+            layout=obj.pop("layout", "auto"),
+        )
+    if ctype == "random_effect":
+        return RandomEffectConfig(
+            shard_name=obj.pop("shard_name"),
+            id_name=obj.pop("id_name"),
+            optimizer=parse_optimizer_config(obj.pop("optimizer", None)),
+            active_rows_per_entity=obj.pop("active_rows_per_entity", None),
+            min_rows_per_entity=int(obj.pop("min_rows_per_entity", 1)),
+        )
+    if ctype == "factored_random_effect":
+        return FactoredRandomEffectConfig(
+            shard_name=obj.pop("shard_name"),
+            id_name=obj.pop("id_name"),
+            latent_dim=int(obj.pop("latent_dim")),
+            mf_iterations=int(obj.pop("mf_iterations", 1)),
+            re_optimizer=parse_optimizer_config(obj.pop("optimizer", None)),
+            latent_optimizer=parse_optimizer_config(
+                obj.pop("latent_optimizer", None)
+            ),
+            active_rows_per_entity=obj.pop("active_rows_per_entity", None),
+            min_rows_per_entity=int(obj.pop("min_rows_per_entity", 1)),
+            seed=int(obj.pop("seed", 0)),
+        )
+    raise ValueError(f"unknown coordinate type '{ctype}'")
+
+
+def parse_game_config(obj: Mapping | str) -> GameConfig:
+    """Parse a GameConfig from a JSON document (dict or JSON string).
+
+    JSON object order of "coordinates" IS the updating sequence."""
+    if isinstance(obj, str):
+        obj = json.loads(obj)
+    coords = {
+        name: parse_coordinate_config(c)
+        for name, c in obj.get("coordinates", {}).items()
+    }
+    return GameConfig(
+        task=obj["task"],
+        coordinates=coords,
+        num_iterations=int(obj.get("num_iterations", 1)),
+        evaluators=tuple(obj.get("evaluators", ())),
+    )
+
+
+def game_config_to_json(config: GameConfig) -> dict:
+    """Inverse of parse_game_config (round-trips through model metadata)."""
+    return _config_metadata(config)

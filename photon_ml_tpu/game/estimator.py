@@ -269,7 +269,9 @@ def _config_metadata(config: GameConfig) -> dict:
             "max_iterations": opt.max_iterations,
             "tolerance": opt.tolerance,
             "regularization": str(opt.regularization.reg_type.value),
+            "alpha": opt.regularization.alpha,
             "regularization_weight": opt.regularization_weight,
+            "lbfgs_history": opt.lbfgs_history,
             "down_sampling_rate": opt.down_sampling_rate,
         }
 
@@ -291,6 +293,8 @@ def _config_metadata(config: GameConfig) -> dict:
         else:
             out["type"] = "fixed_effect"
             out["normalization"] = str(NormalizationType(c.normalization).value)
+            out["intercept_index"] = c.intercept_index
+            out["layout"] = c.layout
             out["optimizer"] = describe_opt(c.optimizer)
         return out
 

@@ -98,8 +98,14 @@ class FactoredRandomEffectModel:
         f = jnp.asarray(flat_of_row[rows[live]], jnp.int32)
 
         c = self.latent[jnp.maximum(f, 0)]  # [m, K]
-        a = self.projection.matrix.T[g]  # [m, K]
-        contrib = jnp.where(f >= 0, v * jnp.sum(c * a, axis=1), 0.0)
+        # features beyond the training dimension score 0 (a scoring shard's
+        # vocabulary may be larger than training's; clamped gathers would
+        # otherwise alias them onto the last training column)
+        known = g < self.projection.original_dim
+        a = self.projection.matrix.T[jnp.minimum(g, self.projection.original_dim - 1)]
+        contrib = jnp.where(
+            (f >= 0) & known, v * jnp.sum(c * a, axis=1), 0.0
+        )
         return jnp.zeros((batch.num_rows,), batch.dtype).at[r].add(contrib)
 
     def effective_coefficients(self, entity_value) -> Optional[Array]:
@@ -214,7 +220,6 @@ class FactoredRandomEffectCoordinate:
         buckets = self.re_data.buckets
         self._batch = self.data.shard(self.re_data.shard_name)
         n_pad = self._batch.num_rows
-        n = self.data.num_rows
 
         # flat latent-table layout: bucket entities concatenated in order
         sizes = [b.num_entities for b in buckets]
@@ -271,12 +276,10 @@ class FactoredRandomEffectCoordinate:
             off[ri[valid]] = np.asarray(b.offsets)[valid]
         self._base_offsets = off
 
-        # order nnz by row for segment-sum friendliness
+        # order nnz by row for segment-sum friendliness; the permutation to
+        # apply to freshly-computed kron values is exactly this sort order
         o = np.argsort(kron_rows, kind="stable")
-        self._kron_perm = jnp.asarray(
-            (np.arange(m)[:, None] * k + np.arange(k)[None, :]).reshape(-1)[o],
-            jnp.int32,
-        )
+        self._kron_perm = jnp.asarray(o, jnp.int32)
         self._num_kron_features = d * k
 
         key_re = dataclasses.replace(self.re_config, regularization_weight=0.0)
@@ -355,9 +358,13 @@ class FactoredRandomEffectCoordinate:
             out[: len(a)] = a
             return jnp.asarray(out.reshape(n_dev, rows_per), self._batch.dtype)
 
+        from photon_ml_tpu.parallel.mesh import put_sharded
+
         self._stacked_rows_per = rows_per
         self._stacked_idx = jnp.asarray(idx_map, jnp.int32)
-        self._stacked_template = SparseBatch(
+        # place each shard's static block on its device once (the
+        # FixedEffectCoordinate put_sharded pattern); refits only move values
+        stacked_host = SparseBatch(
             values=jnp.zeros((n_dev, nnz_max), self._batch.dtype),
             rows=jnp.asarray(srows),
             cols=jnp.asarray(scols),
@@ -366,6 +373,7 @@ class FactoredRandomEffectCoordinate:
             weights=rowwise(wgt),
             num_features=self._num_kron_features,
         )
+        self._stacked_template = put_sharded(stacked_host, self.mesh, self._axis)
 
     # -- model plumbing ------------------------------------------------------
 

@@ -1,0 +1,1 @@
+from photon_ml_tpu.utils.timing import Timer, logger, setup_logging, timed  # noqa: F401

@@ -1,0 +1,61 @@
+"""Phase timers and logging setup.
+
+Reference analog: photon-lib util/Timed.scala:33-77 (named duration blocks
+logged around every driver phase, cli/game/training/Driver.scala:60-86) and
+util/Timer.scala; PhotonLogger's role (SLF4J to HDFS) collapses to stdlib
+logging configured once per process.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+logger = logging.getLogger("photon_ml_tpu")
+
+
+def setup_logging(level: int = logging.INFO, log_file: Optional[str] = None) -> None:
+    """Configure the photon_ml_tpu logger tree (PhotonLogger analog)."""
+    handler: logging.Handler
+    if log_file is not None:
+        handler = logging.FileHandler(log_file)
+    else:
+        handler = logging.StreamHandler()
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+    )
+    root = logging.getLogger("photon_ml_tpu")
+    root.setLevel(level)
+    root.addHandler(handler)
+
+
+class Timer:
+    """Simple stopwatch (util/Timer.scala analog)."""
+
+    def __init__(self):
+        self._start: Optional[float] = None
+        self.seconds: float = 0.0
+
+    def start(self) -> "Timer":
+        self._start = time.time()
+        return self
+
+    def stop(self) -> float:
+        if self._start is None:
+            raise RuntimeError("Timer.stop() before start()")
+        self.seconds = time.time() - self._start
+        self._start = None
+        return self.seconds
+
+
+@contextmanager
+def timed(name: str, log: logging.Logger = logger) -> Iterator[Timer]:
+    """Log the wall-clock duration of a named phase (Timed.scala analog)."""
+    t = Timer().start()
+    try:
+        yield t
+    finally:
+        t.stop()
+        log.info("%s: %.3fs", name, t.seconds)

@@ -1,0 +1,256 @@
+"""Avro codec + Photon wire formats: binary round-trips (both codecs),
+TrainingExampleAvro -> GameDataset ingestion with shard merging and index
+maps, LibSVM->Avro->train round-trip, model/score egress."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.avro import (
+    BAYESIAN_LINEAR_MODEL_AVRO,
+    TRAINING_EXAMPLE_AVRO,
+    build_index_map_from_avro,
+    read_avro,
+    read_bayesian_linear_model,
+    read_game_dataset_from_avro,
+    read_scoring_results,
+    write_avro,
+    write_bayesian_linear_model,
+    write_scoring_results,
+    write_training_examples,
+)
+from photon_ml_tpu.data.index_map import INTERCEPT_KEY, IndexMap, feature_key
+from photon_ml_tpu.game import build_game_dataset
+from photon_ml_tpu.ops.sparse import SparseBatch
+
+
+def _example(i, features, user=None):
+    rec = {
+        "uid": str(i),
+        "label": float(i % 2),
+        "features": [
+            {"name": n, "term": t, "value": float(v)} for n, t, v in features
+        ],
+        "metadataMap": {"userId": str(user)} if user is not None else None,
+        "weight": 1.0 + 0.1 * i,
+        "offset": 0.5 * i,
+    }
+    return rec
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_container_round_trip(tmp_path, codec):
+    recs = [
+        _example(i, [("f", str(j), (i + 1) * (j + 1)) for j in range(i % 4)],
+                 user=i % 3)
+        for i in range(257)  # crosses a block boundary with block_records=100
+    ]
+    p = str(tmp_path / "t.avro")
+    n = write_avro(p, TRAINING_EXAMPLE_AVRO, recs, codec=codec,
+                   block_records=100)
+    assert n == 257
+    back = list(read_avro(p))
+    assert back == recs
+
+
+def test_varint_edge_values(tmp_path):
+    schema = {
+        "name": "E",
+        "type": "record",
+        "fields": [
+            {"name": "l", "type": "long"},
+            {"name": "d", "type": "double"},
+            {"name": "s", "type": "string"},
+            {"name": "b", "type": "boolean"},
+            {"name": "u", "type": ["null", "long"]},
+        ],
+    }
+    vals = [0, -1, 1, 63, -64, 64, -65, 2**31, -(2**31), 2**62, -(2**62)]
+    recs = [
+        {"l": v, "d": v * 1.5, "s": f"v{v}", "b": v % 2 == 0,
+         "u": None if v % 3 == 0 else v}
+        for v in vals
+    ]
+    p = str(tmp_path / "e.avro")
+    write_avro(p, schema, recs)
+    assert list(read_avro(p)) == recs
+
+
+def test_read_game_dataset_with_shard_merging(tmp_path):
+    # two feature bags merged into one shard + a separate shard
+    schema = dict(TRAINING_EXAMPLE_AVRO)
+    schema = {
+        **schema,
+        "fields": schema["fields"]
+        + [
+            {
+                "name": "userFeatures",
+                "type": {"type": "array", "items": "FeatureAvro"},
+                "default": [],
+            }
+        ],
+    }
+    recs = []
+    for i in range(6):
+        rec = _example(i, [("g", "a", i + 1), ("g", "b", 2 * i + 1)], user=i % 2)
+        rec["userFeatures"] = [{"name": "u", "term": "x", "value": float(i)}]
+        recs.append(rec)
+    p = str(tmp_path / "m.avro")
+    write_avro(p, schema, recs)
+
+    data = read_game_dataset_from_avro(
+        p,
+        feature_shards={"global": ("features", "userFeatures"), "user": ("userFeatures",)},
+        id_columns=["userId"],
+    )
+    assert data.num_rows == 6
+    # global shard merged both bags: g|a, g|b, u|x + intercept = 4 features
+    assert data.shard("global").num_features == 4
+    assert data.shard("user").num_features == 2  # u|x + intercept
+    np.testing.assert_allclose(data.offset, 0.5 * np.arange(6))
+    np.testing.assert_allclose(data.weight, 1.0 + 0.1 * np.arange(6))
+    assert data.id_columns["userId"].num_entities == 2
+    # dense reconstruction of the user shard: value i in u|x + intercept 1
+    ub = data.shard("user")
+    vals = np.asarray(ub.values)
+    assert vals[vals != 0].sum() == pytest.approx(sum(range(6)) + 6)
+
+
+def test_unknown_features_dropped(tmp_path):
+    p = str(tmp_path / "d.avro")
+    write_avro(
+        p,
+        TRAINING_EXAMPLE_AVRO,
+        [_example(i, [("known", "", 1.0), ("unknown", "", 9.0)]) for i in range(3)],
+    )
+    imap = IndexMap([feature_key("known", ""), INTERCEPT_KEY])
+    data = read_game_dataset_from_avro(
+        p, feature_shards={"f": ("features",)}, index_maps={"f": imap}
+    )
+    vals = np.asarray(data.shard("f").values)
+    # per row: known=1.0 + intercept=1.0; the 9.0s are dropped
+    assert vals.sum() == pytest.approx(6.0)
+
+
+def test_libsvm_avro_round_trip_trains(rng, tmp_path):
+    """LibSVM fixture -> GameDataset -> Avro -> GameDataset -> train; the
+    re-read dataset must produce the same fit (dev-scripts
+    libsvm_text_to_trainingexample_avro.py analog path)."""
+    from photon_ml_tpu.data.libsvm import read_libsvm
+    from photon_ml_tpu.training import train_glm
+    from photon_ml_tpu.optim import OptimizerConfig
+
+    # synthesize a small libsvm file
+    lines = []
+    n, d = 80, 10
+    X = (rng.random((n, d)) < 0.4) * rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = np.sign(X @ w + 0.1 * rng.normal(size=n))
+    for i in range(n):
+        feats = " ".join(
+            f"{j + 1}:{X[i, j]:.6f}" for j in np.nonzero(X[i])[0]
+        )
+        lines.append(f"{int(y[i])} {feats}")
+    p = tmp_path / "a1a.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+
+    lib = read_libsvm(str(p))
+    batch = lib.to_batch(add_intercept=True)
+    labels01 = (np.asarray(lib.labels) > 0).astype(float)
+    data = build_game_dataset(
+        response=labels01,
+        feature_shards={"f": batch},
+    )
+    imap = IndexMap(
+        [feature_key(str(j), "") for j in range(d)] + [INTERCEPT_KEY]
+    )
+    avro_path = str(tmp_path / "a1a.avro")
+    n_written = write_training_examples(avro_path, data, "f", imap)
+    assert n_written == n
+
+    data2 = read_game_dataset_from_avro(
+        avro_path, feature_shards={"f": ("features",)}, index_maps={"f": imap}
+    )
+    cfg = OptimizerConfig()
+    e1 = train_glm(data.batch_for("f"), "logistic", [0.1], cfg)[0]
+    e2 = train_glm(data2.batch_for("f"), "logistic", [0.1], cfg)[0]
+    np.testing.assert_allclose(
+        e1.model.coefficients.means, e2.model.coefficients.means,
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_estimator_trains_from_avro_end_to_end(rng, tmp_path):
+    from photon_ml_tpu.game import FixedEffectConfig, GameConfig, GameEstimator
+    from photon_ml_tpu.optim import OptimizerConfig
+
+    n, d = 100, 6
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
+    recs = [
+        _example(
+            i,
+            [(f"c{j}", "", X[i, j]) for j in range(d)],
+            user=i % 5,
+        )
+        for i in range(n)
+    ]
+    for i, r in enumerate(recs):
+        r["label"] = float(y[i])
+        r["weight"] = None
+        r["offset"] = None
+    p = str(tmp_path / "train.avro")
+    write_avro(p, TRAINING_EXAMPLE_AVRO, recs)
+
+    data = read_game_dataset_from_avro(p, id_columns=["userId"])
+    cfg = GameConfig(
+        task="logistic",
+        coordinates={"fixed": FixedEffectConfig(shard_name="features")},
+    )
+    result = GameEstimator(cfg).fit(data, output_dir=str(tmp_path / "model"))
+    scores = np.asarray(result.model.score(data))[:n]
+    acc = np.mean((scores > 0) == (y > 0.5))
+    assert acc > 0.8
+
+
+def test_model_export_import_avro(rng, tmp_path):
+    imap = IndexMap.build(
+        [feature_key("f", str(j)) for j in range(12)], add_intercept=True
+    )
+    means = rng.normal(size=len(imap))
+    means[3] = 0.0  # sparse representation drops zeros
+    variances = np.abs(rng.normal(size=len(imap))) + 0.1
+    p = str(tmp_path / "model.avro")
+    write_bayesian_linear_model(
+        p, means, imap, model_id="m1", variances=variances,
+        loss_function="logistic",
+    )
+    m2, v2, meta = read_bayesian_linear_model(p, imap)
+    np.testing.assert_allclose(m2, means, rtol=1e-12)
+    np.testing.assert_allclose(v2, variances, rtol=1e-12)
+    assert meta["modelId"] == "m1"
+    assert meta["lossFunction"] == "logistic"
+
+
+def test_scoring_results_round_trip(tmp_path):
+    scores = np.asarray([0.1, -2.5, 3.25])
+    labels = np.asarray([1.0, 0.0, 1.0])
+    p = str(tmp_path / "scores.avro")
+    n = write_scoring_results(p, scores, model_id="best", labels=labels)
+    assert n == 3
+    recs = read_scoring_results(p)
+    np.testing.assert_allclose([r["predictionScore"] for r in recs], scores)
+    np.testing.assert_allclose([r["label"] for r in recs], labels)
+    assert all(r["modelId"] == "best" for r in recs)
+
+
+def test_build_index_map_from_avro(tmp_path):
+    p = str(tmp_path / "x.avro")
+    write_avro(
+        p,
+        TRAINING_EXAMPLE_AVRO,
+        [_example(i, [("n", str(i % 3), 1.0)]) for i in range(9)],
+    )
+    imap = build_index_map_from_avro(p)
+    assert len(imap) == 4  # 3 terms + intercept
+    assert imap.get(INTERCEPT_KEY) >= 0

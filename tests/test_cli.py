@@ -1,0 +1,204 @@
+"""CLI drivers: config parsing round-trip and a subprocess end-to-end
+train -> save -> score pipeline over Avro files."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.config import (
+    game_config_to_json,
+    parse_game_config,
+    parse_optimizer_config,
+)
+from photon_ml_tpu.data.avro import TRAINING_EXAMPLE_AVRO, write_avro
+from photon_ml_tpu.game.estimator import (
+    FactoredRandomEffectConfig,
+    FixedEffectConfig,
+    RandomEffectConfig,
+)
+from photon_ml_tpu.optim import OptimizerType, RegularizationType
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_optimizer_config():
+    cfg = parse_optimizer_config(
+        {
+            "type": "tron",
+            "max_iterations": 15,
+            "tolerance": 1e-5,
+            "regularization": "l2",
+            "regularization_weight": 2.5,
+        }
+    )
+    assert cfg.optimizer_type == OptimizerType.TRON
+    assert cfg.max_iterations == 15
+    assert cfg.regularization.reg_type == RegularizationType.L2
+    assert cfg.regularization_weight == 2.5
+    with pytest.raises(ValueError, match="unknown optimizer config keys"):
+        parse_optimizer_config({"max_iter": 3})
+
+
+def test_parse_game_config_round_trip():
+    doc = {
+        "task": "logistic",
+        "num_iterations": 2,
+        "evaluators": ["auc", "rmse"],
+        "coordinates": {
+            "fixed": {
+                "type": "fixed_effect",
+                "shard_name": "global",
+                "normalization": "standardization",
+                "intercept_index": 0,
+                "optimizer": {"regularization": "l2", "regularization_weight": 1.0},
+            },
+            "perUser": {
+                "type": "random_effect",
+                "shard_name": "user",
+                "id_name": "userId",
+                "active_rows_per_entity": 64,
+            },
+            "mf": {
+                "type": "factored_random_effect",
+                "shard_name": "user",
+                "id_name": "userId",
+                "latent_dim": 4,
+                "mf_iterations": 2,
+            },
+        },
+    }
+    cfg = parse_game_config(doc)
+    assert list(cfg.coordinates) == ["fixed", "perUser", "mf"]  # order kept
+    assert isinstance(cfg.coordinates["fixed"], FixedEffectConfig)
+    assert isinstance(cfg.coordinates["perUser"], RandomEffectConfig)
+    assert isinstance(cfg.coordinates["mf"], FactoredRandomEffectConfig)
+    assert cfg.coordinates["mf"].latent_dim == 4
+    # JSON metadata re-parses to an equivalent config
+    cfg2 = parse_game_config(game_config_to_json(cfg))
+    assert cfg2 == cfg
+
+
+@pytest.fixture(scope="module")
+def avro_dataset(tmp_path_factory):
+    rng = np.random.default_rng(99)
+    tmp = tmp_path_factory.mktemp("cli")
+    n, d, n_users = 240, 8, 6
+    X = rng.normal(size=(n, d))
+    users = rng.integers(0, n_users, n)
+    w = rng.normal(size=d)
+    u_eff = rng.normal(size=n_users)
+    logits = X @ w + u_eff[users]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(float)
+
+    def recs(lo, hi):
+        for i in range(lo, hi):
+            yield {
+                "uid": str(i),
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"c{j}", "term": "", "value": float(X[i, j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {"userId": str(users[i])},
+                "weight": None,
+                "offset": None,
+            }
+
+    train_path = str(tmp / "train.avro")
+    score_path = str(tmp / "holdout.avro")
+    write_avro(train_path, TRAINING_EXAMPLE_AVRO, recs(0, 200))
+    write_avro(score_path, TRAINING_EXAMPLE_AVRO, recs(200, 240))
+    return tmp, train_path, score_path
+
+
+def _run_cli(args, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli", *args],
+        capture_output=True,
+        text=True,
+        cwd=str(cwd),
+        env=env,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_cli_train_save_score_end_to_end(avro_dataset):
+    tmp, train_path, score_path = avro_dataset
+    config = {
+        "task": "logistic",
+        "input": {
+            "format": "avro",
+            "paths": [train_path],
+            "feature_shards": {"global": ["features"]},
+            "id_columns": ["userId"],
+        },
+        "coordinates": {
+            "fixed": {
+                "type": "fixed_effect",
+                "shard_name": "global",
+                "optimizer": {
+                    "regularization": "l2",
+                    "regularization_weight": 0.1,
+                },
+            },
+            "perUser": {
+                "type": "random_effect",
+                "shard_name": "global",
+                "id_name": "userId",
+                "optimizer": {
+                    "regularization": "l2",
+                    "regularization_weight": 1.0,
+                },
+            },
+        },
+        "num_iterations": 1,
+        "output_dir": str(tmp / "model"),
+    }
+    cfg_path = tmp / "train.json"
+    cfg_path.write_text(json.dumps(config))
+
+    summary = _run_cli(["train", "--config", str(cfg_path)], cwd=tmp)
+    assert summary["num_rows"] == 200
+    assert os.path.exists(tmp / "model" / "final" / "model-metadata.json")
+    assert os.path.exists(tmp / "model" / "best" / "model-metadata.json")
+
+    # the model dir carries the training feature index maps, so scoring a
+    # NEW file reproduces training-time feature ids (prepareFeatureMaps)
+    assert os.path.isdir(tmp / "model" / "final" / "feature-indexes" / "global")
+    score_cfg = {
+        "input": {
+            "format": "avro",
+            "paths": [score_path],
+            "feature_shards": {"global": ["features"]},
+            "id_columns": ["userId"],
+        }
+    }
+    score_cfg_path = tmp / "score.json"
+    score_cfg_path.write_text(json.dumps(score_cfg))
+    out_path = str(tmp / "scores.avro")
+    summary = _run_cli(
+        [
+            "score",
+            "--model-dir", str(tmp / "model" / "final"),
+            "--config", str(score_cfg_path),
+            "--output", out_path,
+            "--evaluators", "auc", "logistic_loss",
+        ],
+        cwd=tmp,
+    )
+    assert summary["num_rows"] == 40
+    assert summary["metrics"]["auc"] > 0.6  # true holdout
+    from photon_ml_tpu.data.avro import read_scoring_results
+
+    recs = read_scoring_results(out_path)
+    assert len(recs) == 40
+    assert all(np.isfinite(r["predictionScore"]) for r in recs)
