@@ -19,7 +19,7 @@ from typing import Mapping, Optional, Sequence
 import numpy as np
 
 from photon_ml_tpu.cli.train import read_input
-from photon_ml_tpu.utils import setup_logging, timed
+from photon_ml_tpu.utils import logger, setup_logging, timed
 
 
 def run(
@@ -44,6 +44,13 @@ def run(
             shard: IndexMap.load(os.path.join(idx_dir, shard))
             for shard in sorted(os.listdir(idx_dir))
         }
+    else:
+        logger.warning(
+            "%s has no feature-indexes/: index maps will be rebuilt by "
+            "scanning the SCORING data — feature ids may not match the "
+            "stored coefficients and scores may be silently wrong",
+            model_dir,
+        )
 
     with timed("read scoring data"):
         data, _ = read_input(
@@ -69,6 +76,12 @@ def run(
             )
 
     metrics = {}
+    if evaluators and len(np.unique(data.response)) < 2:
+        logger.warning(
+            "scoring data has a constant response column (%s) — requested "
+            "evaluator metrics will be meaningless placeholders",
+            data.response[0] if data.num_rows else "empty",
+        )
     for name in evaluators:
         fn = EVALUATORS.get(name)
         if fn is None:

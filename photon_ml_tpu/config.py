@@ -57,7 +57,7 @@ def parse_coordinate_config(obj: Mapping):
     obj = dict(obj)
     ctype = obj.pop("type", "fixed_effect")
     if ctype == "fixed_effect":
-        return FixedEffectConfig(
+        out = FixedEffectConfig(
             shard_name=obj.pop("shard_name"),
             optimizer=parse_optimizer_config(obj.pop("optimizer", None)),
             normalization=obj.pop("normalization", "none"),
@@ -65,16 +65,16 @@ def parse_coordinate_config(obj: Mapping):
             down_sampling_seed=int(obj.pop("down_sampling_seed", 0)),
             layout=obj.pop("layout", "auto"),
         )
-    if ctype == "random_effect":
-        return RandomEffectConfig(
+    elif ctype == "random_effect":
+        out = RandomEffectConfig(
             shard_name=obj.pop("shard_name"),
             id_name=obj.pop("id_name"),
             optimizer=parse_optimizer_config(obj.pop("optimizer", None)),
             active_rows_per_entity=obj.pop("active_rows_per_entity", None),
             min_rows_per_entity=int(obj.pop("min_rows_per_entity", 1)),
         )
-    if ctype == "factored_random_effect":
-        return FactoredRandomEffectConfig(
+    elif ctype == "factored_random_effect":
+        out = FactoredRandomEffectConfig(
             shard_name=obj.pop("shard_name"),
             id_name=obj.pop("id_name"),
             latent_dim=int(obj.pop("latent_dim")),
@@ -87,7 +87,13 @@ def parse_coordinate_config(obj: Mapping):
             min_rows_per_entity=int(obj.pop("min_rows_per_entity", 1)),
             seed=int(obj.pop("seed", 0)),
         )
-    raise ValueError(f"unknown coordinate type '{ctype}'")
+    else:
+        raise ValueError(f"unknown coordinate type '{ctype}'")
+    if obj:  # typos must not silently train with defaults
+        raise ValueError(
+            f"unknown keys in {ctype} coordinate config: {sorted(obj)}"
+        )
+    return out
 
 
 def parse_game_config(obj: Mapping | str) -> GameConfig:

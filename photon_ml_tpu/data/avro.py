@@ -497,11 +497,15 @@ def read_game_dataset_from_avro(
                     raise ValueError(f"{path}: record {row} has no label")
                 label = 0.0
             labels.append(float(label))
-            offsets.append(float(rec.get("offset") or 0.0))
-            weights.append(float(rec.get("weight") or 1.0))
+            off = rec.get("offset")
+            offsets.append(0.0 if off is None else float(off))
+            wgt = rec.get("weight")  # explicit 0.0 weights must survive
+            weights.append(1.0 if wgt is None else float(wgt))
             meta = rec.get("metadataMap") or {}
             for c in id_columns:
-                v = rec.get(c, meta.get(c))
+                v = rec.get(c)
+                if v is None:  # absent OR null top-level field -> metadataMap
+                    v = meta.get(c)
                 if v is None:
                     raise KeyError(
                         f"{path}: record {row} lacks id column '{c}' "

@@ -1,0 +1,176 @@
+"""Bootstrap training: per-coefficient confidence intervals and metric
+distributions from resampled refits.
+
+Reference analog: photon-diagnostics BootstrapTraining.scala:30-181 and
+supervised/model/CoefficientSummary.scala. The reference tags rows into
+1000 splits and filters RDDs per bootstrap sample; TPU-first, each sample
+is a WEIGHT VECTOR (multinomial resample counts over the training portion,
+0 on the holdout) and all B refits run as ONE vmapped jit-compiled solve —
+same shapes, no data movement, B-way parallel on the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+from typing import Callable, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.diagnostics.evaluation import evaluate
+from photon_ml_tpu.models.glm import make_model
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optim.adapter import glm_adapter
+from photon_ml_tpu.optim.factory import OptimizerConfig, dispatch_solve
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class CoefficientSummary:
+    """Per-scalar accumulation summary (CoefficientSummary.scala analog:
+    count/mean/stddev/min/max + quartile estimates)."""
+
+    count: int
+    mean: float
+    std_dev: float
+    min: float
+    max: float
+    q1: float
+    median: float
+    q3: float
+
+    @staticmethod
+    def of(samples: np.ndarray) -> "CoefficientSummary":
+        s = np.asarray(samples, np.float64)
+        q1, med, q3 = np.percentile(s, [25, 50, 75])
+        return CoefficientSummary(
+            count=int(s.size),
+            mean=float(s.mean()),
+            std_dev=float(s.std(ddof=1)) if s.size > 1 else 0.0,
+            min=float(s.min()),
+            max=float(s.max()),
+            q1=float(q1),
+            median=float(med),
+            q3=float(q3),
+        )
+
+    def contains_zero(self) -> bool:
+        return self.min <= 0.0 <= self.max
+
+    def to_summary_string(self) -> str:
+        return (
+            f"Range: [Min: {self.min:.3f}, Q1: {self.q1:.3f}, "
+            f"Med: {self.median:.3f}, Q3: {self.q3:.3f}, Max: {self.max:.3f}) "
+            f"Mean: [{self.mean:.3f}], Std. Dev.[{self.std_dev:.3f}], "
+            f"# samples = [{self.count}]"
+        )
+
+
+@dataclasses.dataclass
+class BootstrapReport:
+    """Aggregates over bootstrap refits (BootstrapReport analog)."""
+
+    coefficient_summaries: list[CoefficientSummary]  # 1:1 with coefficients
+    metric_summaries: dict[str, CoefficientSummary]
+    models: Optional[list] = None  # per-sample GLMs when keep_models
+
+    def significant_coefficients(self) -> np.ndarray:
+        """Indices whose bootstrap CI (min..max) excludes zero — the
+        'very unlikely to be zero' set the reference doc describes."""
+        return np.asarray(
+            [i for i, s in enumerate(self.coefficient_summaries)
+             if not s.contains_zero()],
+            np.int64,
+        )
+
+
+@lru_cache(maxsize=32)
+def _bootstrap_solver(config: OptimizerConfig, loss_name: str):
+    def solve_one(obj, batch, weights, w0, l1):
+        b = dataclasses.replace(batch, weights=weights)
+        return dispatch_solve(glm_adapter(obj, b), w0, config, l1)
+
+    # weights vmap over the sample axis; batch/obj/w0/l1 broadcast
+    return jax.jit(jax.vmap(solve_one, in_axes=(None, None, 0, None, None)))
+
+
+def bootstrap_train(
+    batch,
+    task: str,
+    config: OptimizerConfig,
+    num_samples: int = 16,
+    train_portion: float = 0.8,
+    seed: int = 0,
+    keep_models: bool = False,
+    metrics_fn: Optional[Callable] = None,
+) -> BootstrapReport:
+    """Train ``num_samples`` bootstrap refits and aggregate.
+
+    Each sample: rows are split train/holdout at ``train_portion`` (capped
+    at 0.9 like the reference's 900/1000 splits), the training rows receive
+    multinomial resample counts as weight multipliers (sampling with
+    replacement), and the model refits from zero. Holdout metrics feed the
+    metric distributions (Evaluation.evaluate per model in the reference).
+    """
+    if num_samples < 2:
+        raise ValueError("num_samples must be at least 2")
+    if not 0.0 < train_portion <= 1.0:
+        raise ValueError(f"train_portion must be in (0, 1], got {train_portion}")
+    train_portion = min(train_portion, 0.9)
+    config.validate(task)
+
+    rng = np.random.default_rng(seed)
+    base_w = np.asarray(batch.weights)
+    n_pad = len(base_w)
+    live = base_w > 0
+    n_live = int(live.sum())
+
+    sample_weights = np.zeros((num_samples, n_pad))
+    holdout_masks = np.zeros((num_samples, n_pad), bool)
+    live_idx = np.nonzero(live)[0]
+    n_train = max(int(round(train_portion * n_live)), 1)
+    for b in range(num_samples):
+        perm = rng.permutation(n_live)
+        train_rows = live_idx[perm[:n_train]]
+        holdout_rows = live_idx[perm[n_train:]]
+        counts = rng.multinomial(n_train, np.full(n_train, 1.0 / n_train))
+        sample_weights[b, train_rows] = base_w[train_rows] * counts
+        holdout_masks[b, holdout_rows] = True
+
+    obj = make_objective(
+        task,
+        l2_weight=config.regularization.l2_weight(config.regularization_weight),
+    )
+    l1 = jnp.float32(config.regularization.l1_weight(config.regularization_weight))
+    key_cfg = dataclasses.replace(config, regularization_weight=0.0)
+    solver = _bootstrap_solver(key_cfg, task)
+    w0 = jnp.zeros((batch.num_features,), jnp.float32)
+    res = solver(obj, batch, jnp.asarray(sample_weights, jnp.float32), w0, l1)
+    W = np.asarray(res.w)  # [B, d]
+
+    coef_summaries = [CoefficientSummary.of(W[:, j]) for j in range(W.shape[1])]
+
+    metric_samples: dict[str, list[float]] = {}
+    models = []
+    for b in range(num_samples):
+        m = make_model(task, jnp.asarray(W[b]))
+        models.append(m)
+        hold_w = jnp.asarray(
+            np.where(holdout_masks[b], base_w, 0.0), jnp.float32
+        )
+        hb = dataclasses.replace(batch, weights=hold_w)
+        mm = metrics_fn(m, hb) if metrics_fn is not None else evaluate(m, hb)
+        for k, v in mm.items():
+            metric_samples.setdefault(k, []).append(v)
+
+    return BootstrapReport(
+        coefficient_summaries=coef_summaries,
+        metric_summaries={
+            k: CoefficientSummary.of(np.asarray(v))
+            for k, v in metric_samples.items()
+        },
+        models=models if keep_models else None,
+    )

@@ -281,13 +281,16 @@ def _config_metadata(config: GameConfig) -> dict:
             out["type"] = "random_effect"
             out["id_name"] = c.id_name
             out["active_rows_per_entity"] = c.active_rows_per_entity
+            out["min_rows_per_entity"] = c.min_rows_per_entity
             out["optimizer"] = describe_opt(c.optimizer)
         elif isinstance(c, FactoredRandomEffectConfig):
             out["type"] = "factored_random_effect"
             out["id_name"] = c.id_name
             out["active_rows_per_entity"] = c.active_rows_per_entity
+            out["min_rows_per_entity"] = c.min_rows_per_entity
             out["latent_dim"] = c.latent_dim
             out["mf_iterations"] = c.mf_iterations
+            out["seed"] = c.seed
             out["optimizer"] = describe_opt(c.re_optimizer)
             out["latent_optimizer"] = describe_opt(c.latent_optimizer)
         else:
@@ -295,6 +298,7 @@ def _config_metadata(config: GameConfig) -> dict:
             out["normalization"] = str(NormalizationType(c.normalization).value)
             out["intercept_index"] = c.intercept_index
             out["layout"] = c.layout
+            out["down_sampling_seed"] = c.down_sampling_seed
             out["optimizer"] = describe_opt(c.optimizer)
         return out
 

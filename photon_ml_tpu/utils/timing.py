@@ -18,6 +18,10 @@ logger = logging.getLogger("photon_ml_tpu")
 
 def setup_logging(level: int = logging.INFO, log_file: Optional[str] = None) -> None:
     """Configure the photon_ml_tpu logger tree (PhotonLogger analog)."""
+    root = logging.getLogger("photon_ml_tpu")
+    root.setLevel(level)
+    if root.handlers:  # idempotent: repeated setup must not duplicate lines
+        return
     handler: logging.Handler
     if log_file is not None:
         handler = logging.FileHandler(log_file)
@@ -26,8 +30,6 @@ def setup_logging(level: int = logging.INFO, log_file: Optional[str] = None) -> 
     handler.setFormatter(
         logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
     )
-    root = logging.getLogger("photon_ml_tpu")
-    root.setLevel(level)
     root.addHandler(handler)
 
 

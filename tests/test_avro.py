@@ -254,3 +254,25 @@ def test_build_index_map_from_avro(tmp_path):
     imap = build_index_map_from_avro(p)
     assert len(imap) == 4  # 3 terms + intercept
     assert imap.get(INTERCEPT_KEY) >= 0
+
+
+def test_weight_zero_and_null_id_fallback(tmp_path):
+    """Explicit weight 0.0 survives; a null top-level id field falls back to
+    the metadataMap value."""
+    schema = dict(TRAINING_EXAMPLE_AVRO)
+    schema = {
+        **schema,
+        "fields": schema["fields"]
+        + [{"name": "userId", "type": ["null", "string"], "default": None}],
+    }
+    recs = [
+        {**_example(0, [("f", "", 1.0)], user=7), "weight": 0.0, "userId": None},
+        {**_example(1, [("f", "", 1.0)], user=8), "weight": 2.0, "userId": "9"},
+    ]
+    p = str(tmp_path / "w.avro")
+    write_avro(p, schema, recs)
+    data = read_game_dataset_from_avro(p, id_columns=["userId"])
+    np.testing.assert_array_equal(data.weight, [0.0, 2.0])
+    # record 0: top-level null -> metadataMap "7"; record 1: top-level "9"
+    idc = data.id_columns["userId"]
+    assert list(idc.vocab[idc.codes]) == ["7", "9"]

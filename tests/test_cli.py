@@ -202,3 +202,12 @@ def test_cli_train_save_score_end_to_end(avro_dataset):
     recs = read_scoring_results(out_path)
     assert len(recs) == 40
     assert all(np.isfinite(r["predictionScore"]) for r in recs)
+
+
+def test_parse_coordinate_config_rejects_unknown_keys():
+    from photon_ml_tpu.config import parse_coordinate_config
+
+    with pytest.raises(ValueError, match="unknown keys"):
+        parse_coordinate_config(
+            {"type": "fixed_effect", "shard_name": "g", "normalisation": "none"}
+        )

@@ -1,0 +1,297 @@
+"""Diagnostics: bootstrap CI coverage on a known model, evaluation metrics
+vs closed forms, fitting curves improve with data, H-L calibration
+detection, feature importance ranking, Kendall-tau independence, report
+rendering."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.stats import summarize
+from photon_ml_tpu.diagnostics import (
+    bootstrap_train,
+    diagnose_model,
+    evaluate,
+    expected_magnitude_importance,
+    fitting_diagnostic,
+    hosmer_lemeshow,
+    kendall_tau_analysis,
+    prediction_error_independence,
+    render_html,
+    render_text,
+    variance_importance,
+)
+from photon_ml_tpu.diagnostics.evaluation import (
+    AKAIKE_INFORMATION_CRITERION,
+    AREA_UNDER_PRECISION_RECALL,
+    AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS,
+    DATA_LOG_LIKELIHOOD,
+    ROOT_MEAN_SQUARE_ERROR,
+)
+from photon_ml_tpu.models.glm import make_model
+from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.optim import (
+    OptimizerConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+
+
+def _logistic(rng, n=500, d=6, w=None):
+    X = rng.normal(size=(n, d))
+    X[:, 0] = 1.0
+    w = rng.normal(size=d) if w is None else w
+    p = 1 / (1 + np.exp(-(X @ w)))
+    y = (rng.random(n) < p).astype(float)
+    return X, y, w, SparseBatch.from_dense(X, y)
+
+
+def _cfg(lam=1.0):
+    return OptimizerConfig(
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=lam,
+    )
+
+
+# -- evaluation -------------------------------------------------------------
+
+
+def test_evaluate_logistic_metrics(rng):
+    X, y, w, batch = _logistic(rng)
+    model = make_model("logistic", np.asarray(w, np.float32))
+    m = evaluate(model, batch)
+    assert 0.5 < m[AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS] <= 1.0
+    assert 0.0 < m[AREA_UNDER_PRECISION_RECALL] <= 1.0
+    assert m[DATA_LOG_LIKELIHOOD] < 0.0
+    # closed-form log likelihood
+    p = np.clip(1 / (1 + np.exp(-(X @ w))), 1e-9, 1 - 1e-9)
+    ll = np.mean(y * np.log(p) + (1 - y) * np.log1p(-p))
+    assert m[DATA_LOG_LIKELIHOOD] == pytest.approx(ll, rel=1e-3)
+    # AIC = 2(k - n*ll) + correction
+    k = int(np.sum(np.abs(w) > 1e-9))
+    n = len(y)
+    base = 2 * (k - n * ll)
+    assert m[AKAIKE_INFORMATION_CRITERION] == pytest.approx(
+        base + 2 * k * (k + 1) / (n - k - 1), rel=1e-3
+    )
+
+
+def test_evaluate_regression_metrics(rng):
+    n, d = 300, 5
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = X @ w + 0.1 * rng.normal(size=n)
+    batch = SparseBatch.from_dense(X, y)
+    model = make_model("squared", np.asarray(w, np.float32))
+    m = evaluate(model, batch)
+    resid = y - X @ w
+    assert m[ROOT_MEAN_SQUARE_ERROR] == pytest.approx(
+        np.sqrt(np.mean(resid**2)), rel=1e-3
+    )
+
+
+def test_peak_f1_perfect_classifier(rng):
+    X, y, w, batch = _logistic(rng, n=200)
+    # scores equal to labels -> a threshold separates perfectly -> F1 = 1
+    from photon_ml_tpu.diagnostics import peak_f1
+    import jax.numpy as jnp
+
+    assert float(
+        peak_f1(jnp.asarray(y, jnp.float32), batch.labels, batch.weights)
+    ) == pytest.approx(1.0, abs=1e-5)
+
+
+# -- bootstrap --------------------------------------------------------------
+
+
+def test_bootstrap_ci_covers_true_coefficients(rng):
+    X, y, w_true, batch = _logistic(rng, n=1500, d=5)
+    report = bootstrap_train(
+        batch, "logistic", _cfg(lam=1e-3), num_samples=16, seed=1
+    )
+    assert len(report.coefficient_summaries) == 5
+    covered = sum(
+        s.min <= wt <= s.max
+        for s, wt in zip(report.coefficient_summaries, w_true)
+    )
+    assert covered >= 4  # bootstrap min..max range covers the truth
+    # metric distributions exist and AUC samples are sane
+    auc_sum = report.metric_summaries[
+        AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS
+    ]
+    assert auc_sum.count == 16
+    assert 0.5 < auc_sum.mean <= 1.0
+    # strong true coefficients are flagged significant
+    strong = np.nonzero(np.abs(w_true) > 1.0)[0]
+    sig = set(report.significant_coefficients().tolist())
+    assert set(strong.tolist()) <= sig
+
+
+def test_bootstrap_validates_args(rng):
+    _, _, _, batch = _logistic(rng, n=50)
+    with pytest.raises(ValueError):
+        bootstrap_train(batch, "logistic", _cfg(), num_samples=1)
+    with pytest.raises(ValueError):
+        bootstrap_train(batch, "logistic", _cfg(), train_portion=0.0)
+
+
+# -- fitting ----------------------------------------------------------------
+
+
+def test_fitting_diagnostic_holdout_improves_with_data(rng):
+    X, y, w, batch = _logistic(rng, n=1200, d=8)
+    report = fitting_diagnostic(
+        batch, "logistic", _cfg(lam=1e-2), lambdas=[1e-2], seed=2
+    )
+    assert len(report.portions) == 9
+    assert report.portions == sorted(report.portions)
+    curve = report.test_metrics[1e-2][AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS]
+    # holdout AUC with 90% of data beats AUC with 10%
+    assert curve[-1] >= curve[0] - 0.02
+    assert report.fitting_msg()  # non-empty summary
+
+
+# -- hosmer-lemeshow --------------------------------------------------------
+
+
+def test_hl_calibrated_vs_miscalibrated(rng):
+    n = 4000
+    p = rng.uniform(0.05, 0.95, n)
+    y_cal = (rng.random(n) < p).astype(float)
+    # mean_prob expectation (classical H-L): calibrated data passes
+    good = hosmer_lemeshow(p, y_cal, expected="mean_prob")
+    assert good.p_value > 0.01
+    # miscalibrated: predictions systematically overconfident
+    p_bad = np.clip(p**3, 1e-3, 1 - 1e-3)
+    bad = hosmer_lemeshow(p_bad, y_cal, expected="mean_prob")
+    assert bad.chi_square > 10 * good.chi_square
+    assert bad.p_value < 1e-6
+    assert bad.degrees_of_freedom == 8
+    assert len(bad.cutoffs) == 15
+    assert "chi^2" in bad.to_summary_string()
+    # reference-parity midpoint mode still separates good from bad
+    good_mid = hosmer_lemeshow(p, y_cal)  # default expected="midpoint"
+    bad_mid = hosmer_lemeshow(p_bad, y_cal)
+    assert bad_mid.chi_square > good_mid.chi_square
+
+
+# -- feature importance -----------------------------------------------------
+
+
+def test_feature_importance_rankings(rng):
+    d = 6
+    w = np.zeros(d, np.float32)
+    w[2] = 5.0
+    w[4] = -0.1
+    X = rng.normal(size=(400, d))
+    X[:, 4] *= 100.0  # huge variance feature
+    batch = SparseBatch.from_dense(X, np.zeros(400))
+    summary = summarize(batch)
+    model = make_model("squared", w)
+    names = [f"f{j}" for j in range(d)]
+
+    em = expected_magnitude_importance(model, summary, names)
+    assert em.ranked[0][0] in ("f2", "f4")  # both large contributions
+    vi = variance_importance(model, summary, names)
+    # variance importance weights the 100x-variance column heavily:
+    # |w4 * var4| = 0.1 * 1e4 ~ 1e3 vs |w2 * var2| ~ 5
+    assert vi.ranked[0][0] == "f4"
+    # without a summary both collapse to |coef|
+    em0 = expected_magnitude_importance(model, None, names)
+    assert em0.ranked[0][0] == "f2"
+    assert "f2" in em0.to_summary_string(3)
+
+
+# -- independence -----------------------------------------------------------
+
+
+def test_kendall_tau_independent_vs_dependent(rng):
+    n = 300
+    a = rng.normal(size=n)
+    ind = kendall_tau_analysis(a, rng.normal(size=n))
+    dep = kendall_tau_analysis(a, a + 0.1 * rng.normal(size=n))
+    assert ind.p_value > 0.01
+    assert dep.p_value < 1e-10
+    assert dep.tau_alpha > 0.8
+    # tau vs scipy reference
+    from scipy.stats import kendalltau
+
+    ref = kendalltau(a, a + 0.1 * rng.normal(size=n))
+    assert abs(dep.tau_beta - ref.statistic) < 0.1
+
+
+def test_prediction_error_independence_subsamples(rng):
+    n = 5000
+    pred = rng.normal(size=n)
+    rep = prediction_error_independence(pred, pred * 0.5, max_samples=500)
+    assert rep.num_samples == 500
+    assert "subsampled" in rep.message
+
+
+# -- reports ----------------------------------------------------------------
+
+
+def test_diagnose_model_renders_html_and_text(rng):
+    X, y, w, batch = _logistic(rng, n=400)
+    model = make_model("logistic", np.asarray(w, np.float32))
+    doc = diagnose_model(
+        model, batch, summary=summarize(batch),
+        feature_names=[f"f{j}" for j in range(X.shape[1])],
+    )
+    txt = render_text(doc)
+    assert "Model diagnostics" in txt
+    assert "Hosmer-Lemeshow" in txt
+    assert "Kendall tau" in txt
+    html = render_html(doc)
+    assert html.startswith("<!DOCTYPE html>")
+    assert "<table>" in html
+    assert "Feature importance" in html
+
+
+def test_report_line_plot_svg():
+    from photon_ml_tpu.diagnostics import (
+        Chapter,
+        Document,
+        LinePlot,
+        Section,
+    )
+
+    doc = Document(
+        "curves",
+        [
+            Chapter(
+                "c",
+                [
+                    Section(
+                        "s",
+                        [
+                            LinePlot(
+                                x=[0.1, 0.5, 0.9],
+                                series={"train": [1, 2, 3], "test": [1, 1.5, 2]},
+                                title="learning curve",
+                                x_label="portion",
+                                y_label="auc",
+                            )
+                        ],
+                    )
+                ],
+            )
+        ],
+    )
+    html = render_html(doc)
+    assert "<svg" in html and "polyline" in html
+    txt = render_text(doc)
+    assert "[plot] learning curve" in txt
+
+
+def test_fitting_report_sections_render(rng):
+    X, y, w, batch = _logistic(rng, n=600, d=5)
+    from photon_ml_tpu.diagnostics.fitting import fitting_report_sections  # noqa
+    from photon_ml_tpu.diagnostics import Chapter, Document, render_html
+
+    report = fitting_diagnostic(
+        batch, "logistic", _cfg(lam=1e-2), lambdas=[1e-2], seed=3,
+        num_partitions=5,
+    )
+    sections = fitting_report_sections(report)
+    html = render_html(Document("fit", [Chapter("learning", sections)]))
+    assert "polyline" in html and "Area under ROC" in html
