@@ -66,12 +66,18 @@ def parse_coordinate_config(obj: Mapping):
             layout=obj.pop("layout", "auto"),
         )
     elif ctype == "random_effect":
+        ratio = obj.pop("features_to_samples_ratio", None)
         out = RandomEffectConfig(
             shard_name=obj.pop("shard_name"),
             id_name=obj.pop("id_name"),
             optimizer=parse_optimizer_config(obj.pop("optimizer", None)),
             active_rows_per_entity=obj.pop("active_rows_per_entity", None),
             min_rows_per_entity=int(obj.pop("min_rows_per_entity", 1)),
+            features_to_samples_ratio=None if ratio is None else float(ratio),
+            projector=obj.pop("projector", "index_map"),
+            projected_dim=obj.pop("projected_dim", None),
+            projection_seed=int(obj.pop("projection_seed", 0)),
+            projection_intercept_index=obj.pop("projection_intercept_index", None),
         )
     elif ctype == "factored_random_effect":
         out = FactoredRandomEffectConfig(

@@ -73,14 +73,14 @@ def _log_gamma(x: Array) -> Array:
 def evaluate(
     model: GeneralizedLinearModel,
     batch,
-    offsets: Optional[Array] = None,
 ) -> dict[str, float]:
-    """Full metric map for one GLM on one batch (Evaluation.evaluate)."""
+    """Full metric map for one GLM on one batch (Evaluation.evaluate).
+
+    ``compute_score`` = Xw + batch.offsets already (SparseBatch.margins
+    includes the offset column — computeMeanFunctionWithOffset semantics),
+    so nothing is added here."""
     task = get_loss(model.task).name
     margins = model.compute_score(batch)
-    if offsets is None:
-        offsets = batch.offsets
-    margins = margins + offsets
     means = model.mean_of(margins)
     labels = batch.labels
     weights = batch.weights

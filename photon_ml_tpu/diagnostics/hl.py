@@ -149,20 +149,24 @@ def hosmer_lemeshow(
             expected=expected,
         )
         bins.append(b)
-        if b.expected_pos_count > 0:
-            chi_sq += (pos - b.expected_pos_count) ** 2 / b.expected_pos_count
-        if b.expected_pos_count < MINIMUM_EXPECTED_IN_BUCKET:
-            warnings.append(
-                f"bin {i}: expected positive count {b.expected_pos_count:.1f} "
-                "too small for a sound chi^2 estimate"
-            )
-        if b.expected_neg_count > 0:
-            chi_sq += (neg - b.expected_neg_count) ** 2 / b.expected_neg_count
-        if b.expected_neg_count < MINIMUM_EXPECTED_IN_BUCKET:
-            warnings.append(
-                f"bin {i}: expected negative count {b.expected_neg_count:.1f} "
-                "too small for a sound chi^2 estimate"
-            )
+        # expected == 0 with observed events means unbounded chi^2; the
+        # reference skips the term (HosmerLemeshowDiagnostic.scala deltaNeg
+        # guard) — match that but surface a warning so the understated
+        # statistic is visible
+        for sign, obs, exp in (("positive", pos, b.expected_pos_count),
+                               ("negative", neg, b.expected_neg_count)):
+            if exp > 0:
+                chi_sq += (obs - exp) ** 2 / exp
+            elif obs > 0:
+                warnings.append(
+                    f"bin {i}: observed {sign} events with expected count 0 "
+                    "— chi^2 term skipped (statistic is understated)"
+                )
+            if exp < MINIMUM_EXPECTED_IN_BUCKET:
+                warnings.append(
+                    f"bin {i}: expected {sign} count {exp:.1f} "
+                    "too small for a sound chi^2 estimate"
+                )
 
     dof = max(num_bins - 2, 1)
     dist = _chi2(dof)

@@ -13,7 +13,6 @@ import dataclasses
 import math
 
 import numpy as np
-from scipy.stats import kendalltau as _kendalltau
 
 
 @dataclasses.dataclass
@@ -85,10 +84,10 @@ def kendall_tau_analysis(
     tb_denom = math.sqrt(float(no_ties_a) * float(no_ties_b))
     tau_beta = (concordant - discordant) / tb_denom if tb_denom else 0.0
 
-    # var(tau) under H0 ~ 2(2n+5)/(9n(n-1)) (KendallTauAnalysis z score)
+    # var(tau) under H0 ~ 2(2n+5)/(9n(n-1)) (KendallTauAnalysis z score);
+    # two-sided p-value from the normal approximation
     d = math.sqrt(2.0 * (2.0 * n + 5.0) / (9.0 * n * (n - 1.0)))
     z_alpha = tau_alpha / d if d else 0.0
-    # cross-check with scipy's tau-b p-value when ties are absent
     p_value = float(2.0 * (1.0 - _norm_cdf(abs(z_alpha))))
     return KendallTauReport(
         num_samples=n,

@@ -92,7 +92,8 @@ def diagnose_model(
         Chapter("Feature importance", imp_rows),
     ]
 
-    scores = np.asarray(model.compute_score(batch) + batch.offsets)
+    # compute_score already includes batch.offsets (margins semantics)
+    scores = np.asarray(model.compute_score(batch))
     labels = np.asarray(batch.labels)
     weights = np.asarray(batch.weights)
 

@@ -60,13 +60,30 @@ class FixedEffectConfig:
 class RandomEffectConfig:
     """One per-entity coordinate (RandomEffectDataConfiguration analog:
     randomEffectType = id_name, featureShardId = shard_name, active-data
-    caps as in RandomEffectDataSet.scala:294-357)."""
+    caps as in RandomEffectDataSet.scala:294-357, projectorType, and the
+    numFeaturesToSamplesRatio Pearson bound of :420-434)."""
 
     shard_name: str
     id_name: str
     optimizer: OptimizerConfig = OptimizerConfig()
     active_rows_per_entity: Optional[int] = None
     min_rows_per_entity: int = 1
+    # cap each entity's feature count at ceil(ratio * its row count), picked
+    # by |Pearson(feature, label)| (numFeaturesToSamplesRatioUpperBound)
+    features_to_samples_ratio: Optional[float] = None
+    # "index_map": per-entity observed-feature reindexing (default);
+    # "random": shared Gaussian random projection into projected_dim dims
+    # (ProjectorType.{INDEX_MAP,RANDOM}_PROJECTION analog)
+    projector: str = "index_map"
+    projected_dim: Optional[int] = None
+    projection_seed: int = 0
+    projection_intercept_index: Optional[int] = None
+
+    def __post_init__(self):
+        if self.projector not in ("index_map", "random"):
+            raise ValueError(f"unknown projector '{self.projector}'")
+        if self.projector == "random" and not self.projected_dim:
+            raise ValueError("projector='random' requires projected_dim")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,15 +169,34 @@ class GameEstimator:
                     c.shard_name,
                     active_rows_per_entity=c.active_rows_per_entity,
                     min_rows_per_entity=c.min_rows_per_entity,
+                    features_to_samples_ratio=c.features_to_samples_ratio,
                 )
-                coords[name] = RandomEffectCoordinate(
-                    name=name,
-                    data=data,
-                    re_data=red,
-                    loss_name=self.config.task,
-                    config=c.optimizer,
-                    mesh=entity_mesh,
-                )
+                if c.projector == "random":
+                    # fixed Gaussian projection: per-entity solves in the
+                    # shared projected space (RandomEffectCoordinateIn
+                    # ProjectedSpace + ProjectorType.RANDOM analog)
+                    coords[name] = FactoredRandomEffectCoordinate(
+                        name=name,
+                        data=data,
+                        re_data=red,
+                        loss_name=self.config.task,
+                        re_config=c.optimizer,
+                        latent_config=c.optimizer,
+                        latent_dim=c.projected_dim,
+                        refit_projection=False,
+                        projection_intercept_index=c.projection_intercept_index,
+                        seed=c.projection_seed,
+                        mesh=entity_mesh,
+                    )
+                else:
+                    coords[name] = RandomEffectCoordinate(
+                        name=name,
+                        data=data,
+                        re_data=red,
+                        loss_name=self.config.task,
+                        config=c.optimizer,
+                        mesh=entity_mesh,
+                    )
             elif isinstance(c, FactoredRandomEffectConfig):
                 red = build_random_effect_dataset(
                     data,
@@ -282,6 +318,11 @@ def _config_metadata(config: GameConfig) -> dict:
             out["id_name"] = c.id_name
             out["active_rows_per_entity"] = c.active_rows_per_entity
             out["min_rows_per_entity"] = c.min_rows_per_entity
+            out["features_to_samples_ratio"] = c.features_to_samples_ratio
+            out["projector"] = c.projector
+            out["projected_dim"] = c.projected_dim
+            out["projection_seed"] = c.projection_seed
+            out["projection_intercept_index"] = c.projection_intercept_index
             out["optimizer"] = describe_opt(c.optimizer)
         elif isinstance(c, FactoredRandomEffectConfig):
             out["type"] = "factored_random_effect"

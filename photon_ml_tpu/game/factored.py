@@ -207,12 +207,27 @@ class FactoredRandomEffectCoordinate:
     mesh: Optional[Mesh] = None  # 1-D mesh: entity-shards the latent RE
     # solves (shard_map, no collectives) and data-parallels the latent
     # matrix refit (distributed_solve) over the same devices
+    # refit_projection=False freezes A after random initialization: the
+    # coordinate becomes RandomEffectCoordinateInProjectedSpace with a
+    # Gaussian RandomProjection (ProjectorType.RANDOM analog) — per-entity
+    # solves in the fixed projected space, no kron refit.
+    refit_projection: bool = True
+    # with refit_projection=False, optionally pass the intercept through the
+    # projection untouched (buildGaussianRandomProjectionMatrix's
+    # isKeepingInterceptTerm dummy row)
+    projection_intercept_index: Optional[int] = None
 
     def __post_init__(self):
         if self.latent_dim < 1:
             raise ValueError("latent_dim must be >= 1")
         if self.mf_iterations < 1:
             raise ValueError("mf_iterations must be >= 1")
+        if self.projection_intercept_index is not None and self.refit_projection:
+            raise ValueError(
+                "projection_intercept_index requires refit_projection=False "
+                "(the MF refit would overwrite the passthrough row; the "
+                "reference's MF init uses isKeepingInterceptTerm=false)"
+            )
         self.re_config.validate(self.loss_name)
         self.latent_config.validate(self.loss_name)
         k = self.latent_dim
@@ -220,6 +235,8 @@ class FactoredRandomEffectCoordinate:
         buckets = self.re_data.buckets
         self._batch = self.data.shard(self.re_data.shard_name)
         n_pad = self._batch.num_rows
+        # rows of A, including the optional intercept passthrough row
+        self._proj_rows = k + (1 if self.projection_intercept_index is not None else 0)
 
         # flat latent-table layout: bucket entities concatenated in order
         sizes = [b.num_entities for b in buckets]
@@ -229,6 +246,34 @@ class FactoredRandomEffectCoordinate:
         self._entity_flat = np.where(
             eb >= 0, self._flat_offsets[np.maximum(eb, 0)] + ep, -1
         ).astype(np.int64)
+
+        if not self.refit_projection:
+            # fixed projection: the kron structure is never needed
+            key_re = dataclasses.replace(self.re_config, regularization_weight=0.0)
+            from photon_ml_tpu.game.coordinates import (
+                _re_solver,
+                _re_solver_sharded,
+            )
+
+            self._re_solver = _re_solver(key_re, self.loss_name)
+            if self.mesh is not None:
+                self._axis = self.mesh.axis_names[0]
+                self._n_dev = int(self.mesh.devices.size)
+                self._re_solver_sharded = _re_solver_sharded(
+                    key_re, self.loss_name, self.mesh, self._axis
+                )
+            self._re_obj = make_objective(
+                self.loss_name,
+                l2_weight=self.re_config.regularization.l2_weight(
+                    self.re_config.regularization_weight
+                ),
+            )
+            self._re_l1 = jnp.float32(
+                self.re_config.regularization.l1_weight(
+                    self.re_config.regularization_weight
+                )
+            )
+            return
 
         # --- static kronecker structure (host, once) ---
         g_rows, g_cols, g_vals, g_ent = [], [], [], []
@@ -380,18 +425,18 @@ class FactoredRandomEffectCoordinate:
     def initialize_model(self) -> FactoredRandomEffectModel:
         """Zero latent vectors + a Gaussian random projection
         (FactoredRandomEffectCoordinate.initializeModel:190-212, which seeds
-        A with buildRandomProjectionBroadcastProjector, no intercept row)."""
+        A with buildRandomProjectionBroadcastProjector)."""
         proj = build_gaussian_projection_matrix(
             self.latent_dim,
             self.re_data.num_global_features,
-            intercept_index=None,
+            intercept_index=self.projection_intercept_index,
             seed=self.seed,
         )
         return FactoredRandomEffectModel(
             id_name=self.re_data.id_name,
             shard_name=self.re_data.shard_name,
             projection=proj,
-            latent=jnp.zeros((self._n_flat, self.latent_dim), jnp.float32),
+            latent=jnp.zeros((self._n_flat, self._proj_rows), jnp.float32),
             entity_flat=self._entity_flat,
             vocab=self.data.id_columns[self.re_data.id_name].vocab,
         )
@@ -405,7 +450,7 @@ class FactoredRandomEffectCoordinate:
         self, latent: Array, a_ext: Array, residual: Optional[Array]
     ) -> Array:
         """One pass of per-entity solves in latent space over all buckets."""
-        k = self.latent_dim
+        k = self._proj_rows
         parts = []
         for b_idx, b in enumerate(self.re_data.buckets):
             bucket = b if residual is None else b.with_extra_offsets(residual)
@@ -489,6 +534,12 @@ class FactoredRandomEffectCoordinate:
     ) -> FactoredRandomEffectModel:
         latent = model.latent
         a = model.projection.matrix
+        if not self.refit_projection:
+            # fixed random projection: per-entity solves only
+            latent = self._latent_re_step(
+                latent, model.projection.extended(), residual_scores
+            )
+            return dataclasses.replace(model, latent=latent)
         for _ in range(self.mf_iterations):
             a_ext = ProjectionMatrix(matrix=a).extended()
             latent = self._latent_re_step(latent, a_ext, residual_scores)

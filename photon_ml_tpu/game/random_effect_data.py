@@ -112,12 +112,80 @@ class RandomEffectDataset:
     num_global_features: int
 
 
+_PEARSON_STD_EPS = 1e-8  # MathConst.MEDIUM_PRECISION_TOLERANCE_THRESHOLD
+
+
+def _pearson_keep_mask(
+    nv: np.ndarray,
+    nc: np.ndarray,
+    ne: np.ndarray,
+    y_of_nnz: np.ndarray,
+    y_act: np.ndarray,
+    ent_of_row: np.ndarray,
+    act_counts: np.ndarray,
+    num_global: int,
+    ratio: float,
+) -> np.ndarray:
+    """Keep mask over nnz: per entity, retain the top
+    ceil(ratio * num_rows) features by |Pearson(feature, label)|.
+
+    Vectorized analog of LocalDataSet.computePearsonCorrelationScore
+    (LocalDataSet.scala:221-282) + featureSelectionOnActiveData
+    (RandomEffectDataSet.scala:420-434): a near-constant feature is treated
+    as the intercept — the FIRST such feature per entity scores 1, later
+    duplicates 0. Sums follow the reference exactly (sparse sums; zero rows
+    contribute only to the label moments).
+    """
+    n_ent = len(act_counts)
+    # per-(entity, feature) sums over the entity's nnz
+    pair_key = ne * np.int64(num_global) + nc
+    uniq, inv = np.unique(pair_key, return_inverse=True)
+    s_v = np.bincount(inv, weights=nv, minlength=len(uniq))
+    s_vv = np.bincount(inv, weights=nv * nv, minlength=len(uniq))
+    s_vy = np.bincount(inv, weights=nv * y_of_nnz, minlength=len(uniq))
+    p_ent = (uniq // np.int64(num_global)).astype(np.int64)
+
+    # per-entity label moments over ALL active rows
+    n_e = act_counts.astype(np.float64)
+    ly = np.bincount(ent_of_row, weights=y_act, minlength=n_ent)
+    lyy = np.bincount(ent_of_row, weights=y_act * y_act, minlength=n_ent)
+
+    n_p = n_e[p_ent]
+    numerator = n_p * s_vy - s_v * ly[p_ent]
+    std = np.sqrt(np.abs(n_p * s_vv - s_v * s_v))
+    denominator = std * np.sqrt(
+        np.maximum(n_p * lyy[p_ent] - ly[p_ent] ** 2, 0.0)
+    )
+    score = np.abs(numerator / (denominator + 1e-12))
+    constant = std < _PEARSON_STD_EPS
+    if np.any(constant):
+        # first constant feature per entity acts as the intercept (score 1)
+        c_idx = np.nonzero(constant)[0]
+        first = np.zeros(len(uniq), bool)
+        # uniq is sorted by (entity, col): the first constant per entity is
+        # the one whose predecessor constant has a different entity
+        is_first = np.ones(len(c_idx), bool)
+        is_first[1:] = p_ent[c_idx[1:]] != p_ent[c_idx[:-1]]
+        first[c_idx[is_first]] = True
+        score = np.where(constant, np.where(first, 1.0, 0.0), score)
+
+    # rank within entity by descending score; keep rank < ceil(ratio * n_e)
+    order = np.lexsort((-score, p_ent))
+    starts = np.searchsorted(p_ent[order], np.arange(n_ent))
+    rank = np.empty(len(uniq), np.int64)
+    rank[order] = np.arange(len(uniq)) - starts[p_ent[order]]
+    k_e = np.ceil(ratio * n_e).astype(np.int64)
+    keep_pair = rank < k_e[p_ent]
+    return keep_pair[inv]
+
+
 def build_random_effect_dataset(
     data: GameDataset,
     id_name: str,
     shard_name: str,
     active_rows_per_entity: Optional[int] = None,
     min_rows_per_entity: int = 1,
+    features_to_samples_ratio: Optional[float] = None,
     seed: int = 0,
     dtype=jnp.float32,
 ) -> RandomEffectDataset:
@@ -197,7 +265,24 @@ def build_random_effect_dataset(
     ne = row_ent[nr]
     nlr = row_local[nr]
     o2 = np.lexsort((nlr, ne))  # segment_sum contract: rows sorted per entity
-    nv, nc, ne, nlr = nv[o2], nc[o2], ne[o2], nlr[o2]
+    nv, nc, ne, nlr, ngr = nv[o2], nc[o2], ne[o2], nlr[o2], nr[o2]
+
+    if features_to_samples_ratio is not None:
+        # per-entity Pearson feature selection for low-data entities
+        # (RandomEffectDataSet.scala:420-434)
+        keep = _pearson_keep_mask(
+            nv,
+            nc,
+            ne,
+            y_of_nnz=np.asarray(data.response)[ngr],
+            y_act=np.asarray(data.response)[act_rows],
+            ent_of_row=ent_of_row,
+            act_counts=act_counts,
+            num_global=num_global,
+            ratio=float(features_to_samples_ratio),
+        )
+        nv, nc, ne, nlr = nv[keep], nc[keep], ne[keep], nlr[keep]
+
     nnz_counts = np.bincount(ne, minlength=n_ent).astype(np.int64)
     nnz_starts = np.concatenate([[0], np.cumsum(nnz_counts)[:-1]])
     slot = np.arange(len(nv)) - nnz_starts[ne]

@@ -17,15 +17,31 @@ logger = logging.getLogger("photon_ml_tpu")
 
 
 def setup_logging(level: int = logging.INFO, log_file: Optional[str] = None) -> None:
-    """Configure the photon_ml_tpu logger tree (PhotonLogger analog)."""
+    """Configure the photon_ml_tpu logger tree (PhotonLogger analog).
+
+    Idempotent per TARGET: repeated calls never duplicate a handler, but a
+    later call adding a (new) log file still takes effect."""
+    import os
+
     root = logging.getLogger("photon_ml_tpu")
     root.setLevel(level)
-    if root.handlers:  # idempotent: repeated setup must not duplicate lines
-        return
     handler: logging.Handler
     if log_file is not None:
+        target = os.path.abspath(log_file)
+        if any(
+            isinstance(h, logging.FileHandler)
+            and getattr(h, "baseFilename", None) == target
+            for h in root.handlers
+        ):
+            return
         handler = logging.FileHandler(log_file)
     else:
+        if any(
+            isinstance(h, logging.StreamHandler)
+            and not isinstance(h, logging.FileHandler)
+            for h in root.handlers
+        ):
+            return
         handler = logging.StreamHandler()
     handler.setFormatter(
         logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")

@@ -295,3 +295,20 @@ def test_fitting_report_sections_render(rng):
     sections = fitting_report_sections(report)
     html = render_html(Document("fit", [Chapter("learning", sections)]))
     assert "polyline" in html and "Area under ROC" in html
+
+
+def test_evaluate_counts_offsets_exactly_once(rng):
+    """Regression: evaluate() must use margins = Xw + offset (once) — the
+    GAME residual-offset case that previously double-counted."""
+    import dataclasses as _dc
+    import jax.numpy as jnp
+
+    X, y, w, batch = _logistic(rng, n=300)
+    offs = rng.normal(size=300)
+    batch_o = _dc.replace(batch, offsets=jnp.asarray(
+        np.pad(offs, (0, batch.num_rows - 300)), jnp.float32))
+    model = make_model("logistic", np.asarray(w, np.float32))
+    m = evaluate(model, batch_o)
+    p = np.clip(1 / (1 + np.exp(-(X @ w + offs))), 1e-9, 1 - 1e-9)
+    ll = np.mean(y * np.log(p) + (1 - y) * np.log1p(-p))
+    assert m[DATA_LOG_LIKELIHOOD] == pytest.approx(ll, rel=1e-3)

@@ -1,0 +1,202 @@
+"""Pearson per-entity feature selection and the random-projection RE
+projector (RE scaling tricks for the 1e8-entity regime)."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.game import (
+    GameConfig,
+    GameEstimator,
+    RandomEffectConfig,
+    build_game_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.ops.sparse import SparseBatch
+from photon_ml_tpu.optim import (
+    OptimizerConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+
+
+def _re_data(rng, n_users=12, rows=20, d=30, informative=3):
+    """Per-user data where only the first ``informative`` features predict
+    the label; the rest are noise."""
+    n = n_users * rows
+    users = np.repeat(np.arange(n_users), rows)
+    X = rng.normal(size=(n, d))
+    w = np.zeros(d)
+    w[:informative] = [3.0, -2.0, 1.5][:informative]
+    y = X @ w + 0.01 * rng.normal(size=n)
+    data = build_game_dataset(
+        response=y,
+        feature_shards={"f": SparseBatch.from_dense(X, y)},
+        id_columns={"u": users},
+    )
+    return data, users, X, w, y
+
+
+def _opt(lam=1e-3):
+    return OptimizerConfig(
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=lam,
+        tolerance=1e-9,
+    )
+
+
+def test_pearson_selection_caps_feature_count(rng):
+    data, users, X, w, y = _re_data(rng, rows=10, d=30)
+    # ratio 0.5 -> each 10-row entity keeps ceil(5) features
+    red = build_random_effect_dataset(
+        data, "u", "f", features_to_samples_ratio=0.5
+    )
+    for b in red.buckets:
+        proj = np.asarray(b.projection)
+        per_entity_features = (proj < red.num_global_features).sum(axis=1)
+        assert np.all(per_entity_features <= 5)
+
+
+def test_pearson_selection_matches_per_entity_correlations(rng):
+    """The kept set per entity is exactly the top-k features by that
+    ENTITY's |Pearson(feature, label)| (computed independently here with
+    np.corrcoef over the entity's own rows)."""
+    data, users, X, w, y = _re_data(rng, rows=20, d=30, informative=3)
+    red = build_random_effect_dataset(
+        data, "u", "f", features_to_samples_ratio=0.25  # keep ceil(5) of 30
+    )
+    for b in red.buckets:
+        proj = np.asarray(b.projection)
+        codes = np.asarray(b.entity_codes)
+        for e in range(b.num_entities):
+            kept = set(proj[e][proj[e] < red.num_global_features].tolist())
+            rows_e = users == codes[e]
+            cors = np.abs(
+                [np.corrcoef(X[rows_e, j], y[rows_e])[0, 1] for j in range(30)]
+            )
+            expected = set(np.argsort(-cors)[:5].tolist())
+            assert kept == expected, (
+                f"entity {codes[e]}: kept {sorted(kept)} vs top-5 "
+                f"{sorted(expected)}"
+            )
+
+
+def test_pearson_selection_none_is_identity(rng):
+    data, *_ = _re_data(rng)
+    a = build_random_effect_dataset(data, "u", "f")
+    b = build_random_effect_dataset(data, "u", "f", features_to_samples_ratio=None)
+    for ba, bb in zip(a.buckets, b.buckets):
+        np.testing.assert_array_equal(np.asarray(ba.values), np.asarray(bb.values))
+
+
+def test_pearson_treats_constant_column_as_intercept(rng):
+    n_users, rows, d = 6, 15, 10
+    n = n_users * rows
+    users = np.repeat(np.arange(n_users), rows)
+    X = rng.normal(size=(n, d))
+    X[:, 0] = 1.0  # constant intercept column
+    y = 2.0 * X[:, 1] + 0.01 * rng.normal(size=n)
+    data = build_game_dataset(
+        response=y,
+        feature_shards={"f": SparseBatch.from_dense(X, y)},
+        id_columns={"u": users},
+    )
+    red = build_random_effect_dataset(
+        data, "u", "f", features_to_samples_ratio=2 / 15  # keep 2 features
+    )
+    # intercept (score 1.0) + the informative column survive everywhere
+    for b in red.buckets:
+        proj = np.asarray(b.projection)
+        for e in range(b.num_entities):
+            kept = set(proj[e][proj[e] < red.num_global_features].tolist())
+            assert kept == {0, 1}
+
+
+def test_random_projection_re_trains_and_generalizes(rng):
+    """projector='random': per-user solves in a shared Gaussian projected
+    space; with truly low-rank structure it recovers most of the signal at
+    a fraction of the per-entity dimension."""
+    n_users, rows, d, k = 30, 40, 60, 8
+    n = n_users * rows
+    users = np.repeat(np.arange(n_users), rows)
+    X = rng.normal(size=(n, d))
+    B = rng.normal(size=(k, d)) / np.sqrt(d)
+    Z = rng.normal(size=(n_users, k)) * 2
+    y = np.einsum("nd,nd->n", X, (Z @ B)[users]) + 0.05 * rng.normal(size=n)
+    data = build_game_dataset(
+        response=y,
+        feature_shards={"f": SparseBatch.from_dense(X, y)},
+        id_columns={"u": users},
+    )
+    cfg = GameConfig(
+        task="squared",
+        coordinates={
+            "re": RandomEffectConfig(
+                shard_name="f",
+                id_name="u",
+                optimizer=_opt(),
+                projector="random",
+                projected_dim=24,
+            )
+        },
+    )
+    result = GameEstimator(cfg).fit(data)
+    model = result.model.models["re"]
+    # the model IS a fixed-projection factored model
+    assert model.projection.matrix.shape == (24, d)
+    s = np.asarray(result.model.score(data))[:n]
+    # random projection to 24 of 60 dims keeps most of the fit
+    assert np.var(y - s) < 0.5 * np.var(y)
+    # scoring a dataset with unseen users gives 0
+    new = build_game_dataset(
+        response=np.zeros(10),
+        feature_shards={"f": SparseBatch.from_dense(rng.normal(size=(10, d)),
+                                                    np.zeros(10))},
+        id_columns={"u": np.arange(900, 910)},
+    )
+    np.testing.assert_array_equal(np.asarray(model.score(new))[:10], 0.0)
+
+
+def test_random_projection_config_validation():
+    with pytest.raises(ValueError, match="projected_dim"):
+        RandomEffectConfig(shard_name="f", id_name="u", projector="random")
+    with pytest.raises(ValueError, match="unknown projector"):
+        RandomEffectConfig(shard_name="f", id_name="u", projector="gauss")
+
+
+def test_random_projection_with_intercept_passthrough(rng):
+    from photon_ml_tpu.game.factored import FactoredRandomEffectCoordinate
+
+    n_users, rows, d = 8, 25, 12
+    n = n_users * rows
+    users = np.repeat(np.arange(n_users), rows)
+    X = rng.normal(size=(n, d))
+    X[:, d - 1] = 1.0  # intercept column
+    u_bias = rng.normal(size=n_users) * 3
+    y = X[:, 0] + u_bias[users] + 0.05 * rng.normal(size=n)
+    data = build_game_dataset(
+        response=y,
+        feature_shards={"f": SparseBatch.from_dense(X, y)},
+        id_columns={"u": users},
+    )
+    red = build_random_effect_dataset(data, "u", "f")
+    coord = FactoredRandomEffectCoordinate(
+        name="re", data=data, re_data=red, loss_name="squared",
+        re_config=_opt(), latent_config=_opt(), latent_dim=4,
+        refit_projection=False, projection_intercept_index=d - 1,
+    )
+    model = coord.update_model(coord.initialize_model(), None)
+    # A has 5 rows: 4 Gaussian + intercept passthrough
+    assert model.projection.matrix.shape == (5, d)
+    np.testing.assert_array_equal(
+        np.asarray(model.projection.matrix)[4, : d - 1], 0.0
+    )
+    # per-user bias is recoverable through the passthrough row
+    s = np.asarray(coord.score(model))[:n]
+    assert np.var(y - s) < 0.3 * np.var(y)
+    # the passthrough + refit combination is rejected
+    with pytest.raises(ValueError, match="refit_projection"):
+        FactoredRandomEffectCoordinate(
+            name="re", data=data, re_data=red, loss_name="squared",
+            re_config=_opt(), latent_config=_opt(), latent_dim=4,
+            projection_intercept_index=d - 1,
+        )
