@@ -36,6 +36,19 @@ def parse_optimizer_config(obj: Optional[Mapping]) -> OptimizerConfig:
     obj = dict(obj or {})
     reg_type = RegularizationType(obj.pop("regularization", "none"))
     reg = RegularizationContext(reg_type, alpha=float(obj.pop("alpha", 1.0)))
+
+    def parse_constraints(v):
+        # [[index, lower|null, upper|null], ...] (constraintMap analog)
+        out = []
+        for triple in v:
+            idx, lo, hi = triple
+            out.append((
+                int(idx),
+                float("-inf") if lo is None else float(lo),
+                float("inf") if hi is None else float(hi),
+            ))
+        return tuple(out) or None
+
     known = {
         "type": ("optimizer_type", lambda v: OptimizerType(v)),
         "max_iterations": ("max_iterations", int),
@@ -43,6 +56,7 @@ def parse_optimizer_config(obj: Optional[Mapping]) -> OptimizerConfig:
         "regularization_weight": ("regularization_weight", float),
         "lbfgs_history": ("lbfgs_history", int),
         "down_sampling_rate": ("down_sampling_rate", float),
+        "box_constraints": ("box_constraints", parse_constraints),
     }
     kwargs = {}
     for key, (field, conv) in known.items():

@@ -89,12 +89,16 @@ class BootstrapReport:
 
 @lru_cache(maxsize=32)
 def _bootstrap_solver(config: OptimizerConfig, loss_name: str):
-    def solve_one(obj, batch, weights, w0, l1):
+    def solve_one(obj, batch, weights, w0, l1, constraints):
         b = dataclasses.replace(batch, weights=weights)
-        return dispatch_solve(glm_adapter(obj, b), w0, config, l1)
+        return dispatch_solve(
+            glm_adapter(obj, b), w0, config, l1, constraints=constraints
+        )
 
-    # weights vmap over the sample axis; batch/obj/w0/l1 broadcast
-    return jax.jit(jax.vmap(solve_one, in_axes=(None, None, 0, None, None)))
+    # weights vmap over the sample axis; batch/obj/w0/l1/constraints broadcast
+    return jax.jit(
+        jax.vmap(solve_one, in_axes=(None, None, 0, None, None, None))
+    )
 
 
 def bootstrap_train(
@@ -148,7 +152,10 @@ def bootstrap_train(
     key_cfg = dataclasses.replace(config, regularization_weight=0.0)
     solver = _bootstrap_solver(key_cfg, task)
     w0 = jnp.zeros((batch.num_features,), jnp.float32)
-    res = solver(obj, batch, jnp.asarray(sample_weights, jnp.float32), w0, l1)
+    constraints = config.build_box_constraints(int(batch.num_features))
+    res = solver(
+        obj, batch, jnp.asarray(sample_weights, jnp.float32), w0, l1, constraints
+    )
     W = np.asarray(res.w)  # [B, d]
 
     coef_summaries = [CoefficientSummary.of(W[:, j]) for j in range(W.shape[1])]

@@ -97,11 +97,14 @@ def run_coordinate_descent(
     num_iterations: int,
     validation: Optional[ValidationSpec] = None,
     initial_models: Optional[Mapping[str, object]] = None,
+    on_step=None,
 ) -> CoordinateDescentResult:
     """Train all coordinates for ``num_iterations`` outer sweeps.
 
     ``coordinates`` is ordered (the updating sequence). ``initial_models``
     enables warm-starting whole coordinates from a previous run.
+    ``on_step(entry)`` fires after every (iteration, coordinate) update
+    with that step's telemetry dict (the event-bus hook).
     """
     names = list(coordinates)
     models = {
@@ -153,6 +156,8 @@ def run_coordinate_descent(
                     entry["seconds"],
                 )
             history.append(entry)
+            if on_step is not None:
+                on_step(entry)
 
     final = GameModel(task=task, models=dict(models))
     if best_model is None:

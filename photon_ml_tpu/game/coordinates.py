@@ -61,8 +61,10 @@ class Coordinate(Protocol):
 
 @lru_cache(maxsize=64)
 def _fe_solver(config: OptimizerConfig, loss_name: str):
-    def run(obj, batch, w0, l1):
-        return dispatch_solve(glm_adapter(obj, batch), w0, config, l1)
+    def run(obj, batch, w0, l1, constraints):
+        return dispatch_solve(
+            glm_adapter(obj, batch), w0, config, l1, constraints=constraints
+        )
 
     return jax.jit(run)
 
@@ -105,7 +107,33 @@ class FixedEffectCoordinate:
         self._update_count = 0
         key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
         self._solver = _fe_solver(key_cfg, self.loss_name)
+        self._constraints = self.config.build_box_constraints(
+            self._base_batch.num_features
+        )
         norm = self.normalization
+        if self._constraints is not None and norm is not None:
+            # bounds are declared in ORIGINAL space; the solve runs in
+            # normalized space where w_original = w' * factor, so enforce
+            # w' in [lo/factor, hi/factor]. With shifts, the intercept's
+            # original value additionally absorbs -w.shift at
+            # back-transform time, so an intercept bound cannot be
+            # enforced inside the solve — reject it.
+            f = norm.factors
+            if f is not None:
+                self._constraints = type(self._constraints)(
+                    lower=self._constraints.lower / f,
+                    upper=self._constraints.upper / f,
+                )
+            if norm.shifts is not None and norm.intercept_index is not None:
+                ii = norm.intercept_index
+                if np.isfinite(
+                    float(self._constraints.lower[ii])
+                ) or np.isfinite(float(self._constraints.upper[ii])):
+                    raise ValueError(
+                        "a box constraint on the intercept cannot be "
+                        "enforced under shift normalization (the intercept "
+                        "absorbs -w.shift at back-transform)"
+                    )
         self._obj = make_objective(
             self.loss_name,
             l2_weight=self.config.regularization.l2_weight(
@@ -229,6 +257,7 @@ class FixedEffectCoordinate:
                 w0,
                 self.mesh,
                 axis=self._axis,
+                constraints=self._constraints,
                 factors=None if norm is None else norm.factors,
                 shifts=None if norm is None else norm.shifts,
             )
@@ -248,12 +277,12 @@ class FixedEffectCoordinate:
                         reshape=False,
                     )
                 )
-            res = self._solver(self._obj, batch, w0, self._l1)
+            res = self._solver(self._obj, batch, w0, self._l1, self._constraints)
         else:
             batch = self._maybe_downsample(self._base_batch, update_index)
             if residual_scores is not None:
                 batch = batch.with_offsets(batch.offsets + residual_scores)
-            res = self._solver(self._obj, batch, w0, self._l1)
+            res = self._solver(self._obj, batch, w0, self._l1, self._constraints)
         w = res.w
         if norm is not None:
             w = norm.transform_model_coefficients(w)
@@ -352,6 +381,12 @@ class RandomEffectCoordinate:
 
     def __post_init__(self):
         self.config.validate(self.loss_name)
+        if self.config.box_constraints:
+            raise ValueError(
+                "box constraints address the global feature space; per-entity"
+                " solves run in projected local spaces (use them on the"
+                " fixed-effect coordinate)"
+            )
         key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
         if self.mesh is not None:
             self._sharded_solver = _re_solver_sharded(

@@ -132,13 +132,66 @@ class GameFitResult:
     history: list
 
 
+@dataclasses.dataclass
+class GridFitEntry:
+    """One combination of a fit_grid sweep: the per-coordinate optimizer
+    configs used and the resulting fit (the reference's (config, model,
+    evaluation) triple)."""
+
+    optimizer_configs: Mapping[str, OptimizerConfig]
+    result: GameFitResult
+
+
 class GameEstimator:
     """Builds datasets + coordinates from a GameConfig and trains via CD."""
 
     def __init__(self, config: GameConfig):
-        self.config = config
+        from photon_ml_tpu.utils.events import EventEmitter
 
-    def _build_coordinates(self, data: GameDataset, mesh: Optional[Mesh]) -> dict:
+        self.config = config
+        self._re_datasets: dict = {}
+        # lifecycle event bus (EventEmitter.scala analog); register
+        # listeners before fit() to observe setup/start/step/finish events
+        self.events = EventEmitter()
+
+    def _re_dataset(self, data: GameDataset, c) -> "RandomEffectDataset":
+        """Build (or reuse) the grouped/bucketed RE dataset for a config.
+
+        Keyed by the DATA-side parameters only, so a grid sweep over
+        optimizer configs shares one dataset build per coordinate
+        (prepareTrainingDataSet is outside the config loop in the
+        reference, GameEstimator.scala:135-187 vs :279-398)."""
+        ratio = getattr(c, "features_to_samples_ratio", None)
+        key = (
+            id(data), c.id_name, c.shard_name, c.active_rows_per_entity,
+            c.min_rows_per_entity, ratio,
+        )
+        hit = self._re_datasets.get(key)
+        # the cached entry pins a strong reference to its dataset, so the
+        # id() in the key cannot be recycled while the entry lives; the
+        # identity check guards the (impossible-by-construction) mismatch
+        if hit is not None and hit[0] is data:
+            return hit[1]
+        if len(self._re_datasets) >= 8:  # bound growth on long-lived estimators
+            self._re_datasets.pop(next(iter(self._re_datasets)))
+        red = build_random_effect_dataset(
+            data,
+            c.id_name,
+            c.shard_name,
+            active_rows_per_entity=c.active_rows_per_entity,
+            min_rows_per_entity=c.min_rows_per_entity,
+            features_to_samples_ratio=ratio,
+        )
+        self._re_datasets[key] = (data, red)
+        return red
+
+    def _build_coordinates(
+        self,
+        data: GameDataset,
+        mesh: Optional[Mesh],
+        opt_overrides: Optional[Mapping[str, OptimizerConfig]] = None,
+        only: Optional[set] = None,
+    ) -> dict:
         # One physical mesh, two logical 1-D views over the same devices:
         # FE rows shard over the 'data' axis, RE entity batches over the
         # 'entity' axis (SURVEY.md §2.f). Views are free — no data movement.
@@ -147,8 +200,12 @@ class GameEstimator:
             devices = mesh.devices.reshape(-1)
             data_mesh = Mesh(devices, (DATA_AXIS,))
             entity_mesh = Mesh(devices, (ENTITY_AXIS,))
+        overrides = opt_overrides or {}
         coords = {}
         for name, c in self.config.coordinates.items():
+            if only is not None and name not in only:
+                continue
+            opt = overrides.get(name)
             if isinstance(c, FixedEffectConfig):
                 norm = self._normalization_for(data, c)
                 coords[name] = FixedEffectCoordinate(
@@ -156,21 +213,14 @@ class GameEstimator:
                     data=data,
                     shard_name=c.shard_name,
                     loss_name=self.config.task,
-                    config=c.optimizer,
+                    config=opt or c.optimizer,
                     seed=c.down_sampling_seed,
                     normalization=norm,
                     mesh=data_mesh,
                     layout=c.layout,
                 )
             elif isinstance(c, RandomEffectConfig):
-                red = build_random_effect_dataset(
-                    data,
-                    c.id_name,
-                    c.shard_name,
-                    active_rows_per_entity=c.active_rows_per_entity,
-                    min_rows_per_entity=c.min_rows_per_entity,
-                    features_to_samples_ratio=c.features_to_samples_ratio,
-                )
+                red = self._re_dataset(data, c)
                 if c.projector == "random":
                     # fixed Gaussian projection: per-entity solves in the
                     # shared projected space (RandomEffectCoordinateIn
@@ -180,8 +230,8 @@ class GameEstimator:
                         data=data,
                         re_data=red,
                         loss_name=self.config.task,
-                        re_config=c.optimizer,
-                        latent_config=c.optimizer,
+                        re_config=opt or c.optimizer,
+                        latent_config=opt or c.optimizer,
                         latent_dim=c.projected_dim,
                         refit_projection=False,
                         projection_intercept_index=c.projection_intercept_index,
@@ -194,23 +244,17 @@ class GameEstimator:
                         data=data,
                         re_data=red,
                         loss_name=self.config.task,
-                        config=c.optimizer,
+                        config=opt or c.optimizer,
                         mesh=entity_mesh,
                     )
             elif isinstance(c, FactoredRandomEffectConfig):
-                red = build_random_effect_dataset(
-                    data,
-                    c.id_name,
-                    c.shard_name,
-                    active_rows_per_entity=c.active_rows_per_entity,
-                    min_rows_per_entity=c.min_rows_per_entity,
-                )
+                red = self._re_dataset(data, c)
                 coords[name] = FactoredRandomEffectCoordinate(
                     name=name,
                     data=data,
                     re_data=red,
                     loss_name=self.config.task,
-                    re_config=c.re_optimizer,
+                    re_config=opt or c.re_optimizer,
                     latent_config=c.latent_optimizer,
                     latent_dim=c.latent_dim,
                     mf_iterations=c.mf_iterations,
@@ -255,6 +299,17 @@ class GameEstimator:
         (cli/game/training/Driver.scala:262-312): ``<output_dir>/final`` and
         ``<output_dir>/best`` model directories.
         """
+        import time
+
+        from photon_ml_tpu.utils.events import (
+            OptimizationLogEvent,
+            SetupEvent,
+            TrainingFinishEvent,
+            TrainingStartEvent,
+        )
+
+        t0 = time.time()
+        self.events.send(SetupEvent(config=_config_metadata(self.config)))
         coordinates = self._build_coordinates(data, mesh)
         validation = None
         if validation_data is not None:
@@ -263,12 +318,26 @@ class GameEstimator:
             validation = ValidationSpec(
                 data=validation_data, evaluators=list(self.config.evaluators)
             )
+        self.events.send(TrainingStartEvent(num_rows=data.num_rows))
         result: CoordinateDescentResult = run_coordinate_descent(
             coordinates,
             task=self.config.task,
             num_iterations=self.config.num_iterations,
             validation=validation,
             initial_models=initial_models,
+            on_step=lambda entry: self.events.send(
+                OptimizationLogEvent(
+                    iteration=entry["iteration"],
+                    coordinate=entry["coordinate"],
+                    seconds=entry["seconds"],
+                    metrics=entry.get("metrics"),
+                )
+            ),
+        )
+        self.events.send(
+            TrainingFinishEvent(
+                best_metric=result.best_metric, seconds=time.time() - t0
+            )
         )
         fit = GameFitResult(
             model=result.model,
@@ -295,12 +364,117 @@ class GameEstimator:
             )
         return fit
 
+    def fit_grid(
+        self,
+        data: GameDataset,
+        validation_data: GameDataset,
+        grid: Mapping[str, Sequence[OptimizerConfig]],
+        mesh: Optional[Mesh] = None,
+    ) -> list["GridFitEntry"]:
+        """Sweep the cartesian product of per-coordinate optimizer configs.
+
+        The reference trains one CoordinateDescent run per combination of
+        FE x RE x factored-RE optimization configs and returns (config,
+        model, evaluation) triples (GameEstimator.scala:279-398). Datasets
+        are built once and shared across combinations; compiled solvers are
+        shared whenever two combinations agree on a coordinate's config
+        (lru-cached jit programs). Entries come back sorted best-first by
+        the primary evaluator.
+        """
+        if not self.config.evaluators:
+            raise ValueError("fit_grid needs evaluators to rank combinations")
+        unknown = set(grid) - set(self.config.coordinates)
+        if unknown:
+            raise ValueError(f"grid names unknown coordinates: {sorted(unknown)}")
+        import itertools
+        import time
+
+        from photon_ml_tpu.evaluation import better_than
+        from photon_ml_tpu.utils.events import (
+            OptimizationLogEvent,
+            SetupEvent,
+            TrainingFinishEvent,
+            TrainingStartEvent,
+        )
+
+        names = list(grid)
+        combos = list(itertools.product(*(grid[n] for n in names)))
+        validation = ValidationSpec(
+            data=validation_data, evaluators=list(self.config.evaluators)
+        )
+        primary = self.config.evaluators[0]
+        self.events.send(SetupEvent(config=_config_metadata(self.config)))
+
+        # coordinates whose config doesn't vary in a combo are reused (the
+        # FE tiled/sharded layout build is the dominant per-coordinate setup
+        # cost); keyed per (name, effective config) within this sweep
+        coord_cache: dict = {}
+
+        def coordinates_for(overrides):
+            missing = {
+                n for n in self.config.coordinates
+                if (n, overrides.get(n)) not in coord_cache
+            }
+            built = (
+                self._build_coordinates(data, mesh, overrides, only=missing)
+                if missing
+                else {}
+            )
+            out = {}
+            for n in self.config.coordinates:
+                key = (n, overrides.get(n))
+                if key not in coord_cache:
+                    coord_cache[key] = built[n]
+                out[n] = coord_cache[key]
+            return out
+
+        entries: list[GridFitEntry] = []
+        for combo in combos:
+            overrides = dict(zip(names, combo))
+            t0 = time.time()
+            self.events.send(TrainingStartEvent(num_rows=data.num_rows))
+            result = run_coordinate_descent(
+                coordinates_for(overrides),
+                task=self.config.task,
+                num_iterations=self.config.num_iterations,
+                validation=validation,
+                on_step=lambda entry: self.events.send(
+                    OptimizationLogEvent(
+                        iteration=entry["iteration"],
+                        coordinate=entry["coordinate"],
+                        seconds=entry["seconds"],
+                        metrics=entry.get("metrics"),
+                    )
+                ),
+            )
+            self.events.send(
+                TrainingFinishEvent(
+                    best_metric=result.best_metric, seconds=time.time() - t0
+                )
+            )
+            entries.append(
+                GridFitEntry(
+                    optimizer_configs=overrides,
+                    result=GameFitResult(
+                        model=result.model,
+                        best_model=result.best_model,
+                        best_metric=result.best_metric,
+                        history=result.history,
+                    ),
+                )
+            )
+        return sorted(
+            entries,
+            key=lambda e: e.result.best_metric,
+            reverse=better_than(primary, 1.0, 0.0),  # True iff maximizing
+        )
+
 
 def _config_metadata(config: GameConfig) -> dict:
     """JSON-safe description of the training config (model-metadata analog)."""
 
     def describe_opt(opt):
-        return {
+        out = {
             "type": str(opt.optimizer_type.value),
             "max_iterations": opt.max_iterations,
             "tolerance": opt.tolerance,
@@ -310,6 +484,16 @@ def _config_metadata(config: GameConfig) -> dict:
             "lbfgs_history": opt.lbfgs_history,
             "down_sampling_rate": opt.down_sampling_rate,
         }
+        if opt.box_constraints:
+            out["box_constraints"] = [
+                [
+                    i,
+                    None if lo == float("-inf") else lo,
+                    None if hi == float("inf") else hi,
+                ]
+                for i, lo, hi in opt.box_constraints
+            ]
+        return out
 
     def describe(c):
         out = {"shard_name": c.shard_name}

@@ -108,6 +108,15 @@ class FactoredRandomEffectModel:
         )
         return jnp.zeros((batch.num_rows,), batch.dtype).at[r].add(contrib)
 
+    def to_summary_string(self) -> str:
+        n_models = int(np.sum(self.entity_flat >= 0))
+        return (
+            f"FactoredRandomEffectModel(id={self.id_name}, "
+            f"shard={self.shard_name}, entities={n_models}/{len(self.vocab)}, "
+            f"latent_dim={self.latent_dim}, "
+            f"original_dim={self.projection.original_dim})"
+        )
+
     def effective_coefficients(self, entity_value) -> Optional[Array]:
         """Original-space d-dim coefficients A^T c_e for one entity (the
         projectCoefficients view), or None if the entity is unseen."""
@@ -230,6 +239,10 @@ class FactoredRandomEffectCoordinate:
             )
         self.re_config.validate(self.loss_name)
         self.latent_config.validate(self.loss_name)
+        if self.re_config.box_constraints or self.latent_config.box_constraints:
+            raise ValueError(
+                "box constraints are not supported in latent/projected spaces"
+            )
         k = self.latent_dim
         d = self.re_data.num_global_features
         buckets = self.re_data.buckets

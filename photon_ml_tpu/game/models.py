@@ -46,6 +46,14 @@ class FixedEffectModel:
         """Raw scores x.w for every example row ([n_pad] aligned array)."""
         return data.shard(self.shard_name).dot_rows(self.coefficients)
 
+    def to_summary_string(self) -> str:
+        w = np.asarray(self.coefficients)
+        nnz = int(np.sum(np.abs(w) > 1e-9))
+        return (
+            f"FixedEffectModel(shard={self.shard_name}, features={len(w)}, "
+            f"nonzero={nnz}, |w|2={float(np.linalg.norm(w)):.4g})"
+        )
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +86,15 @@ class RandomEffectModel:
         """Map a dataset's entity VALUES to training codes (-1 if unseen)."""
         idc = data.id_columns[self.id_name]
         return map_vocab_codes(self.vocab, idc.vocab[idc.codes])
+
+    def to_summary_string(self) -> str:
+        n_models = int(np.sum(self.entity_bucket >= 0))
+        dims = [int(b.coefficients.shape[1]) for b in self.buckets]
+        return (
+            f"RandomEffectModel(id={self.id_name}, shard={self.shard_name}, "
+            f"entities={n_models}/{len(self.vocab)}, "
+            f"buckets={len(self.buckets)}, local_dims={dims})"
+        )
 
     def score(self, data: GameDataset) -> Array:
         """Scores for every example row; entities without a model score 0.
@@ -164,3 +181,17 @@ class GameModel:
         new = dict(self.models)
         new[name] = model
         return dataclasses.replace(self, models=new)
+
+    def to_summary_string(self) -> str:
+        """Structured one-summary-per-sub-model log string (the reference's
+        toSummaryString protocol, e.g. GAMEModel/RandomEffectDataSet
+        .toSummaryString)."""
+        lines = [f"GameModel(task={self.task}, coordinates={len(self.models)})"]
+        for name, sub in self.models.items():
+            summary = (
+                sub.to_summary_string()
+                if hasattr(sub, "to_summary_string")
+                else repr(sub)
+            )
+            lines.append(f"  {name}: {summary}")
+        return "\n".join(lines)

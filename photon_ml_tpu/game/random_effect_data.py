@@ -111,6 +111,24 @@ class RandomEffectDataset:
     passive_rows: np.ndarray  # i64[num_passive] global example rows
     num_global_features: int
 
+    def to_summary_string(self) -> str:
+        """RandomEffectDataSet.toSummaryString analog (:174-197): per-bucket
+        geometry + active/passive split."""
+        n_active = int(np.sum(self.entity_bucket >= 0))
+        lines = [
+            f"RandomEffectDataset(id={self.id_name}, shard={self.shard_name}, "
+            f"active_entities={n_active}/{self.num_entities}, "
+            f"passive_rows={len(self.passive_rows)})"
+        ]
+        for i, b in enumerate(self.buckets):
+            lines.append(
+                f"  bucket {i}: entities={b.num_entities} "
+                f"rows/entity={b.rows_per_entity} "
+                f"local_features={b.num_local_features} "
+                f"nnz/entity={b.values.shape[1]}"
+            )
+        return "\n".join(lines)
+
 
 _PEARSON_STD_EPS = 1e-8  # MathConst.MEDIUM_PRECISION_TOLERANCE_THRESHOLD
 

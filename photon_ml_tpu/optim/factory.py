@@ -69,7 +69,14 @@ class RegularizationContext:
 
 @dataclasses.dataclass(frozen=True)
 class OptimizerConfig:
-    """Typed analog of the reference's OptimizerConfig + GLMOptimizationConfiguration."""
+    """Typed analog of the reference's OptimizerConfig + GLMOptimizationConfiguration.
+
+    ``box_constraints`` holds (feature_index, lower, upper) triples — the
+    constraintMap analog (OptimizerConfig.scala); every optimizer projects
+    iterates into the hypercube. Indices address the GLOBAL feature space,
+    so constraints apply to fixed-effect / plain-GLM solves only (per-entity
+    projected spaces renumber features; matching reference scope).
+    """
 
     optimizer_type: OptimizerType = OptimizerType.LBFGS
     max_iterations: int = 100
@@ -78,6 +85,29 @@ class OptimizerConfig:
     regularization_weight: float = 0.0
     lbfgs_history: int = 10
     down_sampling_rate: float = 1.0
+    box_constraints: Optional[tuple[tuple[int, float, float], ...]] = None
+
+    def build_box_constraints(self, num_features: int) -> Optional[BoxConstraints]:
+        """Materialize the sparse (index, lower, upper) triples as dense
+        projection bounds for a ``num_features``-dim solve."""
+        if not self.box_constraints:
+            return None
+        import numpy as np
+
+        lower = np.full(num_features, -np.inf)
+        upper = np.full(num_features, np.inf)
+        for idx, lo, hi in self.box_constraints:
+            if not 0 <= idx < num_features:
+                raise ValueError(
+                    f"box constraint index {idx} out of range [0, {num_features})"
+                )
+            if lo > hi:
+                raise ValueError(f"box constraint [{lo}, {hi}] is empty")
+            lower[idx], upper[idx] = lo, hi
+        return BoxConstraints(
+            lower=jnp.asarray(lower, jnp.float32),
+            upper=jnp.asarray(upper, jnp.float32),
+        )
 
     def validate(self, loss_name: str) -> None:
         uses_l1 = self.regularization.reg_type in (
@@ -189,6 +219,8 @@ def solve(
     obj = build_objective(loss_name, config, factors=factors, shifts=shifts)
     adapter = glm_adapter(obj, batch)
     l1 = config.regularization.l1_weight(config.regularization_weight)
+    if constraints is None:
+        constraints = config.build_box_constraints(batch.num_features)
     return dispatch_solve(
         adapter, w0, config, l1, constraints, init_value, init_grad_norm
     )

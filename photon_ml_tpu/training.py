@@ -108,6 +108,8 @@ def train_glm(
     if not lambdas:
         raise ValueError("lambdas must be non-empty")
     config.validate(task)
+    if constraints is None:
+        constraints = config.build_box_constraints(int(batch.num_features))
     task = get_loss(task).name
 
     factors = shifts = None

@@ -1,0 +1,78 @@
+"""Event system: a typed pub/sub bus for training lifecycle events.
+
+Reference analog: photon-client event/ (EventEmitter.scala:24-72 —
+register/send/clear listener mixin; listeners loaded by class name from the
+--event-listeners flag, Driver.scala:110-118) and the event types
+PhotonSetupEvent / TrainingStartEvent / TrainingFinishEvent /
+PhotonOptimizationLogEvent. Listeners are plain callables here; the
+training driver and GameEstimator emit on one shared emitter instance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Mapping, Optional
+
+logger = logging.getLogger("photon_ml_tpu.events")
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class SetupEvent(Event):
+    """PhotonSetupEvent analog: emitted once with the parsed config."""
+
+    config: Mapping[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingStartEvent(Event):
+    num_rows: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingFinishEvent(Event):
+    best_metric: Optional[float]
+    seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationLogEvent(Event):
+    """PhotonOptimizationLogEvent analog: one per (CD iteration,
+    coordinate) update, carrying that step's telemetry entry."""
+
+    iteration: int
+    coordinate: str
+    seconds: float
+    metrics: Optional[Mapping[str, float]] = None
+
+
+class EventEmitter:
+    """register/send/clear listener registry (EventEmitter.scala analog).
+
+    A listener raising is logged and skipped — observability must never
+    fail training."""
+
+    def __init__(self):
+        self._listeners: list[Callable[[Event], None]] = []
+
+    def register(self, listener: Callable[[Event], None]) -> None:
+        self._listeners.append(listener)
+
+    def clear(self) -> None:
+        self._listeners.clear()
+
+    def send(self, event: Event) -> None:
+        for listener in self._listeners:
+            try:
+                listener(event)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "event listener %r failed on %s",
+                    listener,
+                    type(event).__name__,
+                )
