@@ -9,9 +9,10 @@ import sys
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m photon_ml_tpu.cli {train|score} [options]")
-        print("  train --config <json> [--output-dir <dir>]")
+        print("usage: python -m photon_ml_tpu.cli {train|score|glm} [options]")
+        print("  train --config <json> [--output-dir <dir>]   GAME training")
         print("  score --model-dir <dir> --config <json> [--output <avro>]")
+        print("  glm   --config <json> [--output-dir <dir>]   staged legacy GLM")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "train":
@@ -22,7 +23,11 @@ def main(argv=None) -> int:
         from photon_ml_tpu.cli.score import main as score_main
 
         return score_main(rest)
-    print(f"unknown command '{cmd}' (expected train|score)", file=sys.stderr)
+    if cmd == "glm":
+        from photon_ml_tpu.cli.glm import main as glm_main
+
+        return glm_main(rest)
+    print(f"unknown command '{cmd}' (expected train|score|glm)", file=sys.stderr)
     return 2
 
 

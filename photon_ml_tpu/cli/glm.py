@@ -1,0 +1,382 @@
+"""Legacy single-GLM driver: the staged pipeline
+INIT -> PREPROCESSED -> TRAINED -> VALIDATED -> DIAGNOSED.
+
+Reference analog: photon-client Driver.scala:71-732 — each stage asserts
+its predecessor completed (assertDriverStage/updateStage, :633-651), the
+train stage runs the warm-started lambda sweep via ModelTraining, validate
+computes per-lambda metrics and selects the best model, diagnose runs the
+photon-diagnostics suite and renders an HTML report, and models are
+written in text form (IOUtils.writeModelsInText):
+
+    python -m photon_ml_tpu.cli glm --config glm.json
+
+Config:
+
+    {
+      "task": "logistic",
+      "input": {"format": "libsvm", "paths": ["a1a"]},
+      "validation": {"paths": ["a1a.t"]},     # optional
+      "optimizer": {"type": "lbfgs", "regularization": "l2"},
+      "lambdas": [100.0, 10.0, 1.0, 0.1],
+      "normalization": "standardization",      # optional
+      "compute_variances": false,
+      "diagnostics": true,
+      "validation_mode": "full",               # full | sample | disabled
+      "output_dir": "out/"
+    }
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import json
+import os
+from typing import Mapping, Optional
+
+import numpy as np
+
+from photon_ml_tpu.cli.train import read_input
+from photon_ml_tpu.utils import logger, setup_logging, timed
+from photon_ml_tpu.utils.events import (
+    EventEmitter,
+    OptimizationLogEvent,
+    SetupEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
+
+
+class DriverStage(enum.IntEnum):
+    """Pipeline stages with strict ordering (DriverStage.scala)."""
+
+    INIT = 0
+    PREPROCESSED = 1
+    TRAINED = 2
+    VALIDATED = 3
+    DIAGNOSED = 4
+
+
+class GLMDriver:
+    """Staged legacy GLM pipeline. ``stage_history`` records every stage
+    transition (the MockDriver assertion surface in the reference tests)."""
+
+    def __init__(self, config: Mapping, output_dir: Optional[str] = None):
+        self.config = dict(config)
+        self.output_dir = output_dir or self.config.get("output_dir")
+        self.stage = DriverStage.INIT
+        self.stage_history: list[DriverStage] = [DriverStage.INIT]
+        self.events = EventEmitter()
+        self.sweep = None  # list[SweepEntry]
+        self.best = None  # (SweepEntry, metric)
+        self.metrics: dict[float, dict] = {}
+        self._batch = None
+        self._val_batch = None
+        self._normalization = None
+        self._summary = None
+
+    # -- stage protocol (Driver.scala:633-651) ------------------------------
+
+    def _assert_stage(self, expected: DriverStage) -> None:
+        if self.stage != expected:
+            raise RuntimeError(
+                f"driver stage must be {expected.name} but is {self.stage.name}"
+            )
+
+    def _update_stage(self, new: DriverStage) -> None:
+        self.stage = new
+        self.stage_history.append(new)
+
+    # -- stages --------------------------------------------------------------
+
+    def preprocess(self) -> None:
+        """Read + validate + summarize + build the normalization context
+        (Driver.scala:300-325)."""
+        from photon_ml_tpu.data.normalization import (
+            NormalizationType,
+            build_normalization_context,
+        )
+        from photon_ml_tpu.data.stats import summarize
+        from photon_ml_tpu.data.validators import ValidationMode, validate
+
+        from photon_ml_tpu.data.index_map import INTERCEPT_KEY
+
+        task = self.config["task"]
+        in_spec = self.config["input"]
+        data, index_maps = read_input(in_spec)
+        shard = next(iter(data.feature_shards))
+        self._batch = data.batch_for(shard)
+        # accept the short aliases full/sample/disabled as well as the
+        # reference's VALIDATE_FULL-style names
+        raw_mode = str(self.config.get("validation_mode", "full")).lower()
+        if not raw_mode.startswith("validate_"):
+            raw_mode = f"validate_{raw_mode}"
+        mode = ValidationMode(raw_mode)
+        validate(self._batch, task, mode=mode)
+        self._summary = summarize(self._batch)
+
+        # locate the intercept column: explicit config wins; otherwise
+        # libsvm's appended last column / the avro index map's intercept key
+        add_intercept = bool(in_spec.get("add_intercept", True))
+        intercept_index = self.config.get("intercept_index")
+        if intercept_index is None and add_intercept:
+            if index_maps is not None:  # avro: look up the intercept key
+                imap = index_maps[shard]
+                idx = imap.get(INTERCEPT_KEY)
+                intercept_index = idx if idx >= 0 else None
+            else:  # libsvm: intercept is appended as the LAST column
+                intercept_index = self._batch.num_features - 1
+        self._intercept_index = intercept_index
+
+        ntype = NormalizationType(self.config.get("normalization", "none"))
+        if ntype != NormalizationType.NONE:
+            self._normalization = build_normalization_context(
+                ntype,
+                self._summary,
+                intercept_index=intercept_index,
+            )
+        if self.config.get("validation"):
+            vspec = {**in_spec, **self.config["validation"]}
+            if in_spec.get("format", "avro") == "libsvm":
+                # pin the raw feature dimension to training's
+                d_raw = self._batch.num_features - (1 if add_intercept else 0)
+                vspec["num_features"] = d_raw
+            val_data, _ = read_input(vspec, index_maps=index_maps)
+            self._val_batch = val_data.batch_for(
+                next(iter(val_data.feature_shards))
+            )
+            if self._val_batch.num_features != self._batch.num_features:
+                raise ValueError(
+                    f"validation feature dimension "
+                    f"{self._val_batch.num_features} != training "
+                    f"{self._batch.num_features}"
+                )
+            validate(self._val_batch, task, mode=mode)
+
+    def train(self) -> None:
+        """Warm-started lambda sweep (ModelTraining via training.train_glm;
+        Driver.scala:330-348)."""
+        from photon_ml_tpu.config import parse_optimizer_config
+        from photon_ml_tpu.training import train_glm
+
+        opt = parse_optimizer_config(self.config.get("optimizer"))
+        lambdas = [float(x) for x in self.config.get("lambdas", [0.0])]
+        self.sweep = train_glm(
+            self._batch,
+            self.config["task"],
+            lambdas,
+            opt,
+            normalization=self._normalization,
+            compute_variances=bool(self.config.get("compute_variances", False)),
+        )
+        for e in self.sweep:
+            self.events.send(
+                OptimizationLogEvent(
+                    iteration=int(e.result.iterations),
+                    coordinate=f"lambda={e.reg_weight}",
+                    seconds=0.0,
+                )
+            )
+
+    def validate_models(self) -> None:
+        """Per-lambda validation metrics + best-model selection
+        (Driver.scala:448-457, computeAndLogModelMetrics + ModelSelection)."""
+        from photon_ml_tpu.diagnostics import evaluate
+        from photon_ml_tpu.training import select_best_model
+
+        # score each model on the validation batch ONCE; evaluate() and the
+        # selection metric both consume the cached margins
+        score_cache = {}
+        for e in self.sweep:
+            score_cache[id(e.model)] = e.model.compute_score(self._val_batch)
+            self.metrics[e.reg_weight] = evaluate(e.model, self._val_batch)
+        self.best = select_best_model(
+            self.sweep,
+            self._val_batch,
+            scorer=lambda m: score_cache[id(m)],
+        )
+        logger.info(
+            "best lambda=%s (metric %.6g)", self.best[0].reg_weight, self.best[1]
+        )
+
+    def diagnose(self) -> dict:
+        """Diagnostics + HTML/text report (Driver.scala:600-627,
+        writeDiagnostics:711-731). Returns report paths."""
+        from photon_ml_tpu.config import parse_optimizer_config
+        from photon_ml_tpu.diagnostics import (
+            Chapter,
+            bootstrap_train,
+            diagnose_model,
+            fitting_diagnostic,
+            render_html,
+            render_text,
+        )
+        from photon_ml_tpu.diagnostics.fitting import fitting_report_sections
+
+        model = (self.best or (self.sweep[-1], None))[0].model
+        doc = diagnose_model(model, self._batch, summary=self._summary)
+
+        opt = parse_optimizer_config(self.config.get("optimizer"))
+        lam = (self.best or (self.sweep[-1], None))[0].reg_weight
+        extra = []
+        if self.config.get("diagnostic_fitting", True):
+            fit_rep = fitting_diagnostic(
+                self._batch,
+                self.config["task"],
+                dataclasses.replace(opt, regularization_weight=lam),
+                lambdas=[lam],
+                normalization=self._normalization,
+            )
+            extra.append(Chapter("Fitting curves", fitting_report_sections(fit_rep)))
+        if self.config.get("diagnostic_bootstrap", True):
+            boot = bootstrap_train(
+                self._batch,
+                self.config["task"],
+                dataclasses.replace(opt, regularization_weight=lam),
+                num_samples=int(self.config.get("bootstrap_samples", 8)),
+                normalization=self._normalization,
+            )
+            from photon_ml_tpu.diagnostics import Section, Table
+
+            extra.append(
+                Chapter(
+                    "Bootstrap confidence intervals",
+                    [
+                        Section(
+                            "Per-coefficient summaries",
+                            [
+                                Table(
+                                    header=["coefficient", "summary"],
+                                    rows=[
+                                        (j, s.to_summary_string())
+                                        for j, s in enumerate(
+                                            boot.coefficient_summaries
+                                        )
+                                    ],
+                                )
+                            ],
+                        )
+                    ],
+                )
+            )
+        doc = dataclasses.replace(doc, chapters=list(doc.chapters) + extra)
+
+        paths = {}
+        if self.output_dir:
+            os.makedirs(self.output_dir, exist_ok=True)
+            html_path = os.path.join(self.output_dir, "diagnostic-report.html")
+            text_path = os.path.join(self.output_dir, "diagnostic-report.txt")
+            with open(html_path, "w") as f:
+                f.write(render_html(doc))
+            with open(text_path, "w") as f:
+                f.write(render_text(doc))
+            paths = {"html": html_path, "text": text_path}
+        return paths
+
+    def write_models(self) -> Optional[str]:
+        """Per-lambda models: npz via the model store plus the text format
+        (learned-models-text / IOUtils.writeModelsInText analog: one
+        `index<TAB>value[<TAB>variance]` line per nonzero coefficient)."""
+        if not self.output_dir:
+            return None
+        from photon_ml_tpu.data.model_store import save_glm
+
+        text_dir = os.path.join(self.output_dir, "learned-models-text")
+        os.makedirs(text_dir, exist_ok=True)
+        for e in self.sweep:
+            save_glm(
+                e.model,
+                os.path.join(self.output_dir, "models", f"lambda-{e.reg_weight}"),
+            )
+            means = np.asarray(e.model.coefficients.means)
+            variances = e.model.coefficients.variances
+            lines = []
+            for j in np.nonzero(means)[0]:
+                cols = [str(int(j)), repr(float(means[j]))]
+                if variances is not None:
+                    cols.append(repr(float(np.asarray(variances)[j])))
+                lines.append("\t".join(cols))
+            with open(
+                os.path.join(text_dir, f"lambda-{e.reg_weight}.txt"), "w"
+            ) as f:
+                f.write("\n".join(lines) + "\n")
+        return text_dir
+
+    # -- pipeline ------------------------------------------------------------
+
+    def run(self) -> dict:
+        import time
+
+        t0 = time.time()
+        self.events.send(SetupEvent(config=self.config))
+        self.events.send(TrainingStartEvent(num_rows=0))
+
+        self._assert_stage(DriverStage.INIT)
+        with timed("preprocess"):
+            self.preprocess()
+        self._update_stage(DriverStage.PREPROCESSED)
+
+        self._assert_stage(DriverStage.PREPROCESSED)
+        with timed("train"):
+            self.train()
+        self._update_stage(DriverStage.TRAINED)
+
+        if self._val_batch is not None:
+            self._assert_stage(DriverStage.TRAINED)
+            with timed("validate"):
+                self.validate_models()
+            self._update_stage(DriverStage.VALIDATED)
+
+        report_paths = {}
+        if self.config.get("diagnostics", False):
+            self._assert_stage(
+                DriverStage.VALIDATED
+                if self._val_batch is not None
+                else DriverStage.TRAINED
+            )
+            with timed("diagnose"):
+                report_paths = self.diagnose()
+            self._update_stage(DriverStage.DIAGNOSED)
+
+        with timed("write models"):
+            text_dir = self.write_models()
+
+        self.events.send(
+            TrainingFinishEvent(
+                best_metric=self.best[1] if self.best else None,
+                seconds=time.time() - t0,
+            )
+        )
+        return {
+            "stages": [s.name for s in self.stage_history],
+            "lambdas": [e.reg_weight for e in self.sweep],
+            "best_lambda": self.best[0].reg_weight if self.best else None,
+            "best_metric": self.best[1] if self.best else None,
+            "metrics": {
+                str(k): {m: float(v) for m, v in mm.items()}
+                for k, mm in self.metrics.items()
+            },
+            "models_text_dir": text_dir,
+            "report": report_paths,
+        }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli glm", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("--config", required=True, help="JSON config path")
+    parser.add_argument("--output-dir", help="override config output_dir")
+    args = parser.parse_args(argv)
+
+    setup_logging()
+    with open(args.config) as f:
+        config = json.load(f)
+    summary = GLMDriver(config, output_dir=args.output_dir).run()
+    print(json.dumps(summary, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -90,7 +90,13 @@ def read_input(
                 raise ValueError("libsvm input takes exactly one path")
             paths = paths[0]
         lib = read_libsvm(paths)
-        batch = lib.to_batch(add_intercept=bool(spec.pop("add_intercept", True)))
+        # "num_features" pins the RAW (pre-intercept) feature dimension so a
+        # validation/scoring file whose max feature id differs from
+        # training's still produces an aligned batch
+        batch = lib.to_batch(
+            num_features=spec.pop("num_features", None),
+            add_intercept=bool(spec.pop("add_intercept", True)),
+        )
         labels = np.asarray(lib.labels)
         if spec.pop("binarize_labels", True):
             labels = (labels > 0).astype(np.float64)

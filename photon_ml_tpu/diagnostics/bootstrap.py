@@ -110,6 +110,7 @@ def bootstrap_train(
     seed: int = 0,
     keep_models: bool = False,
     metrics_fn: Optional[Callable] = None,
+    normalization=None,
 ) -> BootstrapReport:
     """Train ``num_samples`` bootstrap refits and aggregate.
 
@@ -144,9 +145,14 @@ def bootstrap_train(
         sample_weights[b, train_rows] = base_w[train_rows] * counts
         holdout_masks[b, holdout_rows] = True
 
+    factors = shifts = None
+    if normalization is not None:
+        factors, shifts = normalization.factors, normalization.shifts
     obj = make_objective(
         task,
         l2_weight=config.regularization.l2_weight(config.regularization_weight),
+        factors=factors,
+        shifts=shifts,
     )
     l1 = jnp.float32(config.regularization.l1_weight(config.regularization_weight))
     key_cfg = dataclasses.replace(config, regularization_weight=0.0)
@@ -156,7 +162,12 @@ def bootstrap_train(
     res = solver(
         obj, batch, jnp.asarray(sample_weights, jnp.float32), w0, l1, constraints
     )
-    W = np.asarray(res.w)  # [B, d]
+    W = np.asarray(res.w)  # [B, d], optimization (normalized) space
+    if normalization is not None:
+        # models live in original space (createModel parity)
+        W = np.asarray(
+            jax.vmap(normalization.transform_model_coefficients)(res.w)
+        )
 
     coef_summaries = [CoefficientSummary.of(W[:, j]) for j in range(W.shape[1])]
 

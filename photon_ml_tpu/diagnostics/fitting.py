@@ -53,6 +53,7 @@ def fitting_diagnostic(
     num_partitions: int = NUM_TRAINING_PARTITIONS,
     seed: int = 0,
     metrics_fn: Optional[Callable] = None,
+    normalization=None,
 ) -> FittingReport:
     """Train on growing prefixes (1/P, 2/P, ... (P-1)/P of the rows), with
     the final 1/P as hold-out; warm-start each portion from the previous
@@ -89,6 +90,7 @@ def fitting_diagnostic(
             task,
             list(lambdas),
             config,
+            normalization=normalization,
             initial_model=warm.get(max(lambdas)) if warm else None,
         )
         for e in entries:

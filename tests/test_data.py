@@ -213,3 +213,33 @@ def test_index_map_save_detects_hash_collision(tmp_path, monkeypatch):
     monkeypatch.setattr(im_mod, "_hash64", lambda key: 42)
     with pytest.raises(ValueError, match="collision"):
         m.save(str(tmp_path / "idx"))
+
+
+def test_testing_generators_smoke(rng):
+    """Shared generator module (GameTestUtils analog): shapes, ground-truth
+    recoverability, and task coverage."""
+    from photon_ml_tpu.testing import (
+        generate_game_dataset,
+        generate_glm_problem,
+        generate_low_rank_game_dataset,
+    )
+    from photon_ml_tpu.optim import OptimizerConfig, solve
+
+    import jax.numpy as jnp
+
+    for task in ("logistic", "squared", "poisson"):
+        p = generate_glm_problem(task, n=300, d=8, seed=3)
+        assert p.batch.num_features == 8
+        res = solve(task, p.batch, OptimizerConfig(),
+                    jnp.zeros(8, jnp.float32))
+        corr = np.corrcoef(np.asarray(res.w), p.w_true)[0, 1]
+        assert corr > 0.8, f"{task}: corr {corr}"
+
+    data, truth = generate_game_dataset("squared", n_users=6, rows_per_user=10)
+    assert data.num_rows == 60
+    assert set(data.feature_shards) == {"global", "user"}
+    assert data.id_columns["userId"].num_entities == 6
+
+    data2, truth2 = generate_low_rank_game_dataset(n_users=8, rows_per_user=5)
+    assert truth2["W"].shape == (8, 30)
+    assert np.linalg.matrix_rank(truth2["W"]) == 2

@@ -1,0 +1,158 @@
+"""Legacy staged GLM driver: stage sequencing (MockDriver-style
+assertions), lambda sweep + best selection, text models, diagnostics
+report rendering."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.glm import DriverStage, GLMDriver
+
+
+@pytest.fixture()
+def libsvm_files(rng, tmp_path):
+    from photon_ml_tpu.testing import write_libsvm
+
+    def write(path, n, d=10, seed=None):
+        # one planted model (fixed seed) for train AND validation so
+        # selection metrics are meaningful; fresh rows per file
+        w = np.asarray([1.5, -2.0, 0.0, 1.0, 0.5, -1.0, 0.0, 0.8, -0.3, 0.2])
+        X = (rng.random((n, d)) < 0.5) * rng.normal(size=(n, d))
+        y = np.sign(X @ w + 0.2 * rng.normal(size=n))
+        return write_libsvm(str(path), X, y)
+
+    train = write(tmp_path / "train.libsvm", 300)
+    val = write(tmp_path / "val.libsvm", 150)
+    return tmp_path, train, val
+
+
+def _config(train, val=None, **kw):
+    cfg = {
+        "task": "logistic",
+        "input": {"format": "libsvm", "paths": [train]},
+        "optimizer": {"regularization": "l2"},
+        "lambdas": [10.0, 1.0, 0.1],
+        **kw,
+    }
+    if val:
+        cfg["validation"] = {"paths": [val]}
+    return cfg
+
+
+def test_stage_sequence_train_only(libsvm_files):
+    tmp, train, val = libsvm_files
+    driver = GLMDriver(_config(train))
+    summary = driver.run()
+    assert summary["stages"] == ["INIT", "PREPROCESSED", "TRAINED"]
+    assert summary["best_lambda"] is None
+    assert len(summary["lambdas"]) == 3
+
+
+def test_stage_sequence_full_pipeline(libsvm_files):
+    tmp, train, val = libsvm_files
+    out = str(tmp / "out")
+    driver = GLMDriver(
+        _config(
+            train, val, diagnostics=True, output_dir=out,
+            bootstrap_samples=4, compute_variances=True,
+        )
+    )
+    summary = driver.run()
+    assert summary["stages"] == [
+        "INIT", "PREPROCESSED", "TRAINED", "VALIDATED", "DIAGNOSED",
+    ]
+    # best lambda selected by validation AUC
+    assert summary["best_lambda"] in (10.0, 1.0, 0.1)
+    assert 0.5 < summary["best_metric"] <= 1.0
+    # per-lambda validation metrics recorded
+    assert set(summary["metrics"]) == {"10.0", "1.0", "0.1"}
+    assert all("Area under ROC" in m for m in summary["metrics"].values())
+    # diagnostics report written
+    assert os.path.exists(summary["report"]["html"])
+    html = open(summary["report"]["html"]).read()
+    assert "Hosmer-Lemeshow" in html and "Bootstrap" in html
+    assert "Fitting curves" in html
+    # text models: one file per lambda, index<TAB>value<TAB>variance lines
+    txts = sorted(os.listdir(summary["models_text_dir"]))
+    assert txts == ["lambda-0.1.txt", "lambda-1.0.txt", "lambda-10.0.txt"]
+    first = open(
+        os.path.join(summary["models_text_dir"], txts[0])
+    ).read().strip().splitlines()
+    parts = first[0].split("\t")
+    assert len(parts) == 3  # variance column present
+    int(parts[0]); float(parts[1]); float(parts[2])
+    # npz models load back
+    from photon_ml_tpu.data.model_store import load_glm
+
+    m = load_glm(os.path.join(out, "models", "lambda-1.0"))
+    assert m.task == "logistic"
+    assert m.coefficients.variances is not None
+
+
+def test_stage_assertion_rejects_out_of_order(libsvm_files):
+    tmp, train, val = libsvm_files
+    driver = GLMDriver(_config(train))
+    with pytest.raises(RuntimeError, match="PREPROCESSED"):
+        driver._assert_stage(DriverStage.PREPROCESSED)
+    driver.preprocess()
+    driver._update_stage(DriverStage.PREPROCESSED)
+    driver._assert_stage(DriverStage.PREPROCESSED)
+
+
+def test_driver_with_normalization(libsvm_files):
+    tmp, train, val = libsvm_files
+    driver = GLMDriver(
+        _config(
+            train, val,
+            normalization="scale_with_standard_deviation",
+        )
+    )
+    summary = driver.run()
+    assert summary["stages"][-1] == "VALIDATED"
+    assert summary["best_metric"] > 0.5
+
+
+def test_cli_glm_subprocess(libsvm_files):
+    import subprocess
+    import sys
+
+    tmp, train, val = libsvm_files
+    cfg_path = tmp / "glm.json"
+    cfg_path.write_text(json.dumps(_config(train, val, output_dir=str(tmp / "o"))))
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli", "glm",
+         "--config", str(cfg_path)],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["stages"][-1] == "VALIDATED"
+
+
+def test_validation_feature_space_pinned_to_training(rng, tmp_path):
+    """A validation file whose max feature id is smaller than training's
+    must still align (num_features pinned; regression for the libsvm
+    per-file dimension inference)."""
+    from photon_ml_tpu.testing import write_libsvm
+
+    d = 12
+    w = rng.normal(size=d)
+    Xt = (rng.random((200, d)) < 0.5) * rng.normal(size=(200, d))
+    Xt[0, d - 1] = 1.0  # training definitely reaches feature id d
+    yt = np.sign(Xt @ w + 0.1 * rng.normal(size=200))
+    Xv = Xt[:80].copy()
+    Xv[:, d - 1] = 0.0  # validation NEVER contains the highest feature id
+    yv = np.sign(Xv @ w + 0.1 * rng.normal(size=80))
+    train = write_libsvm(str(tmp_path / "t.libsvm"), Xt, yt)
+    val = write_libsvm(str(tmp_path / "v.libsvm"), Xv, yv)
+
+    driver = GLMDriver(_config(train, val, normalization="standardization"))
+    summary = driver.run()
+    assert summary["stages"][-1] == "VALIDATED"
+    assert summary["best_metric"] > 0.8  # same planted model -> real AUC
