@@ -1,0 +1,153 @@
+"""Benchmark suite: the remaining BASELINE.md configs beyond bench.py (#1)
+and bench_game.py (#4). Prints ONE JSON line PER config.
+
+  #2: linear regression + TRON, sparse 1M x 10K (elastic-net is L1-bearing
+      and TRON rejects L1 per OptimizerFactory parity, so TRON runs the L2
+      member of the elastic family; an OWLQN elastic-net line is measured
+      alongside for the L1 half).
+  #3: Poisson regression with offset training + per-coefficient box
+      constraints.
+
+Timing recipe per PERF_NOTES.md: warm up with different arg values (the
+tunnel TPU result-caches identical calls), sync via scalar fetch.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _sparse_problem(rng, n_rows, n_features, nnz_per_row, kind):
+    nnz = n_rows * nnz_per_row
+    rows = np.repeat(np.arange(n_rows, dtype=np.int64), nnz_per_row)
+    cols = rng.integers(0, n_features, size=nnz)
+    values = rng.normal(size=nnz)
+    w_true = rng.normal(size=n_features) * 0.5
+    margins = np.zeros(n_rows)
+    np.add.at(margins, rows, values * w_true[cols])
+    if kind == "linear":
+        y = margins + 0.1 * rng.normal(size=n_rows)
+        offsets = None
+    elif kind == "poisson":
+        offsets = rng.normal(size=n_rows) * 0.3  # exposure offsets
+        y = rng.poisson(np.exp(np.clip(0.2 * margins + offsets, -4, 4)))
+        y = y.astype(np.float64)
+    else:
+        raise ValueError(kind)
+    return values, rows, cols, y, offsets
+
+
+def _run(solver, batch, w0, n_rows):
+    import jax
+
+    res = solver(w0, batch)
+    float(res.value)  # warm-up sync
+    t0 = time.perf_counter()
+    res = solver(w0 + 1e-6, batch)  # fresh args defeat result caching
+    final = float(res.value)
+    elapsed = time.perf_counter() - t0
+    iters = int(res.iterations)
+    return {
+        "elapsed_s": round(elapsed, 3),
+        "iterations": iters,
+        "final_loss": final,
+        "rows_per_sec": round(n_rows * (iters + 1) / elapsed, 1),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.ops.tiled import TiledBatch
+    from photon_ml_tpu.optim import (
+        BoxConstraints,
+        LBFGSConfig,
+        TRONConfig,
+        glm_adapter,
+        owlqn_solve,
+        tron_solve,
+    )
+
+    rng = np.random.default_rng(0)
+    n_rows, n_features, nnz_per_row = 1_000_000, 10_000, 20
+
+    # --- config #2: linear + TRON (L2), + OWLQN elastic-net companion ----
+    values, rows, cols, y, _ = _sparse_problem(
+        rng, n_rows, n_features, nnz_per_row, "linear"
+    )
+    batch = TiledBatch.from_coo(
+        values=values, rows=rows, cols=cols, labels=y, num_features=n_features
+    )
+    obj = make_objective("squared", l2_weight=1.0)
+    tron_cfg = TRONConfig(max_iterations=10, tolerance=0.0)
+
+    def tron_run(w0, b):
+        return tron_solve(glm_adapter(obj, b), w0, tron_cfg)
+
+    w0 = jnp.zeros((n_features,), jnp.float32)
+    d = _run(jax.jit(tron_run), batch, w0, n_rows)
+    # rows/s counts OUTER passes only; each TRON iteration additionally runs
+    # up to 20 truncated-CG Hessian-vector passes over the data, so this is
+    # a conservative lower bound on data throughput
+    d["note"] = "outer passes only; CG Hv passes excluded (lower bound)"
+    print(json.dumps({
+        "metric": "linreg_tron_1Mx10K_rows_per_sec_per_chip",
+        "value": d["rows_per_sec"], "unit": "rows/s", "vs_baseline": None,
+        "detail": d,
+    }))
+
+    # elastic-net half: OWLQN with l1=0.5, l2=0.5
+    obj_en = make_objective("squared", l2_weight=0.5)
+    lcfg = LBFGSConfig(max_iterations=20, tolerance=0.0)
+
+    def owlqn_run(w0, b):
+        return owlqn_solve(glm_adapter(obj_en, b), w0, jnp.float32(0.5), lcfg)
+
+    d = _run(jax.jit(owlqn_run), batch, w0, n_rows)
+    print(json.dumps({
+        "metric": "linreg_owlqn_elasticnet_1Mx10K_rows_per_sec_per_chip",
+        "value": d["rows_per_sec"], "unit": "rows/s", "vs_baseline": None,
+        "detail": d,
+    }))
+
+    # --- config #3: Poisson + offsets + box constraints ------------------
+    values, rows, cols, y, offsets = _sparse_problem(
+        rng, n_rows, n_features, nnz_per_row, "poisson"
+    )
+    batch = TiledBatch.from_coo(
+        values=values, rows=rows, cols=cols, labels=y,
+        offsets=offsets, num_features=n_features,
+    )
+    obj_p = make_objective("poisson", l2_weight=1.0)
+    lower = np.full(n_features, -0.5)
+    upper = np.full(n_features, 0.5)
+    constraints = BoxConstraints(
+        lower=jnp.asarray(lower, jnp.float32),
+        upper=jnp.asarray(upper, jnp.float32),
+    )
+
+    from photon_ml_tpu.optim import lbfgs_solve
+
+    def poisson_run(w0, b):
+        return lbfgs_solve(
+            glm_adapter(obj_p, b), w0,
+            LBFGSConfig(max_iterations=20, tolerance=0.0),
+            constraints=constraints,
+        )
+
+    d = _run(jax.jit(poisson_run), batch, w0, n_rows)
+    print(json.dumps({
+        "metric": "poisson_offsets_box_1Mx10K_rows_per_sec_per_chip",
+        "value": d["rows_per_sec"], "unit": "rows/s", "vs_baseline": None,
+        "detail": d,
+    }))
+
+
+if __name__ == "__main__":
+    main()

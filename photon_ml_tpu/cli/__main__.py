@@ -9,10 +9,11 @@ import sys
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m photon_ml_tpu.cli {train|score|glm} [options]")
+        print("usage: python -m photon_ml_tpu.cli {train|score|glm|index} [options]")
         print("  train --config <json> [--output-dir <dir>]   GAME training")
         print("  score --model-dir <dir> --config <json> [--output <avro>]")
         print("  glm   --config <json> [--output-dir <dir>]   staged legacy GLM")
+        print("  index --input <avro...> --output <dir>       feature index build")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "train":
@@ -27,7 +28,11 @@ def main(argv=None) -> int:
         from photon_ml_tpu.cli.glm import main as glm_main
 
         return glm_main(rest)
-    print(f"unknown command '{cmd}' (expected train|score|glm)", file=sys.stderr)
+    if cmd == "index":
+        from photon_ml_tpu.cli.index import main as index_main
+
+        return index_main(rest)
+    print(f"unknown command '{cmd}' (expected train|score|glm|index)", file=sys.stderr)
     return 2
 
 

@@ -105,6 +105,12 @@ class GLMDriver:
         task = self.config["task"]
         in_spec = self.config["input"]
         data, index_maps = read_input(in_spec)
+        if len(data.feature_shards) != 1:
+            raise ValueError(
+                "the legacy GLM driver trains one feature shard; got "
+                f"{sorted(data.feature_shards)} (use the GAME train driver "
+                "for multi-shard configs)"
+            )
         shard = next(iter(data.feature_shards))
         self._batch = data.batch_for(shard)
         # accept the short aliases full/sample/disabled as well as the
@@ -170,12 +176,13 @@ class GLMDriver:
             normalization=self._normalization,
             compute_variances=bool(self.config.get("compute_variances", False)),
         )
-        for e in self.sweep:
+        for pos, e in enumerate(self.sweep):
             self.events.send(
                 OptimizationLogEvent(
-                    iteration=int(e.result.iterations),
+                    iteration=pos,  # position in the sweep
                     coordinate=f"lambda={e.reg_weight}",
                     seconds=0.0,
+                    metrics={"solver_iterations": int(e.result.iterations)},
                 )
             )
 
@@ -185,8 +192,9 @@ class GLMDriver:
         from photon_ml_tpu.diagnostics import evaluate
         from photon_ml_tpu.training import select_best_model
 
-        # score each model on the validation batch ONCE; evaluate() and the
-        # selection metric both consume the cached margins
+        # cache per-model validation margins so best-model selection reuses
+        # them instead of re-scoring (evaluate() computes its own means/
+        # margins internally for the full metric map)
         score_cache = {}
         for e in self.sweep:
             score_cache[id(e.model)] = e.model.compute_score(self._val_batch)
@@ -310,12 +318,16 @@ class GLMDriver:
 
         t0 = time.time()
         self.events.send(SetupEvent(config=self.config))
-        self.events.send(TrainingStartEvent(num_rows=0))
 
         self._assert_stage(DriverStage.INIT)
         with timed("preprocess"):
             self.preprocess()
         self._update_stage(DriverStage.PREPROCESSED)
+        self.events.send(
+            TrainingStartEvent(num_rows=int(np.sum(
+                np.asarray(self._batch.weights) > 0
+            )))
+        )
 
         self._assert_stage(DriverStage.PREPROCESSED)
         with timed("train"):

@@ -1,0 +1,72 @@
+"""Offline feature-index build job.
+
+Reference analog: photon-client FeatureIndexingJob.scala:56-170 — a
+standalone job scanning training Avro for name+term feature keys and
+writing a partitioned PalDB index store, optionally per feature shard, with
+intercept injection. Here the store is the mmap-friendly sorted-hash layout
+of data/index_map.py:
+
+    python -m photon_ml_tpu.cli index --input train/ --output idx/ \\
+        [--shards global:features,userFeatures user:userFeatures] \\
+        [--no-intercept]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from photon_ml_tpu.utils import logger, setup_logging, timed
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli index", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "--input", required=True, nargs="+", help=".avro files or directories"
+    )
+    parser.add_argument("--output", required=True, help="index store directory")
+    parser.add_argument(
+        "--shards",
+        nargs="*",
+        default=[],
+        help="shard specs 'name:bag1,bag2' (featureShardId sections map); "
+        "default one shard 'features' from the 'features' bag",
+    )
+    parser.add_argument(
+        "--no-intercept",
+        action="store_true",
+        help="do not inject the intercept key",
+    )
+    args = parser.parse_args(argv)
+    setup_logging()
+
+    from photon_ml_tpu.data.avro import build_index_map_from_avro
+
+    shards: dict[str, tuple[str, ...]] = {}
+    for spec in args.shards:
+        name, _, bags = spec.partition(":")
+        if not bags:
+            raise SystemExit(f"bad shard spec '{spec}' (want name:bag1,bag2)")
+        shards[name] = tuple(bags.split(","))
+    if not shards:
+        shards = {"features": ("features",)}
+
+    summary = {}
+    for shard, bags in shards.items():
+        with timed(f"index shard '{shard}'"):
+            imap = build_index_map_from_avro(
+                args.input, bags, add_intercept=not args.no_intercept
+            )
+            out_dir = os.path.join(args.output, shard)
+            imap.save(out_dir)
+        logger.info("shard '%s': %d features -> %s", shard, len(imap), out_dir)
+        summary[shard] = {"num_features": len(imap), "path": out_dir}
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -142,6 +142,11 @@ def run_coordinate_descent(
                 "coordinate": name,
                 "seconds": time.time() - t0,
             }
+            tracker = getattr(coord, "last_tracker", None)
+            if tracker is not None:
+                # per-update optimization telemetry (the reference's
+                # OptimizationTracker surfaced in CD logs)
+                entry["tracker"] = tracker.to_summary_string()
             if validation is not None:
                 game_model = GameModel(task=task, models=dict(models))
                 metrics = _evaluate(game_model, validation)

@@ -284,6 +284,9 @@ class FixedEffectCoordinate:
                 batch = batch.with_offsets(batch.offsets + residual_scores)
             res = self._solver(self._obj, batch, w0, self._l1, self._constraints)
         w = res.w
+        from photon_ml_tpu.optim.trackers import FixedEffectOptimizationTracker
+
+        self.last_tracker = FixedEffectOptimizationTracker.from_result(res)
         if norm is not None:
             w = norm.transform_model_coefficients(w)
         return dataclasses.replace(model, coefficients=w)
@@ -427,7 +430,11 @@ class RandomEffectCoordinate:
     def update_model(
         self, model: RandomEffectModel, residual_scores: Optional[Array]
     ) -> RandomEffectModel:
+        from photon_ml_tpu.optim.trackers import RandomEffectOptimizationTracker
+
         new_buckets = []
+        tracker_its = []
+        tracker_reasons = []
         n_dev = 0 if self.mesh is None else int(self.mesh.devices.size)
         for b, bm in zip(self.re_data.buckets, model.buckets):
             bucket = (
@@ -444,7 +451,16 @@ class RandomEffectCoordinate:
                 bb_p, w0_p = _pad_entities(bb, w0, total)
                 res = self._sharded_solver(self._obj, bb_p, w0_p, self._l1)
                 w = res.w[:num_e]
+            # pull only the tiny telemetry vectors to host so the full
+            # SolveResult (grad + tracking buffers) frees per bucket
+            n_real = int(w0.shape[0])
+            tracker_its.append(np.asarray(res.iterations)[:n_real])
+            tracker_reasons.append(np.asarray(res.reason)[:n_real])
             new_buckets.append(dataclasses.replace(bm, coefficients=w))
+        self.last_tracker = RandomEffectOptimizationTracker(
+            iterations=np.concatenate(tracker_its),
+            reasons=np.concatenate(tracker_reasons),
+        )
         return dataclasses.replace(model, buckets=tuple(new_buckets))
 
     def score(self, model: RandomEffectModel) -> Array:

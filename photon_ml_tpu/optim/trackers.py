@@ -1,0 +1,101 @@
+"""Optimization trackers: aggregate solve telemetry per coordinate update.
+
+Reference analog: photon-api optimization/*Tracker.scala —
+FixedEffectOptimizationTracker wraps one OptimizationStatesTracker;
+RandomEffectOptimizationTracker aggregates per-entity trackers into
+convergence-reason counts (countConvergenceReasons) and iteration
+StatCounter stats (getNumIterationStats). Here the vmapped bucket solves
+already return per-entity iteration/reason ARRAYS, so aggregation is a few
+bincounts — no RDD reduce.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_ml_tpu.optim.common import CONVERGENCE_REASON_NAMES
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectOptimizationTracker:
+    """One solve's terminal telemetry (FixedEffectOptimizationTracker)."""
+
+    iterations: int
+    reason: str
+    final_value: float
+    final_grad_norm: float
+
+    @staticmethod
+    def from_result(res) -> "FixedEffectOptimizationTracker":
+        it = int(res.iterations)
+        return FixedEffectOptimizationTracker(
+            iterations=it,
+            reason=CONVERGENCE_REASON_NAMES.get(int(res.reason), "Unknown"),
+            final_value=float(res.value),
+            final_grad_norm=float(res.grad_norms[it]),
+        )
+
+    def to_summary_string(self) -> str:
+        return (
+            f"iterations={self.iterations} reason={self.reason} "
+            f"value={self.final_value:.6g} |grad|={self.final_grad_norm:.3g}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectOptimizationTracker:
+    """Per-entity solve telemetry for one coordinate update, aggregated
+    across geometry buckets (RandomEffectOptimizationTracker analog)."""
+
+    iterations: np.ndarray  # i32[n_entities]
+    reasons: np.ndarray  # i32[n_entities]
+
+    @staticmethod
+    def from_results(results, entity_counts) -> "RandomEffectOptimizationTracker":
+        """Concatenate per-bucket vmapped SolveResults, dropping padded
+        entities (``entity_counts[i]`` = real entities of bucket i)."""
+        its, rs = [], []
+        for res, n in zip(results, entity_counts):
+            its.append(np.asarray(res.iterations)[:n])
+            rs.append(np.asarray(res.reason)[:n])
+        return RandomEffectOptimizationTracker(
+            iterations=(
+                np.concatenate(its) if its else np.zeros(0, np.int32)
+            ),
+            reasons=np.concatenate(rs) if rs else np.zeros(0, np.int32),
+        )
+
+    def count_convergence_reasons(self) -> dict[str, int]:
+        """countConvergenceReasons analog: reason name -> entity count."""
+        out: dict[str, int] = {}
+        codes, counts = np.unique(self.reasons, return_counts=True)
+        for code, count in zip(codes, counts):
+            name = CONVERGENCE_REASON_NAMES.get(int(code), "Unknown")
+            out[name] = out.get(name, 0) + int(count)
+        return out
+
+    def iteration_stats(self) -> dict[str, float]:
+        """getNumIterationStats analog (count/mean/std/min/max)."""
+        it = self.iterations
+        if len(it) == 0:
+            return {"count": 0, "mean": 0.0, "stdev": 0.0, "min": 0.0, "max": 0.0}
+        return {
+            "count": int(len(it)),
+            "mean": float(it.mean()),
+            "stdev": float(it.std()),
+            "min": float(it.min()),
+            "max": float(it.max()),
+        }
+
+    def to_summary_string(self) -> str:
+        s = self.iteration_stats()
+        reasons = ", ".join(
+            f"{k}: {v}" for k, v in sorted(self.count_convergence_reasons().items())
+        )
+        return (
+            f"entities={s['count']} iterations(mean={s['mean']:.2f}, "
+            f"std={s['stdev']:.2f}, min={s['min']:.0f}, max={s['max']:.0f}) "
+            f"convergence {{{reasons}}}"
+        )

@@ -211,3 +211,23 @@ def test_parse_coordinate_config_rejects_unknown_keys():
         parse_coordinate_config(
             {"type": "fixed_effect", "shard_name": "g", "normalisation": "none"}
         )
+
+
+def test_cli_index_job(avro_dataset, tmp_path):
+    """FeatureIndexingJob analog: scan avro -> persisted mmap index store."""
+    from photon_ml_tpu.cli.index import main as index_main
+    from photon_ml_tpu.data.index_map import INTERCEPT_KEY, IndexMap, MmapIndexMap
+
+    tmp, train_path, _ = avro_dataset
+    out = str(tmp_path / "idx")
+    rc = index_main(
+        ["--input", train_path, "--output", out,
+         "--shards", "global:features"]
+    )
+    assert rc == 0
+    imap = IndexMap.load(os.path.join(out, "global"))
+    assert imap.get(INTERCEPT_KEY) >= 0
+    assert len(imap) == 9  # c0..c7 + intercept
+    # mmap store loads and answers lookups
+    mm = MmapIndexMap(os.path.join(out, "global"))
+    assert mm.get("c3") == imap.get("c3")

@@ -286,3 +286,39 @@ def test_fit_grid_emits_events_and_reuses_coordinates(rng):
     m0 = np.asarray(entries[0].result.model.models["fixed"].coefficients)
     m1 = np.asarray(entries[1].result.model.models["fixed"].coefficients)
     assert m0.shape == m1.shape
+
+
+def test_optimization_trackers(rng):
+    """Per-update solve telemetry (Fixed/RandomEffectOptimizationTracker
+    analog): convergence-reason counts, iteration stats, CD history."""
+    data, *_ = _data(rng, n=200)
+    cfg = GameConfig(
+        task="logistic",
+        coordinates={
+            "fixed": FixedEffectConfig(shard_name="f", optimizer=_l2(0.1)),
+            "perUser": RandomEffectConfig(
+                shard_name="f", id_name="u", optimizer=_l2(1.0)
+            ),
+        },
+    )
+    result = GameEstimator(cfg).fit(data)
+    entries = {e["coordinate"]: e for e in result.history}
+    assert "iterations=" in entries["fixed"]["tracker"]
+    assert "reason=" in entries["fixed"]["tracker"]
+    re_summary = entries["perUser"]["tracker"]
+    assert "entities=5" in re_summary
+    assert "convergence {" in re_summary
+
+    from photon_ml_tpu.optim.trackers import RandomEffectOptimizationTracker
+    import numpy as np_
+
+    t = RandomEffectOptimizationTracker(
+        iterations=np_.asarray([3, 5, 5, 7]),
+        reasons=np_.asarray([3, 3, 4, 1]),
+    )
+    assert t.count_convergence_reasons() == {
+        "FunctionValuesConverged": 2, "GradientConverged": 1,
+        "MaxIterations": 1,
+    }
+    s = t.iteration_stats()
+    assert s["count"] == 4 and s["mean"] == 5.0 and s["max"] == 7.0
