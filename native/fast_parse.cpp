@@ -1,0 +1,121 @@
+// Native host-side ingestion kernels for photon-ml-tpu.
+//
+// The reference delegates ingestion to Spark executors (AvroDataReader /
+// LibSVMInputDataFormat); the TPU build's ingestion is host-side, so the
+// hot text-parsing loop is native C++ exposed through a C ABI and loaded
+// via ctypes (no pybind11 in this environment). Semantics mirror
+// photon_ml_tpu/data/libsvm.py::read_libsvm exactly: '#' starts a comment
+// (full-line or trailing), blank lines skipped, feature ids 1-based by
+// default, negative resulting indices are an error.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// Pass 1: count data rows and nnz so the caller can allocate exactly.
+// Returns 0 on success.
+int libsvm_count(const char* buf, int64_t len, int64_t* out_rows,
+                 int64_t* out_nnz) {
+  int64_t rows = 0, nnz = 0;
+  int64_t i = 0;
+  while (i < len) {
+    // line start: skip leading whitespace
+    while (i < len && (buf[i] == ' ' || buf[i] == '\t')) i++;
+    if (i >= len) break;
+    if (buf[i] == '\n' || buf[i] == '\r') {  // blank line
+      i++;
+      continue;
+    }
+    if (buf[i] == '#') {  // comment line
+      while (i < len && buf[i] != '\n') i++;
+      continue;
+    }
+    rows++;
+    // skip the label token
+    while (i < len && !isspace((unsigned char)buf[i])) i++;
+    // tokens until newline/comment
+    while (i < len && buf[i] != '\n') {
+      while (i < len && (buf[i] == ' ' || buf[i] == '\t' || buf[i] == '\r'))
+        i++;
+      if (i >= len || buf[i] == '\n') break;
+      if (buf[i] == '#') {  // trailing comment
+        while (i < len && buf[i] != '\n') i++;
+        break;
+      }
+      nnz++;
+      while (i < len && !isspace((unsigned char)buf[i])) i++;
+    }
+    if (i < len) i++;  // consume newline
+  }
+  *out_rows = rows;
+  *out_nnz = nnz;
+  return 0;
+}
+
+// Pass 2: fill caller-allocated arrays. ``one_based`` nonzero subtracts 1
+// from feature ids. Returns max 0-based column id on success, -1 on a
+// negative index (wrong zero_based setting), -2 on a malformed token.
+// out_rows/out_slots report how many labels/nnz were actually written so
+// the caller can cross-check against libsvm_count (mismatch = malformed
+// input that the two passes tokenized differently).
+int64_t libsvm_parse(const char* buf, int64_t len, int one_based,
+                     double* values, int64_t* rows, int64_t* cols,
+                     double* labels, int64_t* out_rows, int64_t* out_slots) {
+  int64_t row = -1, slot = 0, max_col = -1;
+  int64_t i = 0;
+  *out_rows = 0;
+  *out_slots = 0;
+  while (i < len) {
+    while (i < len && (buf[i] == ' ' || buf[i] == '\t')) i++;
+    if (i >= len) break;
+    if (buf[i] == '\n' || buf[i] == '\r') {
+      i++;
+      continue;
+    }
+    if (buf[i] == '#') {
+      while (i < len && buf[i] != '\n') i++;
+      continue;
+    }
+    row++;
+    char* end = nullptr;
+    labels[row] = strtod(buf + i, &end);
+    if (end == buf + i) return -2;
+    i = end - buf;
+    while (i < len && buf[i] != '\n') {
+      while (i < len && (buf[i] == ' ' || buf[i] == '\t' || buf[i] == '\r'))
+        i++;
+      if (i >= len || buf[i] == '\n') break;
+      if (buf[i] == '#') {
+        while (i < len && buf[i] != '\n') i++;
+        break;
+      }
+      int64_t c = strtoll(buf + i, &end, 10);
+      if (end == buf + i || *end != ':') return -2;
+      i = (end - buf) + 1;  // skip ':'
+      // the value must start IMMEDIATELY after ':' — strtod would skip
+      // whitespace/newlines and swallow the next line's label
+      if (i >= len || isspace((unsigned char)buf[i])) return -2;
+      double v = strtod(buf + i, &end);
+      if (end == buf + i) return -2;
+      i = end - buf;
+      if (one_based) c -= 1;
+      if (c < 0) return -1;
+      values[slot] = v;
+      rows[slot] = row;
+      cols[slot] = c;
+      if (c > max_col) max_col = c;
+      slot++;
+    }
+    if (i < len) i++;
+  }
+  *out_rows = row + 1;
+  *out_slots = slot;
+  return max_col;
+}
+
+}  // extern "C"

@@ -141,6 +141,20 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
                     imap.save(
                         os.path.join(output_dir, sub, "feature-indexes", shard)
                     )
+        # per-shard feature statistics (calculateAndSaveFeatureShardStats /
+        # writeBasicStatistics analog)
+        from photon_ml_tpu.data.avro import write_feature_summary
+        from photon_ml_tpu.data.stats import summarize
+
+        with timed("save feature summaries"):
+            stats_dir = os.path.join(output_dir, "feature-stats")
+            os.makedirs(stats_dir, exist_ok=True)
+            for shard, imap in index_maps.items():
+                write_feature_summary(
+                    os.path.join(stats_dir, f"{shard}.avro"),
+                    summarize(train_data.batch_for(shard)),
+                    imap,
+                )
 
     summary = {
         "output_dir": output_dir,

@@ -663,6 +663,57 @@ def read_bayesian_linear_model(
     return means, variances, meta
 
 
+FEATURE_SUMMARIZATION_RESULT_AVRO = {
+    "name": "FeatureSummarizationResultAvro",
+    "type": "record",
+    "fields": [
+        {"name": "featureName", "type": "string"},
+        {"name": "featureTerm", "type": "string"},
+        {"name": "metrics", "type": {"type": "map", "values": "double"}},
+    ],
+}
+
+
+def write_feature_summary(
+    path: str,
+    summary,
+    index_map: IndexMap,
+    codec: str = "deflate",
+) -> int:
+    """Persist per-feature statistics as FeatureSummarizationResultAvro
+    records (ModelProcessingUtils.writeBasicStatistics:559-608 analog:
+    max/min/mean/normL1/normL2/numNonzeros/variance per name+term)."""
+    metrics_arrays = {
+        "max": np.asarray(summary.max),
+        "min": np.asarray(summary.min),
+        "mean": np.asarray(summary.mean),
+        "normL1": np.asarray(summary.norm_l1),
+        "normL2": np.asarray(summary.norm_l2),
+        "numNonzeros": np.asarray(summary.num_nonzeros),
+        "variance": np.asarray(summary.variance),
+    }
+
+    def records():
+        for i in range(len(index_map)):
+            key = index_map.name_of(i)
+            name, _, term = key.partition("\x01")
+            yield {
+                "featureName": name,
+                "featureTerm": term,
+                "metrics": {k: float(v[i]) for k, v in metrics_arrays.items()},
+            }
+
+    return write_avro(path, FEATURE_SUMMARIZATION_RESULT_AVRO, records(), codec=codec)
+
+
+def read_feature_summary(path: str) -> dict[str, dict[str, float]]:
+    """Load a feature-summary file as {feature key: {metric: value}}."""
+    out = {}
+    for rec in read_avro(path):
+        out[feature_key(rec["featureName"], rec["featureTerm"])] = rec["metrics"]
+    return out
+
+
 def write_scoring_results(
     path: str,
     scores: np.ndarray,

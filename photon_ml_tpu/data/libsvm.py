@@ -70,10 +70,34 @@ def read_libsvm(
     path: str,
     zero_based: bool = False,
     binary_labels_to_01: bool = True,
+    engine: str = "auto",
 ) -> LibSVMData:
     """Parse a LibSVM file. Labels {-1,+1} are mapped to {0,1} when
     ``binary_labels_to_01`` (the loss layer accepts both, but evaluators
-    expect {0,1})."""
+    expect {0,1}).
+
+    ``engine``: "auto" uses the native C++ parser (data/native.py, built on
+    demand) and falls back to pure python; "python"/"native" force one.
+    """
+    if engine not in ("auto", "python", "native"):
+        raise ValueError(f"unknown engine '{engine}'")
+    parsed = None
+    if engine in ("auto", "native"):
+        from photon_ml_tpu.data.native import load_native, parse_libsvm_native
+
+        # check availability (cheap, cached) BEFORE reading the whole file
+        if load_native() is not None:
+            with open(path, "rb") as f:
+                raw = f.read()
+            parsed = parse_libsvm_native(raw, zero_based=zero_based)
+        elif engine == "native":
+            raise RuntimeError("native parser unavailable (no g++ / build failed)")
+    if parsed is not None:
+        vals_arr, rows_arr, cols_arr, y_raw, num_features = parsed
+        return _finish(
+            vals_arr, rows_arr, cols_arr, y_raw, num_features,
+            binary_labels_to_01,
+        )
     labels: list[float] = []
     rows: list[int] = []
     cols: list[int] = []
@@ -101,14 +125,21 @@ def read_libsvm(
                 vals.append(float(v))
                 max_col = max(max_col, c)
 
-    y = np.asarray(labels)
+    return _finish(
+        np.asarray(vals),
+        np.asarray(rows, dtype=np.int64),
+        np.asarray(cols, dtype=np.int64),
+        np.asarray(labels),
+        max_col + 1,
+        binary_labels_to_01,
+    )
+
+
+def _finish(values, rows, cols, y, num_features, binary_labels_to_01):
+    """Shared tail for both engines: label binarization + container."""
     if binary_labels_to_01 and set(np.unique(y)).issubset({-1.0, 1.0}):
         y = (y > 0).astype(np.float64)
-
     return LibSVMData(
-        values=np.asarray(vals),
-        rows=np.asarray(rows, dtype=np.int64),
-        cols=np.asarray(cols, dtype=np.int64),
-        labels=y,
-        num_features=max_col + 1,
+        values=values, rows=rows, cols=cols, labels=y,
+        num_features=num_features,
     )

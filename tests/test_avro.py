@@ -276,3 +276,24 @@ def test_weight_zero_and_null_id_fallback(tmp_path):
     # record 0: top-level null -> metadataMap "7"; record 1: top-level "9"
     idc = data.id_columns["userId"]
     assert list(idc.vocab[idc.codes]) == ["7", "9"]
+
+
+def test_feature_summary_round_trip(rng, tmp_path):
+    from photon_ml_tpu.data.avro import read_feature_summary, write_feature_summary
+    from photon_ml_tpu.data.stats import summarize
+
+    n, d = 60, 5
+    X = rng.normal(size=(n, d))
+    batch = SparseBatch.from_dense(X, np.zeros(n))
+    imap = IndexMap([feature_key("f", str(j)) for j in range(d)])
+    p = str(tmp_path / "stats.avro")
+    n_written = write_feature_summary(p, summarize(batch), imap)
+    assert n_written == d
+    stats = read_feature_summary(p)
+    assert set(stats) == {feature_key("f", str(j)) for j in range(d)}
+    k0 = feature_key("f", "0")
+    assert stats[k0]["max"] == pytest.approx(X[:, 0].max(), rel=1e-5)
+    assert stats[k0]["mean"] == pytest.approx(X[:, 0].mean(), rel=1e-4, abs=1e-5)
+    assert stats[k0]["variance"] == pytest.approx(
+        X[:, 0].var(ddof=1), rel=1e-4
+    )

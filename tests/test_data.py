@@ -243,3 +243,61 @@ def test_testing_generators_smoke(rng):
     data2, truth2 = generate_low_rank_game_dataset(n_users=8, rows_per_user=5)
     assert truth2["W"].shape == (8, 30)
     assert np.linalg.matrix_rank(truth2["W"]) == 2
+
+
+def test_native_libsvm_parser_matches_python(rng, tmp_path):
+    """The C++ parser (built on demand) must agree exactly with the python
+    parser, including comments, blank lines, and {-1,1} label mapping."""
+    import pytest as _pytest
+
+    from photon_ml_tpu.data.libsvm import read_libsvm
+    from photon_ml_tpu.data.native import load_native
+
+    if load_native() is None:
+        _pytest.skip("no native toolchain")
+
+    lines = ["# header comment", ""]
+    n, d = 200, 30
+    X = (rng.random((n, d)) < 0.3) * rng.normal(size=(n, d))
+    y = np.where(rng.random(n) < 0.5, -1, 1)
+    for i in range(n):
+        feats = " ".join(f"{j + 1}:{X[i, j]:.6f}" for j in np.nonzero(X[i])[0])
+        suffix = " # trailing comment" if i % 7 == 0 else ""
+        lines.append(f"{y[i]} {feats}{suffix}")
+    p = tmp_path / "t.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+
+    a = read_libsvm(str(p), engine="python")
+    b = read_libsvm(str(p), engine="native")
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.rows, b.rows)
+    np.testing.assert_array_equal(a.cols, b.cols)
+    np.testing.assert_allclose(a.values, b.values, rtol=0, atol=0)
+    assert a.num_features == b.num_features
+
+
+def test_native_parser_rejects_malformed_input(tmp_path):
+    """Malformed tokens must raise (never uninitialized-array garbage):
+    the count/parse cross-check plus strict value-token validation."""
+    import pytest as _pytest
+
+    from photon_ml_tpu.data.libsvm import read_libsvm
+    from photon_ml_tpu.data.native import load_native
+
+    if load_native() is None:
+        _pytest.skip("no native toolchain")
+
+    bad_inputs = [
+        "1 3: 5\n",  # space after colon: value token missing
+        "1 3:\n-1 2:5\n",  # dangling colon would swallow the next label
+        "1 3:abc\n",  # non-numeric value
+        "x 3:1\n",  # non-numeric label
+    ]
+    for content in bad_inputs:
+        p = tmp_path / "bad.libsvm"
+        p.write_text(content)
+        with _pytest.raises(ValueError):
+            read_libsvm(str(p), engine="native")
+        # the python engine rejects the same inputs
+        with _pytest.raises(ValueError):
+            read_libsvm(str(p), engine="python")
