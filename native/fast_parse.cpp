@@ -4,9 +4,12 @@
 // LibSVMInputDataFormat); the TPU build's ingestion is host-side, so the
 // hot text-parsing loop is native C++ exposed through a C ABI and loaded
 // via ctypes (no pybind11 in this environment). Semantics mirror
-// photon_ml_tpu/data/libsvm.py::read_libsvm exactly: '#' starts a comment
-// (full-line or trailing), blank lines skipped, feature ids 1-based by
-// default, negative resulting indices are an error.
+// photon_ml_tpu/data/libsvm.py::read_libsvm over text-mode files:
+// '#' starts a comment (full-line or as a standalone trailing token),
+// blank lines skipped, '\r' and '\n' are line terminators (python's
+// universal newlines), any other whitespace separates tokens, feature ids
+// 1-based by default, and malformed tokens (value not directly after ':',
+// trailing junk inside a token) are errors — never silently accepted.
 //
 // Build: make -C native   (g++ -O3 -shared -fPIC)
 
@@ -14,6 +17,16 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+
+namespace {
+inline bool is_eol(char c) { return c == '\n' || c == '\r'; }
+inline bool is_blank(char c) {
+  return std::isspace(static_cast<unsigned char>(c)) && !is_eol(c);
+}
+inline bool is_space_any(char c) {
+  return std::isspace(static_cast<unsigned char>(c));
+}
+}  // namespace
 
 extern "C" {
 
@@ -24,33 +37,31 @@ int libsvm_count(const char* buf, int64_t len, int64_t* out_rows,
   int64_t rows = 0, nnz = 0;
   int64_t i = 0;
   while (i < len) {
-    // line start: skip leading whitespace
-    while (i < len && (buf[i] == ' ' || buf[i] == '\t')) i++;
+    while (i < len && is_blank(buf[i])) i++;
     if (i >= len) break;
-    if (buf[i] == '\n' || buf[i] == '\r') {  // blank line
+    if (is_eol(buf[i])) {  // blank line
       i++;
       continue;
     }
     if (buf[i] == '#') {  // comment line
-      while (i < len && buf[i] != '\n') i++;
+      while (i < len && !is_eol(buf[i])) i++;
       continue;
     }
     rows++;
     // skip the label token
-    while (i < len && !isspace((unsigned char)buf[i])) i++;
-    // tokens until newline/comment
-    while (i < len && buf[i] != '\n') {
-      while (i < len && (buf[i] == ' ' || buf[i] == '\t' || buf[i] == '\r'))
-        i++;
-      if (i >= len || buf[i] == '\n') break;
-      if (buf[i] == '#') {  // trailing comment
-        while (i < len && buf[i] != '\n') i++;
+    while (i < len && !is_space_any(buf[i])) i++;
+    // feature tokens until end of line / trailing comment
+    while (i < len && !is_eol(buf[i])) {
+      while (i < len && is_blank(buf[i])) i++;
+      if (i >= len || is_eol(buf[i])) break;
+      if (buf[i] == '#') {  // trailing comment token
+        while (i < len && !is_eol(buf[i])) i++;
         break;
       }
       nnz++;
-      while (i < len && !isspace((unsigned char)buf[i])) i++;
+      while (i < len && !is_space_any(buf[i])) i++;
     }
-    if (i < len) i++;  // consume newline
+    if (i < len && is_eol(buf[i])) i++;
   }
   *out_rows = rows;
   *out_nnz = nnz;
@@ -58,11 +69,12 @@ int libsvm_count(const char* buf, int64_t len, int64_t* out_rows,
 }
 
 // Pass 2: fill caller-allocated arrays. ``one_based`` nonzero subtracts 1
-// from feature ids. Returns max 0-based column id on success, -1 on a
-// negative index (wrong zero_based setting), -2 on a malformed token.
-// out_rows/out_slots report how many labels/nnz were actually written so
-// the caller can cross-check against libsvm_count (mismatch = malformed
-// input that the two passes tokenized differently).
+// from feature ids. Returns the max 0-based column id on success (-1 when
+// the file has labels but no features — a valid input), -2 on a malformed
+// token, -3 on a negative resulting index (wrong zero_based setting).
+// out_rows/out_slots report how many labels/nnz were written so the caller
+// can cross-check against libsvm_count (mismatch = the two passes
+// tokenized differently = malformed input).
 int64_t libsvm_parse(const char* buf, int64_t len, int one_based,
                      double* values, int64_t* rows, int64_t* cols,
                      double* labels, int64_t* out_rows, int64_t* out_slots) {
@@ -71,14 +83,14 @@ int64_t libsvm_parse(const char* buf, int64_t len, int one_based,
   *out_rows = 0;
   *out_slots = 0;
   while (i < len) {
-    while (i < len && (buf[i] == ' ' || buf[i] == '\t')) i++;
+    while (i < len && is_blank(buf[i])) i++;
     if (i >= len) break;
-    if (buf[i] == '\n' || buf[i] == '\r') {
+    if (is_eol(buf[i])) {
       i++;
       continue;
     }
     if (buf[i] == '#') {
-      while (i < len && buf[i] != '\n') i++;
+      while (i < len && !is_eol(buf[i])) i++;
       continue;
     }
     row++;
@@ -86,12 +98,13 @@ int64_t libsvm_parse(const char* buf, int64_t len, int one_based,
     labels[row] = strtod(buf + i, &end);
     if (end == buf + i) return -2;
     i = end - buf;
-    while (i < len && buf[i] != '\n') {
-      while (i < len && (buf[i] == ' ' || buf[i] == '\t' || buf[i] == '\r'))
-        i++;
-      if (i >= len || buf[i] == '\n') break;
+    // the label token must end cleanly (python float("1x") raises)
+    if (i < len && !is_space_any(buf[i])) return -2;
+    while (i < len && !is_eol(buf[i])) {
+      while (i < len && is_blank(buf[i])) i++;
+      if (i >= len || is_eol(buf[i])) break;
       if (buf[i] == '#') {
-        while (i < len && buf[i] != '\n') i++;
+        while (i < len && !is_eol(buf[i])) i++;
         break;
       }
       int64_t c = strtoll(buf + i, &end, 10);
@@ -99,19 +112,21 @@ int64_t libsvm_parse(const char* buf, int64_t len, int one_based,
       i = (end - buf) + 1;  // skip ':'
       // the value must start IMMEDIATELY after ':' — strtod would skip
       // whitespace/newlines and swallow the next line's label
-      if (i >= len || isspace((unsigned char)buf[i])) return -2;
+      if (i >= len || is_space_any(buf[i])) return -2;
       double v = strtod(buf + i, &end);
       if (end == buf + i) return -2;
       i = end - buf;
+      // the value token must end cleanly ("3#x" is an error in python too)
+      if (i < len && !is_space_any(buf[i])) return -2;
       if (one_based) c -= 1;
-      if (c < 0) return -1;
+      if (c < 0) return -3;
       values[slot] = v;
       rows[slot] = row;
       cols[slot] = c;
       if (c > max_col) max_col = c;
       slot++;
     }
-    if (i < len) i++;
+    if (i < len && is_eol(buf[i])) i++;
   }
   *out_rows = row + 1;
   *out_slots = slot;

@@ -112,12 +112,13 @@ def parse_libsvm_native(
         data, len(data), 0 if zero_based else 1, values, rows, cols, labels,
         ctypes.byref(parsed_rows), ctypes.byref(parsed_slots),
     )
-    if max_col == -1:
+    if max_col == -3:
         raise ValueError(
             "negative feature index (wrong zero_based setting?)"
         )
     if max_col == -2:
         raise ValueError("malformed libsvm token")
+    # max_col == -1 is a VALID labels-only file: num_features = 0
     # the two passes must tokenize identically, or the arrays contain
     # uninitialized tails — refuse rather than return garbage
     if parsed_rows.value != n_rows.value or parsed_slots.value != n_nnz.value:

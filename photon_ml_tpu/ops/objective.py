@@ -111,6 +111,28 @@ class GLMObjective:
         grad = grad + l2 * w
         return value, grad
 
+    def value_and_grad_at_margins(
+        self,
+        w: Array,
+        z: Array,
+        batch: SparseBatch,
+        axis_name: Optional[str] = None,
+    ) -> tuple[Array, Array]:
+        """value_and_grad with the margins z ALREADY known: skips the gather
+        half of the fused sweep (one scatter pass). Math identical to
+        value_and_grad — the margin-carrying LBFGS fast path."""
+        l, dz = self.loss.loss_and_dz(z, batch.labels)
+        wdz = batch.weights * dz
+        data_value = jnp.sum(batch.weights * l)
+        raw_grad = batch.scatter_features(wdz)
+        row_total = jnp.sum(wdz)
+        value = self._psum(data_value, axis_name)
+        grad = self._psum(
+            self._back_transform_vec(raw_grad, row_total), axis_name
+        )
+        l2 = self.l2_weight.astype(w.dtype)
+        return value + 0.5 * l2 * jnp.dot(w, w), grad + l2 * w
+
     def value(
         self, w: Array, batch: SparseBatch, axis_name: Optional[str] = None
     ) -> Array:

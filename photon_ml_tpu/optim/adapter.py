@@ -92,10 +92,39 @@ def glm_adapter(
         def hvp(w, v):
             return obj.hessian_vector(w, v, batch, axis_name)
 
+    # margin-carrying protocol: z is threaded through the LBFGS loop so each
+    # iteration does one gather (u = X'@p) + one scatter (gradient) instead
+    # of two fused gather+scatter sweeps
+    def margins(w):
+        return obj.margins(w, batch)
+
+    def ls_prepare_z(z, w, p):
+        p_eff, p_shift = obj._effective(p)
+        u = batch.dot_rows(p_eff) + p_shift
+        return _LSCarry(
+            z=z,
+            u=u,
+            w=w,
+            p=p,
+            ww=jnp.dot(w, w),
+            wp=jnp.dot(w, p),
+            pp=jnp.dot(p, p),
+        )
+
+    def ls_advance(carry: _LSCarry, alpha):
+        return carry.z + alpha * carry.u
+
+    def value_and_grad_at(w, z):
+        return obj.value_and_grad_at_margins(w, z, batch, axis_name)
+
     return Objective(
         value_and_grad=value_and_grad,
         value=value,
         ls_prepare=ls_prepare,
         ls_eval=ls_eval,
         hvp=hvp,
+        margins=margins,
+        ls_prepare_z=ls_prepare_z,
+        ls_advance=ls_advance,
+        value_and_grad_at=value_and_grad_at,
     )

@@ -56,6 +56,18 @@ class Objective(NamedTuple):
     ls_prepare: Callable[[Array, Array], Any]
     ls_eval: Callable[[Any, Array], tuple[Array, Array]]
     hvp: Optional[Callable[[Array, Array], Array]] = None
+    # -- optional margin-carrying protocol (GLM fast path) ------------------
+    # When all four are present AND the solve is unconstrained, LBFGS keeps
+    # the per-row margins z = X'@w in its loop state: each iteration then
+    # costs ONE gather pass (u = X'@p via ls_prepare_z) + ONE scatter pass
+    # (gradient via value_and_grad_at) instead of two full gather+scatter
+    # sweeps — ~2x fewer one-hot matmuls on the tiled layout.
+    margins: Optional[Callable[[Array], Array]] = None  # w -> z
+    ls_prepare_z: Optional[Callable[[Array, Array, Array], Any]] = None  # (z,w,p)
+    ls_advance: Optional[Callable[[Any, Array], Array]] = None  # (carry,a)->z'
+    value_and_grad_at: Optional[
+        Callable[[Array, Array], tuple[Array, Array]]
+    ] = None  # (w, z) -> (f, g)
 
 
 def from_value_and_grad(

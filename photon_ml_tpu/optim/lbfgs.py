@@ -62,6 +62,7 @@ class _LBFGSState(NamedTuple):
     ls_failed: Array
     values: Array
     grad_norms: Array
+    z: Array  # carried margins X'@w (margin-carrying fast path; else [0])
 
 
 def two_loop_direction(
@@ -131,7 +132,23 @@ def lbfgs_solve(
     m, d = config.history, w0.shape[0]
     dtype = w0.dtype
     w0 = project_or_identity(constraints, w0)
-    f0, g0 = objective.value_and_grad(w0)
+    # margin-carrying fast path: thread z = X'@w through the loop so each
+    # iteration costs one gather (u = X'@p) + one scatter (gradient)
+    # instead of two fused sweeps. Requires linear margin updates, so box
+    # constraints (projection breaks z' = z + a*u) keep the standard path.
+    use_z = (
+        constraints is None
+        and objective.margins is not None
+        and objective.ls_prepare_z is not None
+        and objective.ls_advance is not None
+        and objective.value_and_grad_at is not None
+    )
+    if use_z:
+        z0 = objective.margins(w0)
+        f0, g0 = objective.value_and_grad_at(w0, z0)
+    else:
+        z0 = jnp.zeros((0,), dtype)
+        f0, g0 = objective.value_and_grad(w0)
 
     anchor_f = f0 if init_value is None else jnp.asarray(init_value, dtype)
     anchor_gn = (
@@ -158,6 +175,7 @@ def lbfgs_solve(
         ls_failed=jnp.bool_(False),
         values=values,
         grad_norms=gnorms,
+        z=z0,
     )
 
     def cond(s: _LBFGSState):
@@ -177,7 +195,10 @@ def lbfgs_solve(
             first, jnp.minimum(1.0, 1.0 / jnp.maximum(gnorm, 1e-12)), 1.0
         ).astype(dtype)
 
-        carry = objective.ls_prepare(s.w, p)
+        if use_z:
+            carry = objective.ls_prepare_z(s.z, s.w, p)
+        else:
+            carry = objective.ls_prepare(s.w, p)
         ls = strong_wolfe(
             objective.ls_eval,
             carry,
@@ -190,8 +211,14 @@ def lbfgs_solve(
         )
 
         w_step = s.w + ls.alpha * p
-        w_new = project_or_identity(constraints, w_step)
-        f_new, g_new = objective.value_and_grad(w_new)
+        if use_z:
+            w_new = w_step
+            z_new = objective.ls_advance(carry, ls.alpha)
+            f_new, g_new = objective.value_and_grad_at(w_new, z_new)
+        else:
+            w_new = project_or_identity(constraints, w_step)
+            z_new = s.z
+            f_new, g_new = objective.value_and_grad(w_new)
 
         S, Y, rho, head, n_hist, gamma = update_history(
             s.S, s.Y, s.rho, s.head, s.n_hist, s.gamma,
@@ -221,6 +248,7 @@ def lbfgs_solve(
             ls_failed=ls.failed,
             values=s.values.at[it].set(f_new),
             grad_norms=s.grad_norms.at[it].set(jnp.linalg.norm(g_new)),
+            z=z_new,
         )
         # Freeze lanes that already converged (vmap batching runs the body
         # for all lanes until every lane's cond is False).

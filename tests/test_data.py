@@ -301,3 +301,46 @@ def test_native_parser_rejects_malformed_input(tmp_path):
         # the python engine rejects the same inputs
         with _pytest.raises(ValueError):
             read_libsvm(str(p), engine="python")
+
+
+def test_native_parser_edge_semantics_match_python(tmp_path):
+    """Divergence regressions: odd whitespace (\\v), labels-only files,
+    attached '#', CR line endings — native and python must agree (both
+    parse or both raise)."""
+    import pytest as _pytest
+
+    from photon_ml_tpu.data.libsvm import read_libsvm
+    from photon_ml_tpu.data.native import load_native
+
+    if load_native() is None:
+        _pytest.skip("no native toolchain")
+
+    def compare(content: str):
+        p = tmp_path / "e.libsvm"
+        p.write_text(content)
+        try:
+            a = read_libsvm(str(p), engine="python")
+            py_err = None
+        except ValueError as e:
+            a, py_err = None, e
+        try:
+            b = read_libsvm(str(p), engine="native")
+            nat_err = None
+        except ValueError as e:
+            b, nat_err = None, e
+        assert (py_err is None) == (nat_err is None), (
+            f"engines disagree on {content!r}: python={py_err} native={nat_err}"
+        )
+        if a is not None:
+            np.testing.assert_array_equal(a.labels, b.labels)
+            np.testing.assert_array_equal(a.cols, b.cols)
+            np.testing.assert_allclose(a.values, b.values, atol=0)
+            assert a.num_features == b.num_features
+
+    compare("1 2:3\v4:5\n")       # \v separates tokens (no hang)
+    compare("1\n0\n")             # labels-only file: num_features 0
+    compare("")                   # empty file
+    compare("# only a comment\n")
+    compare("1 2:3#comment\n")    # attached '#': both must REJECT
+    compare("1 2:3\r-1 4:5\r")    # CR-only line endings: two rows
+    compare("+1 1:0.5 # ok\n")    # standalone trailing comment token
