@@ -167,6 +167,27 @@ class GLMObjective:
         )
         return hv + self.l2_weight.astype(w.dtype) * v
 
+    def curvature_at_margins(self, z: Array, batch: SparseBatch) -> Array:
+        """Per-row curvature d2 = weight * l''(z) — loop-invariant across a
+        TRON truncated-CG inner solve, so compute it ONCE per outer step."""
+        return batch.weights * self.loss.d2z(z, batch.labels)
+
+    def hessian_vector_with_curvature(
+        self,
+        d2: Array,
+        v: Array,
+        batch: SparseBatch,
+        axis_name: Optional[str] = None,
+    ) -> Array:
+        """H(w) @ v with the per-row curvature d2 = weight*l''(z) ALREADY
+        known: one gather (u = X'@v) + one scatter instead of the fused
+        kernel's two gathers + scatter, and no per-call elementwise d2z
+        pass. TRON's CG uses one fixed z/d2 for its whole inner loop."""
+        v_eff, v_shift = self._effective(v)
+        raw_hv, q_total = batch.fused_hv_at(d2, v_eff, v_shift)
+        hv = self._psum(self._back_transform_vec(raw_hv, q_total), axis_name)
+        return hv + self.l2_weight.astype(v.dtype) * v
+
     def hessian_diagonal(
         self, w: Array, batch: SparseBatch, axis_name: Optional[str] = None
     ) -> Array:

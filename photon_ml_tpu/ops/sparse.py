@@ -243,6 +243,16 @@ class SparseBatch:
         q = self.weights * get_loss(loss_name).d2z(z, self.labels) * xv
         return self.scatter_features(q), jnp.sum(q)
 
+    def fused_hv_at(
+        self, d2_row: Array, v_eff: Array, v_shift
+    ) -> tuple[Array, Array]:
+        """(raw Hv scatter, sum q) with the row curvature d2 = wgt*l''(z)
+        precomputed (q = d2 * (x.v + v_shift)). Plain composition here;
+        TiledBatch fuses gather + scatter into one pallas pass."""
+        u = self.dot_rows(v_eff) + v_shift
+        q = d2_row * u
+        return self.scatter_features(q), jnp.sum(q)
+
     def scatter_features(self, per_row: Array) -> Array:
         """Compute sum_i per_row[i] * x_i as a dense feature-space vector.
 

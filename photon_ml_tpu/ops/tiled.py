@@ -236,6 +236,35 @@ def _hv_kernel(loss_name: str, use_offsets: bool, *refs):
     _scatter_accum(out_g_ref, per_slot, mask_hi, mask_lo)
 
 
+def _hv_at_kernel(*refs):
+    """Hessian-vector sweep with the margin-derived row curvature d2 =
+    weight * l''(z) PRECOMPUTED: gather u = dot(v), form q = d2 * u,
+    scatter q and accumulate sum(q) — one pass, one gather + one scatter
+    matmul (vs _hv_kernel's two gathers + scatter; TRON CG holds z fixed
+    for its whole inner loop)."""
+    (vals_ref, hi_ref, lo_ref, rlo_ref, d2_ref, v_ref, shift_ref,
+     out_s_ref, out_g_ref) = refs
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_s_ref[:] = jnp.zeros_like(out_s_ref)
+        out_g_ref[:] = jnp.zeros_like(out_g_ref)
+
+    S = vals_ref.shape[2]
+    B = v_ref.shape[0]
+    mask_hi, mask_lo, mask_r = _masks(hi_ref, lo_ref, rlo_ref, S, B)
+    vals = vals_ref[0, 0, :]
+
+    u = _row_margins(vals, mask_r, v_ref, mask_hi, mask_lo) + shift_ref[0, 0]
+    q_row = d2_ref[0, :, :] * u  # [1, R]
+    out_s_ref[:] = out_s_ref[:] + jnp.stack(
+        [jnp.sum(q_row), jnp.float32(0.0)]).reshape(1, 2)
+
+    per_slot = jnp.sum(q_row * mask_r, axis=1) * vals
+    _scatter_accum(out_g_ref, per_slot, mask_hi, mask_lo)
+
+
 def _spec_s(S):
     return pl.BlockSpec((1, 1, S), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
 
@@ -293,6 +322,22 @@ def _hv_call(T, S, B, loss_name, use_offsets, interpret):
         kern,
         grid=(T,),
         in_specs=[_spec_s(S)] * 4 + [_spec_r()] * 3 + [_spec_w(B)] * 2
+        + [pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM)],
+        out_specs=[_spec_acc((1, 2)), _spec_acc((B, LANE))],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
+            jax.ShapeDtypeStruct((B, LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _hv_at_call(T, S, B, interpret):
+    return pl.pallas_call(
+        _hv_at_kernel,
+        grid=(T,),
+        in_specs=[_spec_s(S)] * 4 + [_spec_r()] + [_spec_w(B)]
         + [pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM)],
         out_specs=[_spec_acc((1, 2)), _spec_acc((B, LANE))],
         out_shape=[
@@ -570,6 +615,20 @@ class TiledBatch:
         ])
         sums, g = call(*self._slot_args(), self.labels3, self.weights3,
                        self.offsets3, self._w2(w), self._w2(v),
+                       sh.reshape(1, 2))
+        return g.reshape(-1)[: self.num_features], sums[0, 0]
+
+    def fused_hv_at(
+        self, d2_row: Array, v_eff: Array, v_shift
+    ) -> tuple[Array, Array]:
+        """(raw Hv scatter, sum q) with the row curvature d2 = wgt*l''(z)
+        precomputed: ONE pass doing gather u + scatter q (TRON CG holds z
+        fixed across its inner loop)."""
+        T, _, S = self.vals.shape
+        call = _hv_at_call(T, S, self.num_blocks, _interpret())
+        d2_3 = d2_row.astype(jnp.float32).reshape(T, 1, ROWS_PER_TILE)
+        sh = jnp.stack([jnp.asarray(v_shift, jnp.float32), jnp.float32(0)])
+        sums, g = call(*self._slot_args(), d2_3, self._w2(v_eff),
                        sh.reshape(1, 2))
         return g.reshape(-1)[: self.num_features], sums[0, 0]
 

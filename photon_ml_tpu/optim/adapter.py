@@ -99,8 +99,7 @@ def glm_adapter(
         return obj.margins(w, batch)
 
     def ls_prepare_z(z, w, p):
-        p_eff, p_shift = obj._effective(p)
-        u = batch.dot_rows(p_eff) + p_shift
+        u = dir_margins(p)
         return _LSCarry(
             z=z,
             u=u,
@@ -117,6 +116,19 @@ def glm_adapter(
     def value_and_grad_at(w, z):
         return obj.value_and_grad_at_margins(w, z, batch, axis_name)
 
+    def dir_margins(p):
+        p_eff, p_shift = obj._effective(p)
+        return batch.dot_rows(p_eff) + p_shift
+
+    curvature = None
+    hvp_at = None
+    if loss.has_hessian:
+        def curvature(z):
+            return obj.curvature_at_margins(z, batch)
+
+        def hvp_at(d2, v):
+            return obj.hessian_vector_with_curvature(d2, v, batch, axis_name)
+
     return Objective(
         value_and_grad=value_and_grad,
         value=value,
@@ -127,4 +139,7 @@ def glm_adapter(
         ls_prepare_z=ls_prepare_z,
         ls_advance=ls_advance,
         value_and_grad_at=value_and_grad_at,
+        dir_margins=dir_margins,
+        curvature=curvature,
+        hvp_at=hvp_at,
     )

@@ -68,6 +68,12 @@ class Objective(NamedTuple):
     value_and_grad_at: Optional[
         Callable[[Array, Array], tuple[Array, Array]]
     ] = None  # (w, z) -> (f, g)
+    dir_margins: Optional[Callable[[Array], Array]] = None  # p -> X'@p (+shift)
+    # TRON CG fast path: ``curvature(z)`` -> per-row d2 = weight*l''(z),
+    # computed ONCE per outer iteration; ``hvp_at(d2, v)`` -> Hv with no
+    # per-call z gather or d2z pass (one gather + one scatter sweep)
+    curvature: Optional[Callable[[Array], Array]] = None  # z -> d2 rows
+    hvp_at: Optional[Callable[[Array, Array], Array]] = None  # (d2, v) -> Hv
 
 
 def from_value_and_grad(

@@ -143,6 +143,7 @@ class _TRONState(NamedTuple):
     reason: Array
     values: Array
     grad_norms: Array
+    z: Array  # carried margins X'@w (margin-carrying fast path; else [0])
 
 
 def tron_solve(
@@ -159,7 +160,25 @@ def tron_solve(
     dtype = w0.dtype
 
     w0 = project_or_identity(constraints, w0)
-    f0, g0 = objective.value_and_grad(w0)
+    # margin-carrying fast path: z = X'@w is fixed across a whole truncated-
+    # CG inner loop, so each Hv product needs one gather + one scatter
+    # (hvp_at) instead of the fused two-gather+scatter sweep; the trial
+    # point advances z linearly (z + X'@step). Projection breaks linearity,
+    # so box-constrained solves keep the standard path.
+    use_z = (
+        constraints is None
+        and objective.margins is not None
+        and objective.dir_margins is not None
+        and objective.curvature is not None
+        and objective.hvp_at is not None
+        and objective.value_and_grad_at is not None
+    )
+    if use_z:
+        z0 = objective.margins(w0)
+        f0, g0 = objective.value_and_grad_at(w0, z0)
+    else:
+        z0 = jnp.zeros((0,), dtype)
+        f0, g0 = objective.value_and_grad(w0)
     g0n = jnp.linalg.norm(g0)
     anchor_f = f0 if init_value is None else jnp.asarray(init_value, dtype)
     anchor_gn = g0n if init_grad_norm is None else jnp.asarray(init_grad_norm, dtype)
@@ -179,19 +198,29 @@ def tron_solve(
         reason=jnp.int32(NOT_CONVERGED),
         values=values,
         grad_norms=gnorms,
+        z=z0,
     )
 
     def cond(s: _TRONState):
         return s.reason == NOT_CONVERGED
 
     def body(s: _TRONState) -> _TRONState:
-        hvp = lambda v: objective.hvp(s.w, v)
+        if use_z:
+            d2 = objective.curvature(s.z)  # loop-invariant across the CG solve
+            hvp = lambda v: objective.hvp_at(d2, v)
+        else:
+            hvp = lambda v: objective.hvp(s.w, v)
         _, step, residual = _truncated_cg(hvp, s.grad, s.delta, config)
 
         w_try = s.w + step
         gs = jnp.dot(s.grad, step)
         predicted = -0.5 * (gs - jnp.dot(step, residual))
-        f_try, g_try = objective.value_and_grad(w_try)
+        if use_z:
+            z_try = s.z + objective.dir_margins(step)
+            f_try, g_try = objective.value_and_grad_at(w_try, z_try)
+        else:
+            z_try = s.z
+            f_try, g_try = objective.value_and_grad(w_try)
         actual = s.value - f_try
         step_norm = jnp.linalg.norm(step)
 
@@ -262,6 +291,7 @@ def tron_solve(
             iteration=it,
             failures=failures,
             reason=reason,
+            z=jnp.where(improved, z_try, s.z),
             values=jnp.where(
                 improved, s.values.at[it].set(f_try), s.values
             ),
