@@ -55,6 +55,16 @@ def read_input(
     spec = dict(spec)
     fmt = spec.pop("format", "avro")
     paths = spec.pop("paths")
+    dr = spec.pop("date_range", None)
+    dr_ago = spec.pop("date_range_days_ago", None)
+    if dr or dr_ago:
+        # daily-directory expansion (IOUtils.getInputPathsWithinDateRange)
+        from photon_ml_tpu.data.paths import expand_input_paths
+
+        if isinstance(paths, str):
+            paths = [paths]
+        paths = expand_input_paths(paths, date_range=dr,
+                                   date_range_days_ago=dr_ago)
     if fmt == "avro":
         from photon_ml_tpu.data.avro import (
             build_index_map_from_avro,
