@@ -58,6 +58,11 @@ def read_input(
     dr = spec.pop("date_range", None)
     dr_ago = spec.pop("date_range_days_ago", None)
     if dr or dr_ago:
+        if fmt != "avro":
+            raise ValueError(
+                "date_range expansion is supported for avro daily "
+                f"directories only, not format '{fmt}'"
+            )
         # daily-directory expansion (IOUtils.getInputPathsWithinDateRange)
         from photon_ml_tpu.data.paths import expand_input_paths
 

@@ -29,10 +29,57 @@ from photon_ml_tpu.optim.factory import (
 )
 
 
-def parse_optimizer_config(obj: Optional[Mapping]) -> OptimizerConfig:
-    """Parse the JSON optimizer spec (GLMOptimizationConfiguration analog:
-    the reference string DSL `maxIter,tol,lambda,downSample,optType,regType`
-    becomes named fields)."""
+_REG_TYPE_ALIASES = {
+    "none": "none",
+    "l1": "l1",
+    "l2": "l2",
+    "elastic_net": "elastic_net",
+    "elasticnet": "elastic_net",
+}
+
+
+def parse_optimizer_config_string(spec: str) -> OptimizerConfig:
+    """Parse the reference's comma-separated optimizer mini-DSL:
+    ``maxIter,tolerance,regWeight,downSamplingRate,optimizerType,regType
+    [,alpha]`` (GLMOptimizationConfiguration.parseAndBuildFromString:87-110;
+    the trailing alpha extends it for elastic net)."""
+    parts = [p.strip() for p in spec.split(",")]
+    if len(parts) not in (6, 7):
+        raise ValueError(
+            f"bad optimizer config string '{spec}': expected "
+            "'maxIter,tol,lambda,downSamplingRate,optimizerType,"
+            "regularizationType[,alpha]'"
+        )
+    max_iter, tol, lam, ds_rate = parts[0], parts[1], parts[2], parts[3]
+    try:
+        opt_type = OptimizerType(parts[4].lower())
+    except ValueError:
+        raise ValueError(f"unknown optimizer type '{parts[4]}'") from None
+    reg_name = parts[5].lower()
+    if reg_name not in _REG_TYPE_ALIASES:
+        raise ValueError(f"unknown regularization type '{parts[5]}'")
+    reg_type = RegularizationType(_REG_TYPE_ALIASES[reg_name])
+    if len(parts) == 7 and reg_type != RegularizationType.ELASTIC_NET:
+        raise ValueError(
+            f"alpha ('{parts[6]}') only applies to elastic_net, not "
+            f"'{parts[5]}'"
+        )
+    alpha = float(parts[6]) if len(parts) == 7 else 1.0
+    return OptimizerConfig(
+        optimizer_type=opt_type,
+        max_iterations=int(max_iter),
+        tolerance=float(tol),
+        regularization=RegularizationContext(reg_type, alpha=alpha),
+        regularization_weight=float(lam),
+        down_sampling_rate=float(ds_rate),
+    )
+
+
+def parse_optimizer_config(obj: Optional[Mapping | str]) -> OptimizerConfig:
+    """Parse the JSON optimizer spec (GLMOptimizationConfiguration analog);
+    a plain string routes through the reference's comma-separated DSL."""
+    if isinstance(obj, str):
+        return parse_optimizer_config_string(obj)
     obj = dict(obj or {})
     reg_type = RegularizationType(obj.pop("regularization", "none"))
     reg = RegularizationContext(reg_type, alpha=float(obj.pop("alpha", 1.0)))

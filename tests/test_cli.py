@@ -231,3 +231,31 @@ def test_cli_index_job(avro_dataset, tmp_path):
     # mmap store loads and answers lookups
     mm = MmapIndexMap(os.path.join(out, "global"))
     assert mm.get("c3") == imap.get("c3")
+
+
+def test_parse_optimizer_config_string_dsl():
+    """Reference mini-DSL: maxIter,tol,lambda,downSample,optType,regType
+    (GLMOptimizationConfiguration.parseAndBuildFromString)."""
+    from photon_ml_tpu.config import parse_optimizer_config
+
+    cfg = parse_optimizer_config("50, 1e-6, 0.3, 0.8, LBFGS, L2")
+    assert cfg.max_iterations == 50
+    assert cfg.tolerance == 1e-6
+    assert cfg.regularization_weight == 0.3
+    assert cfg.down_sampling_rate == 0.8
+    assert cfg.optimizer_type == OptimizerType.LBFGS
+    assert cfg.regularization.reg_type == RegularizationType.L2
+    en = parse_optimizer_config("10,1e-4,1.0,1.0,LBFGS,ELASTIC_NET,0.3")
+    assert en.regularization.reg_type == RegularizationType.ELASTIC_NET
+    assert en.regularization.alpha == 0.3
+    with pytest.raises(ValueError, match="expected"):
+        parse_optimizer_config("10,1e-4,1.0")
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        parse_optimizer_config("10,1e-4,1,1,SGD,L2")
+
+
+def test_dsl_alpha_only_for_elastic_net():
+    from photon_ml_tpu.config import parse_optimizer_config
+
+    with pytest.raises(ValueError, match="elastic_net"):
+        parse_optimizer_config("50,1e-6,0.3,0.8,LBFGS,L2,0.5")

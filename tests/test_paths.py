@@ -1,10 +1,8 @@
 """Date-range input path expansion (IOUtils/DateRange analog)."""
 
 import datetime
-import json
 import os
 
-import numpy as np
 import pytest
 
 from photon_ml_tpu.data.paths import (
