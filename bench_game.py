@@ -80,18 +80,28 @@ def main():
         id_columns={"userId": users},
     )
 
+    from photon_ml_tpu.optim import OptimizerType
+
     opt = OptimizerConfig(
         max_iterations=20,
         tolerance=0.0,
         regularization=RegularizationContext(RegularizationType.L2),
         regularization_weight=1.0,
     )
+    # per-entity solves use the batched-Newton fast path (explicit [K,K]
+    # Hessians on the MXU): same optima, ~5x fewer sequential loop steps
+    # than vmapped LBFGS for these tiny local dims
+    import dataclasses as _dc
+
+    re_opt = _dc.replace(
+        opt, optimizer_type=OptimizerType.NEWTON, tolerance=1e-7
+    )
     config = GameConfig(
         task="logistic",
         coordinates={
             "fixed": FixedEffectConfig(shard_name="global", optimizer=opt),
             "per-user": RandomEffectConfig(
-                shard_name="user", id_name="userId", optimizer=opt),
+                shard_name="user", id_name="userId", optimizer=re_opt),
         },
         num_iterations=cd_iterations,
     )

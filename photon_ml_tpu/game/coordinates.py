@@ -451,15 +451,17 @@ class RandomEffectCoordinate:
                 bb_p, w0_p = _pad_entities(bb, w0, total)
                 res = self._sharded_solver(self._obj, bb_p, w0_p, self._l1)
                 w = res.w[:num_e]
-            # pull only the tiny telemetry vectors to host so the full
-            # SolveResult (grad + tracking buffers) frees per bucket
+            # keep only the tiny telemetry vectors (the full SolveResult
+            # frees per bucket); stay ON DEVICE — each host fetch costs a
+            # ~100ms tunnel round trip, so both arrays cross in ONE
+            # np.asarray each after a device-side concat
             n_real = int(w0.shape[0])
-            tracker_its.append(np.asarray(res.iterations)[:n_real])
-            tracker_reasons.append(np.asarray(res.reason)[:n_real])
+            tracker_its.append(res.iterations[:n_real])
+            tracker_reasons.append(res.reason[:n_real])
             new_buckets.append(dataclasses.replace(bm, coefficients=w))
         self.last_tracker = RandomEffectOptimizationTracker(
-            iterations=np.concatenate(tracker_its),
-            reasons=np.concatenate(tracker_reasons),
+            iterations=np.asarray(jnp.concatenate(tracker_its)),
+            reasons=np.asarray(jnp.concatenate(tracker_reasons)),
         )
         return dataclasses.replace(model, buckets=tuple(new_buckets))
 

@@ -212,6 +212,25 @@ class GLMObjective:
                 diag = f * f * (raw_sq - 2.0 * s * raw_lin + s * s * total)
         return self._psum(diag, axis_name) + self.l2_weight.astype(w.dtype)
 
+    def dense_hessian(
+        self, w: Array, batch: SparseBatch, axis_name: Optional[str] = None
+    ) -> Array:
+        """Full H(w) = X'^T diag(wgt*l'') X' + l2 I as a dense [d, d] —
+        the explicit-Hessian path for SMALL d (per-entity local spaces;
+        batched Newton). Normalization materializes X' = (X - shift)*factor
+        on the densified design."""
+        z = self.margins(w, batch)
+        d2 = batch.weights * self.loss.d2z(z, batch.labels)
+        X = batch.dense_rows()
+        if self.shifts is not None:
+            X = X - self.shifts[None, :]
+        if self.factors is not None:
+            X = X * self.factors[None, :]
+        H = (X * d2[:, None]).T @ X
+        H = self._psum(H, axis_name)
+        d = batch.num_features
+        return H + self.l2_weight.astype(w.dtype) * jnp.eye(d, dtype=w.dtype)
+
     # -- plumbing ------------------------------------------------------------
 
     def with_l2(self, l2_weight) -> "GLMObjective":

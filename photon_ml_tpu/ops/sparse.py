@@ -165,6 +165,13 @@ class SparseBatch:
             dtype=dtype,
         )
 
+    def dense_rows(self) -> Array:
+        """DEVICE-side densify [num_rows, num_features] — jit/vmap friendly.
+        Intended for small feature dims (per-entity local spaces) where
+        explicit-Hessian solvers want the dense design."""
+        X = jnp.zeros((self.num_rows, self.num_features), self.dtype)
+        return X.at[self.rows, self.cols].add(self.values)
+
     def to_dense(self) -> np.ndarray:
         """Host-side densify (tests / diagnostics only)."""
         X = np.zeros((self.num_rows, self.num_features), dtype=np.float64)

@@ -19,6 +19,7 @@ from photon_ml_tpu.optim.factory import (  # noqa: F401
     build_objective,
     solve,
 )
+from photon_ml_tpu.optim.newton import NewtonConfig, newton_solve  # noqa: F401
 from photon_ml_tpu.optim.lbfgs import LBFGSConfig, lbfgs_solve  # noqa: F401
 from photon_ml_tpu.optim.owlqn import owlqn_solve  # noqa: F401
 from photon_ml_tpu.optim.tron import TRONConfig, tron_solve  # noqa: F401

@@ -120,6 +120,11 @@ def glm_adapter(
         p_eff, p_shift = obj._effective(p)
         return batch.dot_rows(p_eff) + p_shift
 
+    hessian = None
+    if loss.has_hessian and hasattr(batch, "dense_rows"):
+        def hessian(w):
+            return obj.dense_hessian(w, batch, axis_name)
+
     curvature = None
     hvp_at = None
     if loss.has_hessian:
@@ -142,4 +147,5 @@ def glm_adapter(
         dir_margins=dir_margins,
         curvature=curvature,
         hvp_at=hvp_at,
+        hessian=hessian,
     )

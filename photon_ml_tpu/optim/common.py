@@ -74,6 +74,9 @@ class Objective(NamedTuple):
     # per-call z gather or d2z pass (one gather + one scatter sweep)
     curvature: Optional[Callable[[Array], Array]] = None  # z -> d2 rows
     hvp_at: Optional[Callable[[Array, Array], Array]] = None  # (d2, v) -> Hv
+    # Full dense Hessian (small-d only): the batched-Newton fast path for
+    # per-entity solves. None when the layout can't densify (TiledBatch).
+    hessian: Optional[Callable[[Array], Array]] = None  # w -> H [d, d]
 
 
 def from_value_and_grad(

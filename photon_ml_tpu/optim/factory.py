@@ -22,6 +22,7 @@ from photon_ml_tpu.ops.sparse import SparseBatch
 from photon_ml_tpu.optim.adapter import glm_adapter
 from photon_ml_tpu.optim.common import BoxConstraints, SolveResult
 from photon_ml_tpu.optim.lbfgs import LBFGSConfig, lbfgs_solve
+from photon_ml_tpu.optim.newton import NewtonConfig, newton_solve
 from photon_ml_tpu.optim.owlqn import owlqn_solve
 from photon_ml_tpu.optim.tron import TRONConfig, tron_solve
 
@@ -31,6 +32,11 @@ Array = jax.Array
 class OptimizerType(str, Enum):
     LBFGS = "lbfgs"
     TRON = "tron"
+    # TPU-first addition (no reference analog): damped Newton with explicit
+    # batched [d, d] Hessians — the latency-light fast path for SMALL-d
+    # solves (per-entity random effects), where vmapped LBFGS is bound by
+    # sequential while_loop depth, not FLOPs
+    NEWTON = "newton"
 
 
 class RegularizationType(str, Enum):
@@ -114,16 +120,17 @@ class OptimizerConfig:
             RegularizationType.L1,
             RegularizationType.ELASTIC_NET,
         )
-        if self.optimizer_type == OptimizerType.TRON:
+        if self.optimizer_type in (OptimizerType.TRON, OptimizerType.NEWTON):
+            name = self.optimizer_type.value.upper()
             if uses_l1:
                 raise ValueError(
-                    "TRON does not support L1/elastic-net regularization "
+                    f"{name} does not support L1/elastic-net regularization "
                     "(OptimizerFactory parity)"
                 )
             if not get_loss(loss_name).has_hessian:
                 raise ValueError(
-                    f"TRON requires a twice-differentiable loss; '{loss_name}' "
-                    "is not (use LBFGS/OWLQN)"
+                    f"{name} requires a twice-differentiable loss; "
+                    f"'{loss_name}' is not (use LBFGS/OWLQN)"
                 )
 
 
@@ -172,6 +179,25 @@ def dispatch_solve(
             constraints=constraints,
             init_value=init_value,
             init_grad_norm=init_grad_norm,
+        )
+    if config.optimizer_type == OptimizerType.NEWTON:
+        if adapter.hessian is None:
+            raise ValueError(
+                "NEWTON needs a dense-Hessian adapter (small-d layouts only; "
+                "the tiled layout cannot densify)"
+            )
+        return newton_solve(
+            adapter.value_and_grad,
+            adapter.hessian,
+            w0,
+            NewtonConfig(
+                max_iterations=config.max_iterations, tolerance=config.tolerance
+            ),
+            constraints=constraints,
+            init_value=init_value,
+            init_grad_norm=init_grad_norm,
+            ls_prepare=adapter.ls_prepare,
+            ls_eval=adapter.ls_eval,
         )
 
     lcfg = LBFGSConfig(
