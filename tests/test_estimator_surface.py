@@ -322,3 +322,26 @@ def test_optimization_trackers(rng):
     }
     s = t.iteration_stats()
     assert s["count"] == 4 and s["mean"] == 5.0 and s["max"] == 7.0
+
+
+def test_repeated_fit_reproducible_with_down_sampling(rng):
+    """Regression: the coordinate cache must reset per-fit state so two
+    fits of the same estimator draw the SAME seeded down-sampling sequence
+    and return identical models."""
+    data, *_ = _data(rng, n=300)
+    cfg = GameConfig(
+        task="logistic",
+        coordinates={
+            "fixed": FixedEffectConfig(
+                shard_name="f",
+                optimizer=OptimizerConfig(down_sampling_rate=0.5),
+                down_sampling_seed=7,
+            )
+        },
+    )
+    est = GameEstimator(cfg)
+    m1 = est.fit(data).model.models["fixed"]
+    m2 = est.fit(data).model.models["fixed"]
+    np.testing.assert_array_equal(
+        np.asarray(m1.coefficients), np.asarray(m2.coefficients)
+    )
