@@ -139,6 +139,7 @@ def parse_coordinate_config(obj: Mapping):
             projected_dim=obj.pop("projected_dim", None),
             projection_seed=int(obj.pop("projection_seed", 0)),
             projection_intercept_index=obj.pop("projection_intercept_index", None),
+            compute_variances=bool(obj.pop("compute_variances", False)),
         )
     elif ctype == "factored_random_effect":
         out = FactoredRandomEffectConfig(

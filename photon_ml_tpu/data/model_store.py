@@ -131,6 +131,8 @@ def _save_random_effect(model: RandomEffectModel, path: str) -> dict:
         arrays[f"coefficients_{i}"] = np.asarray(bm.coefficients, np.float32)
         arrays[f"projection_{i}"] = np.asarray(bm.projection, np.int32)
         arrays[f"entity_codes_{i}"] = np.asarray(bm.entity_codes, np.int32)
+        if bm.variances is not None:
+            arrays[f"variances_{i}"] = np.asarray(bm.variances, np.float32)
     _write_npz(os.path.join(path, "model.npz"), **arrays)
     return {
         "type": "random_effect",
@@ -148,6 +150,11 @@ def _load_random_effect(path: str, spec: dict) -> RandomEffectModel:
                 coefficients=jnp.asarray(z[f"coefficients_{i}"]),
                 projection=jnp.asarray(z[f"projection_{i}"]),
                 entity_codes=jnp.asarray(z[f"entity_codes_{i}"]),
+                variances=(
+                    jnp.asarray(z[f"variances_{i}"])
+                    if f"variances_{i}" in z
+                    else None
+                ),
             )
             for i in range(spec["num_buckets"])
         )

@@ -38,6 +38,7 @@ from photon_ml_tpu.ops.objective import make_objective
 from photon_ml_tpu.ops.sparse import SparseBatch
 from photon_ml_tpu.ops.tiled import ROWS_PER_TILE, TiledBatch
 from photon_ml_tpu.optim.adapter import glm_adapter
+from photon_ml_tpu.optim.common import BoxConstraints
 from photon_ml_tpu.optim.factory import OptimizerConfig, dispatch_solve
 from photon_ml_tpu.parallel.distributed import distributed_solve
 from photon_ml_tpu.parallel.mesh import put_sharded, shard_rows, shard_tiles
@@ -299,31 +300,65 @@ class FixedEffectCoordinate:
 # Random effect
 # ---------------------------------------------------------------------------
 
+# DistributedOptimizationProblem.computeVariances adds this to the Hessian
+# diagonal before inverting (MathConst.HIGH_PRECISION_TOLERANCE_THRESHOLD)
+_VARIANCE_EPS = 1e-12
+
+
+def _make_solve_one(config: OptimizerConfig, compute_variances: bool):
+    """One entity's solve (+optional Hessian-diagonal-inverse variances, the
+    computeVariances path of SingleNodeOptimizationProblem.scala:57-88).
+    Returns ``(SolveResult, variances-or-None)``."""
+
+    def solve_one(obj, batch, w0, l1, constraints):
+        res = dispatch_solve(
+            glm_adapter(obj, batch), w0, config, l1, constraints=constraints
+        )
+        if not compute_variances:
+            return res, None
+        var = 1.0 / (obj.hessian_diagonal(res.w, batch) + _VARIANCE_EPS)
+        return res, var
+
+    return solve_one
+
+
 @lru_cache(maxsize=64)
-def _re_solver(config: OptimizerConfig, loss_name: str):
-    def solve_one(obj, batch, w0, l1):
-        return dispatch_solve(glm_adapter(obj, batch), w0, config, l1)
-
-    # obj, l1 broadcast; batch leaves and w0 map over the entity axis
-    return jax.jit(jax.vmap(solve_one, in_axes=(None, 0, 0, None)))
+def _re_solver(
+    config: OptimizerConfig,
+    loss_name: str,
+    constrained: bool = False,
+    compute_variances: bool = False,
+):
+    solve_one = _make_solve_one(config, compute_variances)
+    # obj, l1 broadcast; batch leaves, w0 (and per-entity constraint boxes,
+    # when present) map over the entity axis
+    c_axis = 0 if constrained else None
+    return jax.jit(jax.vmap(solve_one, in_axes=(None, 0, 0, None, c_axis)))
 
 
 @lru_cache(maxsize=64)
-def _re_solver_sharded(config: OptimizerConfig, loss_name: str, mesh: Mesh, axis: str):
+def _re_solver_sharded(
+    config: OptimizerConfig,
+    loss_name: str,
+    mesh: Mesh,
+    axis: str,
+    constrained: bool = False,
+    compute_variances: bool = False,
+):
     """Entity-sharded bucket solver: explicit shard_map over ``axis`` — each
     device runs the vmapped while-loop solve on its local entity block with
     NO collectives (per-entity problems are independent; the EP-like strategy
     of SURVEY.md §2.f / RandomEffectCoordinate.scala:101-130)."""
 
-    def solve_one(obj, batch, w0, l1):
-        return dispatch_solve(glm_adapter(obj, batch), w0, config, l1)
+    solve_one = _make_solve_one(config, compute_variances)
+    c_axis = 0 if constrained else None
 
-    def local(obj, bucket_batch, w0, l1):
-        return jax.vmap(solve_one, in_axes=(None, 0, 0, None))(
-            obj, bucket_batch, w0, l1
+    def local(obj, bucket_batch, w0, l1, constraints):
+        return jax.vmap(solve_one, in_axes=(None, 0, 0, None, c_axis))(
+            obj, bucket_batch, w0, l1, constraints
         )
 
-    def wrapped(obj, bucket_batch, w0, l1):
+    def wrapped(obj, bucket_batch, w0, l1, constraints):
         rep = lambda t: jax.tree.map(lambda _: P(), t)
         return jax.shard_map(
             local,
@@ -333,10 +368,11 @@ def _re_solver_sharded(config: OptimizerConfig, loss_name: str, mesh: Mesh, axis
                 jax.tree.map(lambda _: P(axis), bucket_batch),
                 P(axis),
                 P(),
+                jax.tree.map(lambda _: P(axis), constraints),
             ),
             out_specs=P(axis),
             check_vma=False,
-        )(obj, bucket_batch, w0, l1)
+        )(obj, bucket_batch, w0, l1, constraints)
 
     return jax.jit(wrapped)
 
@@ -353,6 +389,22 @@ def _pad_entities(batch: SparseBatch, w0: Array, total: int):
         return jnp.concatenate([x, pad], axis=0)
 
     return jax.tree.map(padf, batch), padf(w0)
+
+
+def _pad_constraints(cons: Optional[BoxConstraints], total: int):
+    """Pad per-entity constraint boxes to ``total`` entities with unbounded
+    rows (padded problems are all-zero; their iterates must stay free)."""
+    if cons is None or cons.lower.shape[0] == total:
+        return cons
+
+    def padv(x, fill):
+        n = x.shape[0]
+        pad = jnp.full((total - n,) + x.shape[1:], fill, x.dtype)
+        return jnp.concatenate([x, pad], axis=0)
+
+    return BoxConstraints(
+        lower=padv(cons.lower, -jnp.inf), upper=padv(cons.upper, jnp.inf)
+    )
 
 
 @lru_cache(maxsize=64)
@@ -381,21 +433,48 @@ class RandomEffectCoordinate:
     loss_name: str
     config: OptimizerConfig
     mesh: Optional[Mesh] = None  # 1-D entity-axis mesh -> shard_map solve
+    compute_variances: bool = False  # per-coefficient Hessian-diag inverse
 
     def __post_init__(self):
+        from photon_ml_tpu.ops.losses import get_loss
+
         self.config.validate(self.loss_name)
-        if self.config.box_constraints:
+        if self.compute_variances and not get_loss(self.loss_name).has_hessian:
             raise ValueError(
-                "box constraints address the global feature space; per-entity"
-                " solves run in projected local spaces (use them on the"
-                " fixed-effect coordinate)"
+                "coefficient variances need a twice-differentiable loss; "
+                f"'{self.loss_name}' is not"
             )
+        # Box constraints are declared against GLOBAL feature ids
+        # (OptimizerConfig constraintMap); each entity's local space is an
+        # index-map renumbering (local k <-> global projection[e, k]), so the
+        # global boxes gather straight through the projection into per-entity
+        # [E, K] bounds — the reference threads the same map into every
+        # per-entity problem (SingleNodeOptimizationProblem.scala:124-139).
+        self._bucket_constraints: list = [None] * len(self.re_data.buckets)
+        constrained = bool(self.config.box_constraints)
+        if constrained:
+            lower_g, upper_g = self.config.dense_box_bounds(
+                self.re_data.num_global_features, sentinel=True
+            )
+            for i, b in enumerate(self.re_data.buckets):
+                proj = np.asarray(b.projection)
+                self._bucket_constraints[i] = BoxConstraints(
+                    lower=jnp.asarray(lower_g[proj]),
+                    upper=jnp.asarray(upper_g[proj]),
+                )
         key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
         if self.mesh is not None:
             self._sharded_solver = _re_solver_sharded(
-                key_cfg, self.loss_name, self.mesh, self.mesh.axis_names[0]
+                key_cfg,
+                self.loss_name,
+                self.mesh,
+                self.mesh.axis_names[0],
+                constrained,
+                self.compute_variances,
             )
-        self._solver = _re_solver(key_cfg, self.loss_name)
+        self._solver = _re_solver(
+            key_cfg, self.loss_name, constrained, self.compute_variances
+        )
         self._scorer = _re_scorer()
         self._obj = make_objective(
             self.loss_name,
@@ -435,22 +514,29 @@ class RandomEffectCoordinate:
         new_buckets = []
         tracker_its = []
         tracker_reasons = []
+        tracker_vals = []
         n_dev = 0 if self.mesh is None else int(self.mesh.devices.size)
-        for b, bm in zip(self.re_data.buckets, model.buckets):
+        for i, (b, bm) in enumerate(zip(self.re_data.buckets, model.buckets)):
             bucket = (
                 b if residual_scores is None else b.with_extra_offsets(residual_scores)
             )
             bb = bucket.entity_batch()
             w0 = bm.coefficients
+            cons = self._bucket_constraints[i]
             if self.mesh is None:
-                res = self._solver(self._obj, bb, w0, self._l1)
+                res, var = self._solver(self._obj, bb, w0, self._l1, cons)
                 w = res.w
             else:
                 num_e = w0.shape[0]
                 total = -(-num_e // n_dev) * n_dev
                 bb_p, w0_p = _pad_entities(bb, w0, total)
-                res = self._sharded_solver(self._obj, bb_p, w0_p, self._l1)
+                cons_p = _pad_constraints(cons, total)
+                res, var = self._sharded_solver(
+                    self._obj, bb_p, w0_p, self._l1, cons_p
+                )
                 w = res.w[:num_e]
+                if var is not None:
+                    var = var[:num_e]
             # keep only the tiny telemetry vectors (the full SolveResult
             # frees per bucket); stay ON DEVICE — each host fetch costs a
             # ~100ms tunnel round trip, so both arrays cross in ONE
@@ -458,10 +544,12 @@ class RandomEffectCoordinate:
             n_real = int(w0.shape[0])
             tracker_its.append(res.iterations[:n_real])
             tracker_reasons.append(res.reason[:n_real])
-            new_buckets.append(dataclasses.replace(bm, coefficients=w))
-        self.last_tracker = RandomEffectOptimizationTracker(
-            iterations=np.asarray(jnp.concatenate(tracker_its)),
-            reasons=np.asarray(jnp.concatenate(tracker_reasons)),
+            tracker_vals.append(res.value[:n_real])
+            new_buckets.append(
+                dataclasses.replace(bm, coefficients=w, variances=var)
+            )
+        self.last_tracker = RandomEffectOptimizationTracker.from_device_parts(
+            tracker_its, tracker_reasons, tracker_vals
         )
         return dataclasses.replace(model, buckets=tuple(new_buckets))
 

@@ -78,8 +78,18 @@ class RandomEffectConfig:
     projected_dim: Optional[int] = None
     projection_seed: int = 0
     projection_intercept_index: Optional[int] = None
+    # per-coefficient posterior variances via Hessian-diagonal inverse at
+    # each entity's optimum (SingleNodeOptimizationProblem.scala:57-88)
+    compute_variances: bool = False
 
     def __post_init__(self):
+        if self.projector == "random" and self.compute_variances:
+            raise ValueError(
+                "compute_variances needs the index_map projector: under a "
+                "Gaussian random projection the local coordinates are mixtures "
+                "of global features, so per-coefficient variances have no "
+                "original-space meaning"
+            )
         if self.projector not in ("index_map", "random"):
             raise ValueError(f"unknown projector '{self.projector}'")
         if self.projector == "random" and not self.projected_dim:
@@ -273,6 +283,7 @@ class GameEstimator:
                         loss_name=self.config.task,
                         config=opt or c.optimizer,
                         mesh=entity_mesh,
+                        compute_variances=c.compute_variances,
                     )
             elif isinstance(c, FactoredRandomEffectConfig):
                 red = self._re_dataset(data, c)
@@ -537,6 +548,7 @@ def _config_metadata(config: GameConfig) -> dict:
             out["projected_dim"] = c.projected_dim
             out["projection_seed"] = c.projection_seed
             out["projection_intercept_index"] = c.projection_intercept_index
+            out["compute_variances"] = c.compute_variances
             out["optimizer"] = describe_opt(c.optimizer)
         elif isinstance(c, FactoredRandomEffectConfig):
             out["type"] = "factored_random_effect"

@@ -461,10 +461,14 @@ class FactoredRandomEffectCoordinate:
 
     def _latent_re_step(
         self, latent: Array, a_ext: Array, residual: Optional[Array]
-    ) -> Array:
-        """One pass of per-entity solves in latent space over all buckets."""
+    ):
+        """One pass of per-entity solves in latent space over all buckets.
+        Returns ``(latent', (its, reasons, values))`` — the telemetry stays
+        as DEVICE arrays so the MF alternation loop never blocks on a host
+        fetch; update_model packs it once after the loop."""
         k = self._proj_rows
         parts = []
+        t_its, t_reasons, t_vals = [], [], []
         for b_idx, b in enumerate(self.re_data.buckets):
             bucket = b if residual is None else b.with_extra_offsets(residual)
             E, R = b.num_entities, b.rows_per_entity
@@ -486,24 +490,30 @@ class FactoredRandomEffectCoordinate:
             )
             w0 = self._bucket_slice(latent, b_idx)
             if self.mesh is None:
-                res = self._re_solver(self._re_obj, dense, w0, self._re_l1)
+                res, _ = self._re_solver(
+                    self._re_obj, dense, w0, self._re_l1, None
+                )
                 w = res.w
             else:
                 total = -(-E // self._n_dev) * self._n_dev
                 from photon_ml_tpu.game.coordinates import _pad_entities
 
                 dense_p, w0_p = _pad_entities(dense, w0, total)
-                res = self._re_solver_sharded(
-                    self._re_obj, dense_p, w0_p, self._re_l1
+                res, _ = self._re_solver_sharded(
+                    self._re_obj, dense_p, w0_p, self._re_l1, None
                 )
                 w = res.w[:E]
             parts.append(w)
-        return jnp.concatenate(parts, axis=0) if parts else latent
+            t_its.append(res.iterations[:E])
+            t_reasons.append(res.reason[:E])
+            t_vals.append(res.value[:E])
+        new_latent = jnp.concatenate(parts, axis=0) if parts else latent
+        return new_latent, (t_its, t_reasons, t_vals)
 
-    def _latent_matrix_step(
-        self, latent: Array, a: Array, residual: Optional[Array]
-    ) -> Array:
-        """Refit vec(A) as one GLM over the static kronecker structure."""
+    def _latent_matrix_step(self, latent: Array, a: Array, residual: Optional[Array]):
+        """Refit vec(A) as one GLM over the static kronecker structure.
+        Returns ``(A', SolveResult)`` — tracker construction (4 scalar host
+        fetches) is deferred past the MF loop by update_model."""
         vals = _kron_values(self._kron_vals, self._kron_ent, latent)
         vals = vals[self._kron_perm]
         w0 = a.T.reshape(-1)  # vec layout matches cols j*K + l
@@ -532,31 +542,54 @@ class FactoredRandomEffectCoordinate:
                 self.mesh,
                 axis=self._axis,
             )
-            return res.w.reshape(-1, k).T
+            return res.w.reshape(-1, k).T, res
         batch = dataclasses.replace(self._latent_template, values=vals)
         if residual is not None:
             off = jnp.asarray(self._base_offsets, batch.dtype) + residual
             batch = dataclasses.replace(batch, offsets=off)
         res = self._lat_solver(self._lat_obj, batch, w0, self._lat_l1)
-        return res.w.reshape(-1, k).T  # [K, d]
+        return res.w.reshape(-1, k).T, res  # [K, d]
 
     def update_model(
         self,
         model: FactoredRandomEffectModel,
         residual_scores: Optional[Array],
     ) -> FactoredRandomEffectModel:
+        from photon_ml_tpu.optim.trackers import (
+            FactoredRandomEffectOptimizationTracker,
+            FixedEffectOptimizationTracker,
+            RandomEffectOptimizationTracker,
+        )
+
         latent = model.latent
         a = model.projection.matrix
         if not self.refit_projection:
             # fixed random projection: per-entity solves only
-            latent = self._latent_re_step(
+            latent, re_parts = self._latent_re_step(
                 latent, model.projection.extended(), residual_scores
             )
+            re_t = RandomEffectOptimizationTracker.from_device_parts(*re_parts)
+            self.last_tracker = FactoredRandomEffectOptimizationTracker(
+                steps=((re_t, None),)
+            )
             return dataclasses.replace(model, latent=latent)
+        raw_steps = []
         for _ in range(self.mf_iterations):
             a_ext = ProjectionMatrix(matrix=a).extended()
-            latent = self._latent_re_step(latent, a_ext, residual_scores)
-            a = self._latent_matrix_step(latent, a, residual_scores)
+            latent, re_parts = self._latent_re_step(latent, a_ext, residual_scores)
+            a, lat_res = self._latent_matrix_step(latent, a, residual_scores)
+            raw_steps.append((re_parts, lat_res))
+        # all host fetches happen HERE, after the alternation finished, so
+        # each iteration's dispatch overlaps the previous one's execution
+        self.last_tracker = FactoredRandomEffectOptimizationTracker(
+            steps=tuple(
+                (
+                    RandomEffectOptimizationTracker.from_device_parts(*rp),
+                    FixedEffectOptimizationTracker.from_result(lr),
+                )
+                for rp, lr in raw_steps
+            )
+        )
         return dataclasses.replace(
             model, latent=latent, projection=ProjectionMatrix(matrix=a)
         )

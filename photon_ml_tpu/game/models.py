@@ -59,11 +59,18 @@ class FixedEffectModel:
 @dataclasses.dataclass(frozen=True)
 class RandomEffectBucketModel:
     """Per-entity coefficients for one geometry bucket, aligned with the
-    bucket's sorted projection (local id k <-> global feature projection[k])."""
+    bucket's sorted projection (local id k <-> global feature projection[k]).
+
+    ``variances`` (optional) are per-coefficient posterior variances from the
+    Hessian-diagonal inverse at each entity's optimum — the computeVariances
+    path of SingleNodeOptimizationProblem.scala:57-88; entries for padded
+    local features (projection == sentinel) are meaningless.
+    """
 
     coefficients: Array  # f[E, K]
     projection: Array  # i32[E, K] sorted global ids; sentinel = num_global
     entity_codes: Array  # i32[E]
+    variances: Optional[Array] = None  # f[E, K] when computed
 
 
 @dataclasses.dataclass(frozen=True)

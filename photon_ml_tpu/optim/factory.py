@@ -93,15 +93,19 @@ class OptimizerConfig:
     down_sampling_rate: float = 1.0
     box_constraints: Optional[tuple[tuple[int, float, float], ...]] = None
 
-    def build_box_constraints(self, num_features: int) -> Optional[BoxConstraints]:
-        """Materialize the sparse (index, lower, upper) triples as dense
-        projection bounds for a ``num_features``-dim solve."""
+    def dense_box_bounds(self, num_features: int, sentinel: bool = False):
+        """Validated dense numpy (lower, upper) bounds from the sparse
+        (index, lower, upper) triples, or None when unconstrained. With
+        ``sentinel`` the arrays carry one extra trailing unbounded slot —
+        the gather target for projected spaces' padding id (index-map
+        sentinel == num_features)."""
         if not self.box_constraints:
             return None
         import numpy as np
 
-        lower = np.full(num_features, -np.inf)
-        upper = np.full(num_features, np.inf)
+        size = num_features + (1 if sentinel else 0)
+        lower = np.full(size, -np.inf, np.float32)
+        upper = np.full(size, np.inf, np.float32)
         for idx, lo, hi in self.box_constraints:
             if not 0 <= idx < num_features:
                 raise ValueError(
@@ -110,6 +114,15 @@ class OptimizerConfig:
             if lo > hi:
                 raise ValueError(f"box constraint [{lo}, {hi}] is empty")
             lower[idx], upper[idx] = lo, hi
+        return lower, upper
+
+    def build_box_constraints(self, num_features: int) -> Optional[BoxConstraints]:
+        """Materialize the sparse (index, lower, upper) triples as dense
+        projection bounds for a ``num_features``-dim solve."""
+        bounds = self.dense_box_bounds(num_features)
+        if bounds is None:
+            return None
+        lower, upper = bounds
         return BoxConstraints(
             lower=jnp.asarray(lower, jnp.float32),
             upper=jnp.asarray(upper, jnp.float32),

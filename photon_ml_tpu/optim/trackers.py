@@ -44,27 +44,62 @@ class FixedEffectOptimizationTracker:
         )
 
 
+_PERCENTILES = (5, 25, 50, 75, 95)
+
+
+def _pct(a: np.ndarray) -> dict[str, float]:
+    if len(a) == 0:
+        return {f"p{p}": 0.0 for p in _PERCENTILES}
+    qs = np.percentile(a, _PERCENTILES)
+    return {f"p{p}": float(q) for p, q in zip(_PERCENTILES, qs)}
+
+
 @dataclasses.dataclass(frozen=True)
 class RandomEffectOptimizationTracker:
     """Per-entity solve telemetry for one coordinate update, aggregated
-    across geometry buckets (RandomEffectOptimizationTracker analog)."""
+    across geometry buckets (RandomEffectOptimizationTracker analog).
+
+    ``final_values`` (optional) are the per-entity terminal objective values;
+    together with ``iterations`` they feed the distribution summaries the
+    reference aggregates per entity (RandomEffectOptimizationTracker.scala
+    getNumIterationStats / per-state StatCounters)."""
 
     iterations: np.ndarray  # i32[n_entities]
     reasons: np.ndarray  # i32[n_entities]
+    final_values: np.ndarray | None = None  # f32[n_entities]
 
     @staticmethod
-    def from_results(results, entity_counts) -> "RandomEffectOptimizationTracker":
-        """Concatenate per-bucket vmapped SolveResults, dropping padded
-        entities (``entity_counts[i]`` = real entities of bucket i)."""
-        its, rs = [], []
-        for res, n in zip(results, entity_counts):
-            its.append(np.asarray(res.iterations)[:n])
-            rs.append(np.asarray(res.reason)[:n])
+    def from_device_parts(
+        its: list, reasons: list, vals: list
+    ) -> "RandomEffectOptimizationTracker":
+        """Build from per-bucket DEVICE arrays (padding already sliced off)
+        with ONE packed host fetch: the f32 terminal values ride the i32
+        concat via bitcast — each device->host fetch costs a ~100ms tunnel
+        round trip, so all three telemetry vectors cross together."""
+        import jax
+        import jax.numpy as jnp
+
+        if not its:
+            z = np.zeros(0, np.int32)
+            return RandomEffectOptimizationTracker(
+                iterations=z, reasons=z, final_values=np.zeros(0, np.float32)
+            )
+        packed = np.asarray(
+            jnp.concatenate(
+                [
+                    jnp.concatenate(its).astype(jnp.int32),
+                    jnp.concatenate(reasons).astype(jnp.int32),
+                    jax.lax.bitcast_convert_type(
+                        jnp.concatenate(vals).astype(jnp.float32), jnp.int32
+                    ),
+                ]
+            )
+        )
+        n = len(packed) // 3
         return RandomEffectOptimizationTracker(
-            iterations=(
-                np.concatenate(its) if its else np.zeros(0, np.int32)
-            ),
-            reasons=np.concatenate(rs) if rs else np.zeros(0, np.int32),
+            iterations=packed[:n],
+            reasons=packed[n : 2 * n],
+            final_values=packed[2 * n :].view(np.float32),
         )
 
     def count_convergence_reasons(self) -> dict[str, int]:
@@ -89,13 +124,53 @@ class RandomEffectOptimizationTracker:
             "max": float(it.max()),
         }
 
+    def percentile_summary(self) -> dict[str, dict[str, float]]:
+        """Distribution summaries of per-entity iterations and terminal
+        objective values (p5/p25/p50/p75/p95 — the per-entity StatCounter
+        aggregation of RandomEffectOptimizationTracker.scala)."""
+        out = {"iterations": _pct(self.iterations)}
+        if self.final_values is not None:
+            out["final_loss"] = _pct(self.final_values)
+        return out
+
     def to_summary_string(self) -> str:
         s = self.iteration_stats()
         reasons = ", ".join(
             f"{k}: {v}" for k, v in sorted(self.count_convergence_reasons().items())
         )
-        return (
+        pcts = self.percentile_summary()
+        it_p = pcts["iterations"]
+        lines = (
             f"entities={s['count']} iterations(mean={s['mean']:.2f}, "
-            f"std={s['stdev']:.2f}, min={s['min']:.0f}, max={s['max']:.0f}) "
+            f"std={s['stdev']:.2f}, min={s['min']:.0f}, max={s['max']:.0f}, "
+            f"p50={it_p['p50']:.0f}, p95={it_p['p95']:.0f}) "
             f"convergence {{{reasons}}}"
         )
+        if "final_loss" in pcts:
+            fl = pcts["final_loss"]
+            lines += (
+                f" final_loss(p5={fl['p5']:.4g}, p50={fl['p50']:.4g}, "
+                f"p95={fl['p95']:.4g})"
+            )
+        return lines
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectOptimizationTracker:
+    """Per-MF-iteration telemetry for the factored coordinate: each
+    alternation step pairs the latent-space RE solve's per-entity tracker
+    with the latent-matrix refit's tracker (the reference keeps exactly this
+    pair per iteration, FactoredRandomEffectOptimizationProblem.scala's
+    Array[(RandomEffectOptimizationTracker, FixedEffectOptimizationTracker)]).
+    ``matrix`` is None in fixed-projection mode (no refit happens)."""
+
+    steps: tuple  # of (RandomEffectOptimizationTracker, FE tracker | None)
+
+    def to_summary_string(self) -> str:
+        lines = []
+        for i, (re_t, fe_t) in enumerate(self.steps):
+            lines.append(f"MF iteration {i}:")
+            lines.append("  latent RE: " + re_t.to_summary_string())
+            if fe_t is not None:
+                lines.append("  latent matrix: " + fe_t.to_summary_string())
+        return "\n".join(lines)

@@ -112,9 +112,12 @@ def test_box_constraints_in_fixed_effect_config(rng):
     assert np.abs(coefs).max() > 0.1
 
 
-def test_box_constraints_rejected_for_random_effect(rng):
+def test_box_constraints_accepted_for_random_effect(rng):
+    """Global-space boxes now thread into per-entity solves through the
+    index-map projection (SingleNodeOptimizationProblem.scala:124-139);
+    detailed parity lives in test_game.py."""
     data, *_ = _data(rng, n=100)
-    opt = OptimizerConfig(box_constraints=((0, -1.0, 1.0),))
+    opt = OptimizerConfig(box_constraints=((0, -0.2, 0.2),), max_iterations=20)
     cfg = GameConfig(
         task="logistic",
         coordinates={
@@ -123,8 +126,12 @@ def test_box_constraints_rejected_for_random_effect(rng):
             )
         },
     )
-    with pytest.raises(ValueError, match="box constraints"):
-        GameEstimator(cfg).fit(data)
+    model = GameEstimator(cfg).fit(data).model.models["perUser"]
+    for bm in model.buckets:
+        proj = np.asarray(bm.projection)
+        w = np.asarray(bm.coefficients)
+        assert np.all(w[proj == 0] >= -0.2 - 1e-6)
+        assert np.all(w[proj == 0] <= 0.2 + 1e-6)
 
 
 def test_box_constraints_validation():

@@ -317,3 +317,53 @@ def test_factored_mesh_matches_single_device(rng):
         np.asarray(local.score(m_local)),
         rtol=5e-3, atol=5e-3,
     )
+
+
+def test_factored_coordinate_emits_tracker(rng):
+    """The factored coordinate records per-MF-iteration telemetry pairs
+    (FactoredRandomEffectOptimizationProblem tracker analog)."""
+    from photon_ml_tpu.optim.trackers import (
+        FactoredRandomEffectOptimizationTracker,
+    )
+
+    from photon_ml_tpu.game import build_random_effect_dataset
+    from photon_ml_tpu.game.factored import FactoredRandomEffectCoordinate
+
+    gds, *_ = _low_rank_re_data(rng, n_users=12, rows_per_user=15, d=10)
+    red = build_random_effect_dataset(gds, "userId", "feats")
+    coord = FactoredRandomEffectCoordinate(
+        name="mf",
+        data=gds,
+        re_data=red,
+        loss_name="squared",
+        re_config=_opt(lam=0.1, iters=30),
+        latent_config=_opt(lam=0.1, iters=30),
+        latent_dim=2,
+        mf_iterations=2,
+    )
+    coord.update_model(coord.initialize_model(), None)
+    t = coord.last_tracker
+    assert isinstance(t, FactoredRandomEffectOptimizationTracker)
+    assert len(t.steps) == 2
+    for re_t, fe_t in t.steps:
+        assert len(re_t.iterations) > 0
+        assert re_t.final_values is not None
+        assert fe_t is not None and fe_t.iterations >= 1
+    s = t.to_summary_string()
+    assert "MF iteration 1" in s and "latent matrix" in s
+
+
+def test_re_tracker_percentile_summary(rng):
+    from photon_ml_tpu.optim.trackers import RandomEffectOptimizationTracker
+
+    t = RandomEffectOptimizationTracker(
+        iterations=np.arange(1, 101, dtype=np.int32),
+        reasons=np.full(100, 3, np.int32),
+        final_values=np.linspace(0.1, 1.0, 100).astype(np.float32),
+    )
+    p = t.percentile_summary()
+    assert p["iterations"]["p50"] == pytest.approx(50.5)
+    assert p["final_loss"]["p95"] == pytest.approx(
+        float(np.percentile(np.linspace(0.1, 1.0, 100), 95)), rel=1e-5
+    )
+    assert "p95" in t.to_summary_string() or "final_loss" in t.to_summary_string()
