@@ -138,6 +138,13 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
             validation_data, _ = read_input(vspec, index_maps=index_maps)
 
     estimator = GameEstimator(game_config)
+    if config.get("event_listeners"):
+        # dotted-path listener specs, import-registered at driver startup
+        # (the --event-listeners class loading of Driver.scala:110-118)
+        from photon_ml_tpu.utils.events import load_listeners
+
+        for listener in load_listeners(config["event_listeners"]):
+            estimator.events.register(listener)
     with timed("fit"):
         result = estimator.fit(
             train_data,

@@ -19,7 +19,6 @@ training time scores 0 for those entities (RandomEffectModel semantics).
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import os
 from typing import Optional

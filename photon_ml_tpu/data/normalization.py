@@ -14,7 +14,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from photon_ml_tpu.data.stats import FeatureSummary
 

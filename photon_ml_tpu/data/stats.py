@@ -15,7 +15,6 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from photon_ml_tpu.ops.sparse import SparseBatch
 

@@ -9,7 +9,6 @@ VALIDATE_DISABLED. Checks run host-side on the COO arrays before upload.
 from __future__ import annotations
 
 from enum import Enum
-from typing import Optional
 
 import numpy as np
 
@@ -49,14 +48,26 @@ def validate(
     values = np.asarray(batch.values)
     valid_rows = weights > 0  # padded rows excluded
 
-    mask = _sample_mask(len(labels), mode, rng) & valid_rows
+    # uniform sampling contract: under SAMPLE every scan (rows AND nnz) is
+    # subsampled; under FULL every scan is complete — and zero-copy (an
+    # all-True fancy index would duplicate the nnz-sized values array, the
+    # largest array in the batch). Weights are sampled by the row mask
+    # alone — a NaN weight fails the >0 test, so filtering by valid_rows
+    # would hide it from its own finiteness check.
+    sampling = mode == ValidationMode.SAMPLE
+    row_mask = _sample_mask(len(labels), mode, rng)
+    mask = row_mask & valid_rows
+    vals = values[_sample_mask(len(values), mode, rng)] if sampling else values
+    samp = lambda arr: arr[row_mask] if sampling else arr  # noqa: E731
 
-    if not np.all(np.isfinite(values)):
+    if not np.all(np.isfinite(vals)):
         raise DataValidationError("non-finite feature values")
-    for name, arr in (("labels", labels), ("offsets", offsets), ("weights", weights)):
-        if not np.all(np.isfinite(arr[mask] if name != "weights" else arr)):
+    for name, arr in (("labels", labels), ("offsets", offsets)):
+        if not np.all(np.isfinite(arr[mask] if sampling else arr[valid_rows])):
             raise DataValidationError(f"non-finite {name}")
-    if np.any(weights < 0):
+    if not np.all(np.isfinite(samp(weights))):
+        raise DataValidationError("non-finite weights")
+    if np.any(samp(weights) < 0):
         raise DataValidationError("negative weights")
 
     task_l = task.lower()

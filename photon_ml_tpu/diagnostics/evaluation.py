@@ -12,11 +12,9 @@ the PR/ROC curves are one sort + cumulative sums.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from photon_ml_tpu.evaluation.evaluators import auc as _auc
 from photon_ml_tpu.models.glm import GeneralizedLinearModel

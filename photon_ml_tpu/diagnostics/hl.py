@@ -11,7 +11,6 @@ bins, Fixed... = equal-width bins).
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
 
 import numpy as np
 from scipy.stats import chi2 as _chi2

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import html as _html
-from typing import Optional, Sequence, Union
+from typing import Sequence, Union
 
 Item = Union["Section", "Text", "BulletedList", "NumberedList", "Table", "LinePlot"]
 

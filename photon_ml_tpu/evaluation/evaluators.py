@@ -14,7 +14,7 @@ groups are segment-sums over a group-id array.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

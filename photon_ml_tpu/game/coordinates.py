@@ -33,7 +33,7 @@ from photon_ml_tpu.game.models import (
     RandomEffectModel,
 )
 from photon_ml_tpu.data.normalization import NormalizationContext
-from photon_ml_tpu.game.random_effect_data import EntityBucket, RandomEffectDataset
+from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
 from photon_ml_tpu.ops.objective import make_objective
 from photon_ml_tpu.ops.sparse import SparseBatch
 from photon_ml_tpu.ops.tiled import ROWS_PER_TILE, TiledBatch
@@ -85,7 +85,7 @@ class FixedEffectCoordinate:
     loss_name: str
     config: OptimizerConfig
     seed: int = 0
-    normalization: Optional["NormalizationContext"] = None
+    normalization: Optional[NormalizationContext] = None
     mesh: Optional[Mesh] = None  # 1-D data-axis mesh -> distributed_solve
     layout: str = "auto"  # "auto" | "tiled" | "coo" training layout
 

@@ -18,7 +18,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.game.dataset import GameDataset
-from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
 from photon_ml_tpu.ops.losses import get_loss
 
 Array = jax.Array

@@ -12,7 +12,6 @@ purpose, OWLQN.scala:56-63).
 
 from __future__ import annotations
 
-import dataclasses
 from typing import NamedTuple, Optional
 
 import jax

@@ -29,7 +29,6 @@ import jax.numpy as jnp
 from jax import lax
 
 from photon_ml_tpu.optim.common import (
-    MAX_ITERATIONS,
     NOT_CONVERGED,
     OBJECTIVE_NOT_IMPROVING,
     BoxConstraints,

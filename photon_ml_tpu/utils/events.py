@@ -51,6 +51,39 @@ class OptimizationLogEvent(Event):
     metrics: Optional[Mapping[str, float]] = None
 
 
+def load_listener(spec: str) -> Callable[[Event], None]:
+    """Import one listener from a dotted-path spec — the --event-listeners
+    class-name loading of the reference driver (Driver.scala:110-118).
+
+    ``"pkg.mod:name"`` (or ``"pkg.mod.name"``) must resolve to either a
+    callable taking one event, or a zero-arg class whose INSTANCE is the
+    listener (classes are instantiated, matching the reference's
+    newInstance())."""
+    import importlib
+    import inspect
+
+    if ":" in spec:
+        mod_name, attr = spec.split(":", 1)
+    else:
+        mod_name, _, attr = spec.rpartition(".")
+    if not mod_name or not attr:
+        raise ValueError(f"listener spec '{spec}' is not a dotted path")
+    try:
+        target = getattr(importlib.import_module(mod_name), attr)
+    except (ImportError, AttributeError) as e:
+        raise ValueError(f"cannot load event listener '{spec}': {e}") from e
+    if inspect.isclass(target):
+        target = target()
+    if not callable(target):
+        raise ValueError(f"event listener '{spec}' is not callable")
+    return target
+
+
+def load_listeners(specs) -> list[Callable[[Event], None]]:
+    """Import every listener named by ``specs`` (sequence of dotted paths)."""
+    return [load_listener(s) for s in specs]
+
+
 class EventEmitter:
     """register/send/clear listener registry (EventEmitter.scala analog).
 

@@ -6,7 +6,6 @@ import numpy as np
 import pytest
 
 from photon_ml_tpu.data.avro import (
-    BAYESIAN_LINEAR_MODEL_AVRO,
     TRAINING_EXAMPLE_AVRO,
     build_index_map_from_avro,
     read_avro,

@@ -259,3 +259,56 @@ def test_dsl_alpha_only_for_elastic_net():
 
     with pytest.raises(ValueError, match="elastic_net"):
         parse_optimizer_config("50,1e-6,0.3,0.8,LBFGS,L2,0.5")
+
+
+def test_load_listener_specs():
+    from photon_ml_tpu.utils.events import load_listener, load_listeners
+
+    fn = load_listener("photon_ml_tpu.utils.events:load_listeners")
+    assert callable(fn)
+    fn2 = load_listener("photon_ml_tpu.utils.events.load_listeners")
+    assert callable(fn2)
+    with pytest.raises(ValueError, match="dotted path"):
+        load_listener("nodots")
+    with pytest.raises(ValueError, match="cannot load"):
+        load_listener("photon_ml_tpu.utils.events:NoSuchThing")
+    with pytest.raises(ValueError, match="cannot load"):
+        load_listener("no.such.module:thing")
+    assert len(load_listeners([])) == 0
+
+
+def test_cli_train_config_driven_event_listener(avro_dataset):
+    """--event-listeners analog: dotted-path listener specs in the train
+    config are import-registered at driver startup (Driver.scala:110-118)."""
+    tmp, train_path, _ = avro_dataset
+    (tmp / "my_listeners.py").write_text(
+        "class Recorder:\n"
+        "    def __call__(self, event):\n"
+        "        with open('events.log', 'a') as f:\n"
+        "            f.write(type(event).__name__ + '\\n')\n"
+    )
+    config = {
+        "task": "logistic",
+        "input": {
+            "format": "avro",
+            "paths": [train_path],
+            "feature_shards": {"global": ["features"]},
+            "id_columns": ["userId"],
+        },
+        "coordinates": {
+            "fixed": {
+                "type": "fixed_effect",
+                "shard_name": "global",
+                "optimizer": {"max_iterations": 5},
+            },
+        },
+        "event_listeners": ["my_listeners:Recorder"],
+    }
+    cfg_path = tmp / "train_listener.json"
+    cfg_path.write_text(json.dumps(config))
+    _run_cli(["train", "--config", str(cfg_path)], cwd=tmp)
+    log = (tmp / "events.log").read_text().splitlines()
+    assert "SetupEvent" in log
+    assert "TrainingStartEvent" in log
+    assert "OptimizationLogEvent" in log
+    assert "TrainingFinishEvent" in log

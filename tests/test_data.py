@@ -1,7 +1,6 @@
 """Data-plane tests: stats vs numpy, normalization contexts + model
 back-transform, index maps (incl. mmap store), libsvm reader, validators."""
 
-import os
 
 import jax.numpy as jnp
 import numpy as np

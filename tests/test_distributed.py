@@ -13,8 +13,6 @@ from photon_ml_tpu.optim import (
     OptimizerType,
     RegularizationContext,
     RegularizationType,
-    glm_adapter,
-    lbfgs_solve,
     solve,
 )
 from photon_ml_tpu.parallel import (

@@ -6,7 +6,6 @@ Parity targets: GameEstimator.scala:76-398 (fit flow), NormalizationTest
 """
 
 import numpy as np
-import jax.numpy as jnp
 
 from photon_ml_tpu.data.model_store import load_game_model, load_game_model_metadata
 from photon_ml_tpu.data.normalization import NormalizationType

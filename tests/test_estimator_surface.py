@@ -19,7 +19,6 @@ from photon_ml_tpu.optim import (
 )
 from photon_ml_tpu.utils.events import (
     OptimizationLogEvent,
-    SetupEvent,
     TrainingFinishEvent,
     TrainingStartEvent,
 )

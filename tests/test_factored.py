@@ -9,7 +9,6 @@ import numpy as np
 import pytest
 
 from photon_ml_tpu.data.projection import build_gaussian_projection_matrix
-from photon_ml_tpu.evaluation import rmse
 from photon_ml_tpu.game import (
     FactoredRandomEffectConfig,
     FixedEffectConfig,

@@ -7,7 +7,6 @@ import pytest
 
 from photon_ml_tpu.game import (
     FixedEffectCoordinate,
-    GameModel,
     RandomEffectCoordinate,
     ValidationSpec,
     build_game_dataset,

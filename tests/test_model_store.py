@@ -5,7 +5,6 @@ Reference parity: ModelProcessingUtils.scala:72 (save), :137 (load), :516
 fresh-process test proves nothing is captured in interpreter state.
 """
 
-import json
 import os
 import subprocess
 import sys

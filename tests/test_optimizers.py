@@ -19,7 +19,6 @@ from photon_ml_tpu.optim import (
     OptimizerType,
     RegularizationContext,
     RegularizationType,
-    TRONConfig,
     from_value_and_grad,
     glm_adapter,
     lbfgs_solve,

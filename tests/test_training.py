@@ -14,7 +14,6 @@ from photon_ml_tpu.data.stats import summarize
 from photon_ml_tpu.ops.sparse import SparseBatch
 from photon_ml_tpu.optim import (
     OptimizerConfig,
-    OptimizerType,
     RegularizationContext,
     RegularizationType,
     solve,

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Static-analysis gate: `python tools/check.py`.
+
+Reference analog: the scalastyle + Apache RAT gates of the reference build
+(scalastyle-config.xml, build-scripts/rat.gradle) — a zero-setup check that
+every source file parses and passes lint before code lands.
+
+Runs, in order:
+  1. syntax: compile every .py under photon_ml_tpu/ tests/ tools/ (py_compile)
+  2. stdlib AST lint (dependency-free, so the gate works in hermetic
+     images with no linters installed):
+       - unused imports (module scope)
+       - bare `except:` clauses
+       - mutable default arguments (list/dict/set literals)
+       - `== None` / `!= None` comparisons
+       - f-strings with no placeholders
+  3. ruff + mypy, IF installed (configs live in pyproject.toml)
+
+Exit code 0 = clean. Any finding prints `path:line: code message` and the
+run exits 1.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGETS = ("photon_ml_tpu", "tests", "tools", "bench.py", "bench_game.py",
+           "bench_suite.py", "__graft_entry__.py")
+
+
+def source_files() -> list[str]:
+    out = []
+    for t in TARGETS:
+        path = os.path.join(REPO, t)
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for root, _dirs, files in os.walk(path):
+            out.extend(
+                os.path.join(root, f) for f in files if f.endswith(".py")
+            )
+    return sorted(out)
+
+
+def check_syntax(files: list[str]) -> list[str]:
+    errs = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            try:
+                compile(fh.read(), f, "exec")
+            except SyntaxError as e:
+                errs.append(f"{f}:{e.lineno}: SYNTAX {e.msg}")
+    return errs
+
+
+class _Lint(ast.NodeVisitor):
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.findings: list[str] = []
+        self.imported: dict[str, int] = {}  # name -> lineno (module scope)
+        self.used: set[str] = set()
+        self._collect(tree)
+
+    def _report(self, node: ast.AST, code: str, msg: str) -> None:
+        self.findings.append(f"{self.path}:{node.lineno}: {code} {msg}")
+
+    def _collect(self, tree: ast.Module) -> None:
+        for node in tree.body:  # module scope only: re-export surfaces stay
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    self.imported[name] = node.lineno
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__" or any(
+                    a.name == "*" for a in node.names
+                ):
+                    continue
+                for a in node.names:
+                    self.imported[a.asname or a.name] = node.lineno
+        self.visit(tree)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        root = node
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name):
+            self.used.add(root.id)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._report(node, "L002", "bare `except:` (catch something)")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self._report(
+                    d, "L003", "mutable default argument (use None sentinel)"
+                )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)) and (
+                isinstance(comp, ast.Constant) and comp.value is None
+            ):
+                self._report(node, "L004", "use `is None` / `is not None`")
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self._report(node, "L005", "f-string without placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
+        # format specs parse as nested JoinedStrs of constants (e.g. ':.3g');
+        # visiting them would false-positive L005 on every formatted field
+        self.visit(node.value)
+
+    def unused_imports(self, tree: ast.Module) -> None:
+        exported = set()
+        for node in tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.List, ast.Tuple))
+            ):
+                exported |= {
+                    e.value
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                }
+        for name, lineno in sorted(self.imported.items(), key=lambda kv: kv[1]):
+            if name not in self.used and name not in exported:
+                self.findings.append(
+                    f"{self.path}:{lineno}: L001 unused import `{name}`"
+                )
+
+
+def check_lint(files: list[str]) -> list[str]:
+    findings = []
+    for f in files:
+        if os.path.basename(f) == "__init__.py":
+            continue  # re-export surfaces import without using
+        with open(f, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=f)
+            except SyntaxError:
+                continue  # reported by the syntax phase
+        lint = _Lint(os.path.relpath(f, REPO), tree)
+        lint.unused_imports(tree)
+        findings.extend(lint.findings)
+    return findings
+
+
+def run_external() -> list[str]:
+    errs = []
+    for tool, args in (
+        ("ruff", ["check", "photon_ml_tpu", "tests", "tools"]),
+        ("mypy", ["photon_ml_tpu"]),
+    ):
+        exe = shutil.which(tool)
+        if exe is None:
+            print(f"  - {tool}: not installed, skipped (stdlib gate still ran)")
+            continue
+        proc = subprocess.run(
+            [exe, *args], cwd=REPO, capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            errs.append(f"{tool} failed:\n{proc.stdout}\n{proc.stderr}")
+        else:
+            print(f"  - {tool}: clean")
+    return errs
+
+
+def main() -> int:
+    files = source_files()
+    print(f"checking {len(files)} files")
+    findings = check_syntax(files)
+    findings += check_lint(files)
+    print("external tools:")
+    findings += run_external()
+    if findings:
+        print("\n".join(findings))
+        print(f"\n{len(findings)} finding(s)")
+        return 1
+    print("clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
