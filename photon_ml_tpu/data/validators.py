@@ -31,6 +31,14 @@ def _sample_mask(n: int, mode: ValidationMode, rng: np.random.Generator):
     return np.ones(n, dtype=bool)
 
 
+def _sample_indices(n: int, rng: np.random.Generator) -> np.ndarray:
+    """O(k)-memory subsample of [0, n) for SAMPLE-mode scans of the
+    nnz-sized values array (a full random(n) temp would be 2x the array
+    this mode exists to avoid copying)."""
+    k = max(10, min(n, int(n * max(0.01, min(1.0, 1000.0 / max(n, 1))))))
+    return rng.integers(0, max(n, 1), size=k)
+
+
 def validate(
     batch: SparseBatch,
     task: str,
@@ -57,7 +65,7 @@ def validate(
     sampling = mode == ValidationMode.SAMPLE
     row_mask = _sample_mask(len(labels), mode, rng)
     mask = row_mask & valid_rows
-    vals = values[_sample_mask(len(values), mode, rng)] if sampling else values
+    vals = values[_sample_indices(len(values), rng)] if sampling else values
     samp = lambda arr: arr[row_mask] if sampling else arr  # noqa: E731
 
     if not np.all(np.isfinite(vals)):

@@ -10,20 +10,28 @@ instead by removing ALL random access:
     where ``col = col_hi * 128 + col_lo`` and ``row_local = row % 128``.
   - The coefficient vector lives as a [B, 128] grid (B = ceil(F/128)).
   - Gathering w[col] per slot = one-hot(col_hi) @ w2, then a masked
-    lane-reduction over one-hot(col_lo): two MXU matmuls + VPU ops.
+    product with one-hot(col_lo) reduced BY MATVEC against a ones vector.
   - Scattering per-slot contributions into feature space = the transposed
-    one-hot matmul. Per-row sums/broadcasts use the row_local one-hot on
-    the VPU only (R == one lane-width, so no row matmuls at all).
+    one-hot matmul into a [128, B] accumulator (the [S, B] mask side is
+    the smaller elementwise operand).
+  - EVERY reduction and row broadcast rides the MXU: these kernels are
+    VPU-bound (mask construction + elementwise chains saturate the vector
+    unit while the MXU idles at ~3% — PERF_NOTES.md roofline), so lane
+    shuffle-reduces and [S, 128] row-mask broadcasts are replaced by
+    matmuls against the TRANSPOSED row one-hot mask_rT [R, S]. Measured:
+    margins 75 -> 39 ms, fused value+grad 91 -> 62 ms (v5e, config below).
   - f32 exactness comes from bf16x2 splits (x = hi + lo in bfloat16,
     products against 0/1 masks are exact, MXU accumulates in f32). The
     split MUST happen inside the kernel: XLA's
     ``--xla_allow_excess_precision`` folds ``bf16(x - f32(bf16(x)))`` to
     zero, silently degrading the pass to single-bf16 (measured 2e-3
-    gradient error; in-kernel split measures ~5e-6).
+    gradient error; in-kernel split measures ~5e-6). Mosaic's
+    precision=HIGHEST f32 matmul measures 5e-3 — not a substitute.
 
 Measured on TPU v5e (1M rows x 10K features, 20 nnz/row): one fused
-value+grad pass ~110 ms vs ~650 ms for the XLA gather/scatter path (~6x);
-the margins-pair kernel makes an LBFGS iteration ~2 passes total.
+value+grad pass ~62 ms vs ~650 ms for the XLA gather/scatter path (~10x);
+the margin-carrying LBFGS iteration is one dot_rows (~39 ms) plus one
+scatter pass.
 
 This replaces the hot loop the reference distributes over a Spark cluster
 (ValueAndGradientAggregator.scala:132-153) with on-chip matmuls.
@@ -86,34 +94,72 @@ def _mmT2(a, bh, bl):
         preferred_element_type=jnp.float32)
 
 
-def _row_margins(vals, mask_r, w_ref, mask_hi, mask_lo):
+def _slot_contrib(vals, w_ref, mask_hi, mask_lo):
+    """Per-slot vals_s * w[col_s] as an [S, 1] f32 column.
+
+    All reductions ride the MXU: the lane pick + sum is a masked-product
+    matvec against a ones vector instead of a 128-lane shuffle reduce
+    (measured ~30% kernel time on v5e; the VPU is this kernel family's
+    critically saturated unit — see PERF_NOTES roofline)."""
+    w = w_ref[:]
+    whi, wlo = _split_bf16(w)
+    wrow = _mm2(mask_hi, whi, wlo)                    # [S, 128] f32
+    e = (wrow * mask_lo) * vals[:, None]              # one lane nonzero
+    eh, el = _split_bf16(e)
+    ones = jnp.ones((LANE, 1), jnp.bfloat16)
+    g = jax.lax.dot_general(
+        eh, ones, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return g + jax.lax.dot_general(
+        el, ones, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [S, 1]
+
+
+def _rowsum_mxu(contrib_col, mask_rT):
+    """[S, 1] per-slot contributions -> [1, R] per-row sums via the
+    TRANSPOSED row one-hot ON THE MXU ([R,S] @ [S,1], bf16x2 exact).
+    Both row ops use mask_rT so Mosaic sees only (1,0)-contractions."""
+    ch, cl = _split_bf16(contrib_col)
+    return _mm2(mask_rT, ch, cl).reshape(1, -1)       # [R, 1] -> [1, R]
+
+
+def _row_margins(vals, mask_rT, w_ref, mask_hi, mask_lo):
     """Per-row margin sums [1, R] for one tile (shared kernel body)."""
-    contrib = vals * _gather_w(w_ref, mask_hi, mask_lo)
-    return jnp.sum(contrib[:, None] * mask_r, axis=0, keepdims=True)
+    return _rowsum_mxu(_slot_contrib(vals, w_ref, mask_hi, mask_lo), mask_rT)
+
+
+def _slots_of_rows(per_row, mask_rT):
+    """Broadcast a [1, R] per-row vector to slots ([S, 1]) via the
+    transposed row one-hot matvec (exact: per_row splits bf16x2)."""
+    ph, plo = _split_bf16(per_row)
+    s_row = jax.lax.dot_general(
+        ph, mask_rT, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    s_row = s_row + jax.lax.dot_general(
+        plo, mask_rT, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [1, S]
+    return s_row.reshape(-1, 1)
 
 
 def _scatter_accum(out_ref, per_slot, mask_hi, mask_lo):
-    """Accumulate sum_s per_slot[s]*onehot(col_s) into out_ref (bf16x2 exact)."""
-    tmp = per_slot[:, None] * mask_lo
+    """Accumulate sum_s per_slot[s]*onehot(col_s) into the TRANSPOSED
+    [LANE, B] accumulator: tmp = per_slot ⊙ mask_hi is [S, B] (the smaller
+    mask side), then mask_lo^T @ tmp on the MXU (bf16x2 exact)."""
+    tmp = per_slot * mask_hi                          # [S, B]
     th, tl = _split_bf16(tmp)
-    out_ref[:] = out_ref[:] + _mmT2(mask_hi, th, tl)
+    out_ref[:] = out_ref[:] + _mmT2(mask_lo, th, tl)  # [LANE, B]
 
 
 def _masks(hi_ref, lo_ref, rlo_ref, S: int, B: int):
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (S, B), 1)
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (S, LANE), 1)
+    iota_r = jax.lax.broadcasted_iota(jnp.int32, (LANE, S), 0)
     mask_hi = (hi_ref[0, 0, :][:, None] == iota_b).astype(jnp.bfloat16)
     mask_lo = (lo_ref[0, 0, :][:, None] == iota_l).astype(jnp.bfloat16)
-    mask_r = (rlo_ref[0, 0, :][:, None] == iota_l).astype(jnp.bfloat16)
-    return mask_hi, mask_lo, mask_r
-
-
-def _gather_w(w_ref, mask_hi, mask_lo):
-    """Per-slot w[col] via one-hot matmul + masked lane reduction (exact)."""
-    w = w_ref[:]
-    whi, wlo = _split_bf16(w)
-    wrow = _mm2(mask_hi, whi, wlo)                    # [S, 128] f32
-    return jnp.sum(wrow * mask_lo, axis=1)            # [S]
+    # row one-hot in TRANSPOSED [R, S] orientation: every use is then a
+    # standard (1,0) MXU contraction (Mosaic rejects dim-1 contractions)
+    mask_rT = (rlo_ref[0, 0, :][None, :] == iota_r).astype(jnp.bfloat16)
+    return mask_hi, mask_lo, mask_rT
 
 
 # ---------------------------------------------------------------------------
@@ -137,16 +183,16 @@ def _margins_kernel(use_offsets: bool, pair: bool,
          shift_ref, out_z_ref) = refs
     S = vals_ref.shape[2]
     B = w_ref.shape[0]
-    mask_hi, mask_lo, mask_r = _masks(hi_ref, lo_ref, rlo_ref, S, B)
+    mask_hi, mask_lo, mask_rT = _masks(hi_ref, lo_ref, rlo_ref, S, B)
     vals = vals_ref[0, 0, :]
 
-    z = _row_margins(vals, mask_r, w_ref, mask_hi, mask_lo) + shift_ref[0, 0]
+    z = _row_margins(vals, mask_rT, w_ref, mask_hi, mask_lo) + shift_ref[0, 0]
     if use_offsets:
         z = z + off_ref[0, :, :]
     out_z_ref[0, :, :] = z
 
     if pair:
-        u = _row_margins(vals, mask_r, v_ref, mask_hi, mask_lo)
+        u = _row_margins(vals, mask_rT, v_ref, mask_hi, mask_lo)
         out_u_ref[0, :, :] = u + shift_ref[0, 1]
 
 
@@ -160,14 +206,13 @@ def _scatter_kernel(square: bool, *refs):
         out_g_ref[:] = jnp.zeros_like(out_g_ref)
 
     S = vals_ref.shape[2]
-    B = out_g_ref.shape[0]
-    mask_hi, mask_lo, mask_r = _masks(hi_ref, lo_ref, rlo_ref, S, B)
+    B = out_g_ref.shape[1]
+    mask_hi, mask_lo, mask_rT = _masks(hi_ref, lo_ref, rlo_ref, S, B)
     vals = vals_ref[0, 0, :]
     if square:
         vals = vals * vals
 
-    per_row = pr_ref[0, :, :]                          # [1, R]
-    per_slot = jnp.sum(per_row * mask_r, axis=1) * vals  # [S]
+    per_slot = _slots_of_rows(pr_ref[0, :, :], mask_rT) * vals[:, None]
     _scatter_accum(out_g_ref, per_slot, mask_hi, mask_lo)
 
 
@@ -184,10 +229,10 @@ def _value_grad_kernel(loss_name: str, use_offsets: bool, *refs):
 
     S = vals_ref.shape[2]
     B = w_ref.shape[0]
-    mask_hi, mask_lo, mask_r = _masks(hi_ref, lo_ref, rlo_ref, S, B)
+    mask_hi, mask_lo, mask_rT = _masks(hi_ref, lo_ref, rlo_ref, S, B)
     vals = vals_ref[0, 0, :]
 
-    z = _row_margins(vals, mask_r, w_ref, mask_hi, mask_lo) + shift_ref[0, 0]
+    z = _row_margins(vals, mask_rT, w_ref, mask_hi, mask_lo) + shift_ref[0, 0]
     if use_offsets:
         z = z + off_ref[0, :, :]
 
@@ -199,7 +244,7 @@ def _value_grad_kernel(loss_name: str, use_offsets: bool, *refs):
     sums = jnp.stack([jnp.sum(wgt * l), jnp.sum(g_row)]).reshape(1, 2)
     out_s_ref[:] = out_s_ref[:] + sums
 
-    per_slot = jnp.sum(g_row * mask_r, axis=1) * vals
+    per_slot = _slots_of_rows(g_row, mask_rT) * vals[:, None]
     _scatter_accum(out_g_ref, per_slot, mask_hi, mask_lo)
 
 
@@ -219,20 +264,20 @@ def _hv_kernel(loss_name: str, use_offsets: bool, *refs):
 
     S = vals_ref.shape[2]
     B = w_ref.shape[0]
-    mask_hi, mask_lo, mask_r = _masks(hi_ref, lo_ref, rlo_ref, S, B)
+    mask_hi, mask_lo, mask_rT = _masks(hi_ref, lo_ref, rlo_ref, S, B)
     vals = vals_ref[0, 0, :]
 
-    z = _row_margins(vals, mask_r, w_ref, mask_hi, mask_lo) + shift_ref[0, 0]
+    z = _row_margins(vals, mask_rT, w_ref, mask_hi, mask_lo) + shift_ref[0, 0]
     if use_offsets:
         z = z + off_ref[0, :, :]
-    u = _row_margins(vals, mask_r, v_ref, mask_hi, mask_lo) + shift_ref[0, 1]
+    u = _row_margins(vals, mask_rT, v_ref, mask_hi, mask_lo) + shift_ref[0, 1]
 
     loss = get_loss(loss_name)
     q_row = wgt_ref[0, :, :] * loss.d2z(z, lab_ref[0, :, :]) * u   # [1, R]
     out_s_ref[:] = out_s_ref[:] + jnp.stack(
         [jnp.sum(q_row), jnp.float32(0.0)]).reshape(1, 2)
 
-    per_slot = jnp.sum(q_row * mask_r, axis=1) * vals
+    per_slot = _slots_of_rows(q_row, mask_rT) * vals[:, None]
     _scatter_accum(out_g_ref, per_slot, mask_hi, mask_lo)
 
 
@@ -253,15 +298,15 @@ def _hv_at_kernel(*refs):
 
     S = vals_ref.shape[2]
     B = v_ref.shape[0]
-    mask_hi, mask_lo, mask_r = _masks(hi_ref, lo_ref, rlo_ref, S, B)
+    mask_hi, mask_lo, mask_rT = _masks(hi_ref, lo_ref, rlo_ref, S, B)
     vals = vals_ref[0, 0, :]
 
-    u = _row_margins(vals, mask_r, v_ref, mask_hi, mask_lo) + shift_ref[0, 0]
+    u = _row_margins(vals, mask_rT, v_ref, mask_hi, mask_lo) + shift_ref[0, 0]
     q_row = d2_ref[0, :, :] * u  # [1, R]
     out_s_ref[:] = out_s_ref[:] + jnp.stack(
         [jnp.sum(q_row), jnp.float32(0.0)]).reshape(1, 2)
 
-    per_slot = jnp.sum(q_row * mask_r, axis=1) * vals
+    per_slot = _slots_of_rows(q_row, mask_rT) * vals[:, None]
     _scatter_accum(out_g_ref, per_slot, mask_hi, mask_lo)
 
 
@@ -309,8 +354,8 @@ def _scatter_call(T, S, B, square, interpret):
         kern,
         grid=(T,),
         in_specs=[_spec_s(S)] * 4 + [_spec_r()],
-        out_specs=_spec_acc((B, LANE)),
-        out_shape=jax.ShapeDtypeStruct((B, LANE), jnp.float32),
+        out_specs=_spec_acc((LANE, B)),
+        out_shape=jax.ShapeDtypeStruct((LANE, B), jnp.float32),
         interpret=interpret,
     )
 
@@ -323,10 +368,10 @@ def _hv_call(T, S, B, loss_name, use_offsets, interpret):
         grid=(T,),
         in_specs=[_spec_s(S)] * 4 + [_spec_r()] * 3 + [_spec_w(B)] * 2
         + [pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM)],
-        out_specs=[_spec_acc((1, 2)), _spec_acc((B, LANE))],
+        out_specs=[_spec_acc((1, 2)), _spec_acc((LANE, B))],
         out_shape=[
             jax.ShapeDtypeStruct((1, 2), jnp.float32),
-            jax.ShapeDtypeStruct((B, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((LANE, B), jnp.float32),
         ],
         interpret=interpret,
     )
@@ -339,10 +384,10 @@ def _hv_at_call(T, S, B, interpret):
         grid=(T,),
         in_specs=[_spec_s(S)] * 4 + [_spec_r()] + [_spec_w(B)]
         + [pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM)],
-        out_specs=[_spec_acc((1, 2)), _spec_acc((B, LANE))],
+        out_specs=[_spec_acc((1, 2)), _spec_acc((LANE, B))],
         out_shape=[
             jax.ShapeDtypeStruct((1, 2), jnp.float32),
-            jax.ShapeDtypeStruct((B, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((LANE, B), jnp.float32),
         ],
         interpret=interpret,
     )
@@ -356,10 +401,10 @@ def _value_grad_call(T, S, B, loss_name, use_offsets, interpret):
         grid=(T,),
         in_specs=[_spec_s(S)] * 4 + [_spec_r()] * 3 + [_spec_w(B)]
         + [pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM)],
-        out_specs=[_spec_acc((1, 2)), _spec_acc((B, LANE))],
+        out_specs=[_spec_acc((1, 2)), _spec_acc((LANE, B))],
         out_shape=[
             jax.ShapeDtypeStruct((1, 2), jnp.float32),
-            jax.ShapeDtypeStruct((B, LANE), jnp.float32),
+            jax.ShapeDtypeStruct((LANE, B), jnp.float32),
         ],
         interpret=interpret,
     )
@@ -575,7 +620,8 @@ class TiledBatch:
         call = _scatter_call(T, S, self.num_blocks, square, _interpret())
         pr3 = per_row.astype(jnp.float32).reshape(T, 1, ROWS_PER_TILE)
         g = call(*self._slot_args(), pr3)
-        return g.reshape(-1)[: self.num_features]
+        # accumulator is [LANE, B]; feature f = b*128 + j lives at [j, b]
+        return g.T.reshape(-1)[: self.num_features]
 
     def scatter_features(self, per_row: Array) -> Array:
         """sum_i per_row[i] * x_i as a dense feature-space vector."""
@@ -600,7 +646,7 @@ class TiledBatch:
         sh = jnp.stack([jnp.asarray(shift, jnp.float32), jnp.float32(0)])
         sums, g = call(*self._slot_args(), self.labels3, self.weights3,
                        self.offsets3, self._w2(w), sh.reshape(1, 2))
-        return sums[0, 0], g.reshape(-1)[: self.num_features], sums[0, 1]
+        return sums[0, 0], g.T.reshape(-1)[: self.num_features], sums[0, 1]
 
     def fused_hessian_vector(
         self, w: Array, shift, v: Array, v_shift, loss_name: str
@@ -616,7 +662,7 @@ class TiledBatch:
         sums, g = call(*self._slot_args(), self.labels3, self.weights3,
                        self.offsets3, self._w2(w), self._w2(v),
                        sh.reshape(1, 2))
-        return g.reshape(-1)[: self.num_features], sums[0, 0]
+        return g.T.reshape(-1)[: self.num_features], sums[0, 0]
 
     def fused_hv_at(
         self, d2_row: Array, v_eff: Array, v_shift
@@ -630,7 +676,7 @@ class TiledBatch:
         sh = jnp.stack([jnp.asarray(v_shift, jnp.float32), jnp.float32(0)])
         sums, g = call(*self._slot_args(), d2_3, self._w2(v_eff),
                        sh.reshape(1, 2))
-        return g.reshape(-1)[: self.num_features], sums[0, 0]
+        return g.T.reshape(-1)[: self.num_features], sums[0, 0]
 
     def feature_moment_sums(self) -> tuple[Array, Array, Array]:
         """Per-feature (sum x, sum x^2, count nonzero) over valid rows."""

