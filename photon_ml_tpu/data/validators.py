@@ -35,8 +35,10 @@ def _sample_indices(n: int, rng: np.random.Generator) -> np.ndarray:
     """O(k)-memory subsample of [0, n) for SAMPLE-mode scans of the
     nnz-sized values array (a full random(n) temp would be 2x the array
     this mode exists to avoid copying)."""
-    k = max(10, min(n, int(n * max(0.01, min(1.0, 1000.0 / max(n, 1))))))
-    return rng.integers(0, max(n, 1), size=k)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    k = max(10, min(n, int(n * max(0.01, min(1.0, 1000.0 / n)))))
+    return rng.integers(0, n, size=min(k, n))
 
 
 def validate(

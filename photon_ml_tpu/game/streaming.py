@@ -1,0 +1,258 @@
+"""Billion-coefficient random-effect training: resident sharded coefficient
+tables + streamed entity chunks.
+
+The reference's defining scale claim is "hundreds of billions of
+coefficients" across per-entity models (/root/reference/README.md:73;
+projection envelope ~1e8 entities x ~1e3 features/entity,
+photon-ml projector/README.md:8-12), held as RDD partitions across a Spark
+cluster. The TPU-native answer:
+
+  - The COEFFICIENT TABLE [N, K] is HBM-resident for the whole fit (4 GB
+    per 1e9 f32 coefficients — one v5e chip holds ~2-3e9 alongside its
+    working set; a mesh shards the entity axis so capacity scales linearly
+    with devices, the multi-host path to 1e11).
+  - The TRAINING DATA does not fit (a dense [N, R, K] stack is R*4 bytes
+    per coefficient) and never has to: per-entity problems are
+    independent, so entities stream through in CHUNKS. Chunk i+1's data is
+    enqueued (host `device_put` or an on-device generator) before chunk
+    i's solve is awaited — JAX's async dispatch overlaps the transfer with
+    the compute, the streaming analog of Spark pipelining a partition
+    fetch behind a partition solve.
+  - Each chunk is ONE vmapped optimizer call on the dense local-design
+    layout (ops/dense.DenseBatch — pure MXU-batched matmul sweeps, no
+    random access); under a mesh the chunk is entity-sharded by shard_map
+    with NO collectives (RandomEffectCoordinate.scala:101-130 semantics).
+
+``bench_scale.py`` drives this at ~1e9 coefficients on one chip;
+``__graft_entry__.dryrun_multichip`` runs the sharded-table path on the
+virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache, partial
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.ops.dense import DenseBatch
+from photon_ml_tpu.ops.objective import make_objective
+from photon_ml_tpu.optim.factory import OptimizerConfig
+
+Array = jax.Array
+
+
+@lru_cache(maxsize=16)
+def _chunk_writer(donate: bool):
+    def write(table, w, start):
+        return jax.lax.dynamic_update_slice(
+            table, w.astype(table.dtype), (start, 0)
+        )
+
+    return jax.jit(write, donate_argnums=(0,) if donate else ())
+
+
+def _read_chunk(table, start: int, size: int) -> Array:
+    return jax.lax.dynamic_slice(table, (start, 0), (size, table.shape[1]))
+
+
+class ShardedCoefficientTable:
+    """HBM-resident [N, K] coefficient table, chunk-updated in place.
+
+    Updates donate the table buffer, so the table is never duplicated in
+    HBM. With ``mesh`` the entity axis is sharded (NamedSharding P(axis))
+    — per-device residency N*K*4/n_devices bytes. The per-entity SOLVES
+    are collective-free (independent problems under shard_map); chunk
+    read/write slices may reshard between the chunk's P(axis) layout and
+    the table's, which XLA lowers to the minimal ICI exchange.
+    """
+
+    def __init__(
+        self,
+        num_entities: int,
+        dim: int,
+        mesh: Optional[Mesh] = None,
+        axis: str = "entity",
+        dtype=jnp.float32,
+    ):
+        self.num_entities = int(num_entities)
+        self.dim = int(dim)
+        self.mesh = mesh
+        self.axis = axis
+        if mesh is None:
+            self.sharding = None
+            self.coefficients = jnp.zeros((num_entities, dim), dtype)
+        else:
+            n_dev = int(mesh.devices.size)
+            if num_entities % n_dev:
+                raise ValueError(
+                    f"num_entities={num_entities} must divide over the "
+                    f"{n_dev}-device '{axis}' axis (pad the entity count)"
+                )
+            self.sharding = NamedSharding(mesh, P(axis, None))
+            self.coefficients = jax.device_put(
+                jnp.zeros((num_entities, dim), dtype), self.sharding
+            )
+
+    @property
+    def nbytes(self) -> int:
+        return self.num_entities * self.dim * self.coefficients.dtype.itemsize
+
+    def write_chunk(self, start: int, w: Array) -> None:
+        self.coefficients = _chunk_writer(True)(
+            self.coefficients, w, jnp.int32(start)
+        )
+
+    def read_chunk(self, start: int, size: int) -> Array:
+        return _read_chunk(self.coefficients, jnp.int32(start), size)
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.coefficients)
+
+
+@dataclasses.dataclass
+class ChunkResult:
+    """Per-chunk telemetry, kept ON DEVICE until summarized."""
+
+    start: int
+    size: int
+    iterations: Array  # i32[E_c]
+    values: Array  # f32[E_c]
+
+
+@dataclasses.dataclass
+class StreamingTrainStats:
+    total_entities: int
+    total_coefficients: int
+    num_chunks: int
+    mean_iterations: float
+    total_final_value: float
+
+
+class StreamingRandomEffectTrainer:
+    """Drive a :class:`ShardedCoefficientTable` through streamed chunks.
+
+    ``chunks`` yields ``(start, batch_source)`` where ``batch_source`` is
+    either a DenseBatch of HOST (numpy) arrays — uploaded with
+    ``device_put`` one chunk ahead of the solve — or a zero-arg callable
+    returning a device DenseBatch (an on-device generator; used by the 1B
+    bench because the tunnel link makes bulk H2D impractical, and by any
+    caller whose features are computed rather than stored).
+    """
+
+    def __init__(
+        self,
+        loss_name: str,
+        config: OptimizerConfig,
+        mesh: Optional[Mesh] = None,
+        axis: str = "entity",
+    ):
+        # the vmapped / shard_mapped per-entity solver builders are shared
+        # with RandomEffectCoordinate — one lru_cache entry serves both
+        from photon_ml_tpu.game.coordinates import (
+            _re_solver,
+            _re_solver_sharded,
+        )
+
+        config.validate(loss_name)
+        self.loss_name = loss_name
+        self.config = config
+        self.mesh = mesh
+        self._n_dev = 1 if mesh is None else int(mesh.devices.size)
+        key_cfg = dataclasses.replace(config, regularization_weight=0.0)
+        if mesh is None:
+            self._solver = _re_solver(key_cfg, loss_name)
+        else:
+            self._solver = _re_solver_sharded(key_cfg, loss_name, mesh, axis)
+        self._sharding = (
+            None if mesh is None else NamedSharding(mesh, P(axis))
+        )
+        self._obj = make_objective(
+            loss_name,
+            l2_weight=config.regularization.l2_weight(
+                config.regularization_weight
+            ),
+        )
+        self._l1 = jnp.float32(
+            config.regularization.l1_weight(config.regularization_weight)
+        )
+
+    def _prepare(self, source) -> DenseBatch:
+        if callable(source):
+            return source()
+        if isinstance(source, DenseBatch):
+            leaves = jax.tree.leaves(source)
+            if leaves and isinstance(leaves[0], np.ndarray):
+                put = (
+                    jax.device_put
+                    if self._sharding is None
+                    else partial(jax.device_put, device=self._sharding)
+                )
+                return jax.tree.map(put, source)
+            return source
+        raise TypeError(f"chunk source {type(source).__name__}")
+
+    def _solve(self, table, start: int, batch: DenseBatch) -> ChunkResult:
+        size = batch.labels.shape[0]
+        if self.mesh is not None and size % self._n_dev:
+            # fail with intent, not a shard-shape error deep inside jax
+            raise ValueError(
+                f"chunk of {size} entities must divide over the "
+                f"{self._n_dev}-device mesh (pad the chunk)"
+            )
+        w0 = table.read_chunk(start, size)
+        res, _ = self._solver(self._obj, batch, w0, self._l1, None)
+        table.write_chunk(start, res.w)
+        return ChunkResult(
+            start=start,
+            size=size,
+            iterations=res.iterations,
+            values=res.value,
+        )
+
+    def train(
+        self,
+        table: ShardedCoefficientTable,
+        chunks: Iterable[tuple[int, DenseBatch | Callable[[], DenseBatch]]],
+    ) -> StreamingTrainStats:
+        """Solve every chunk into ``table``; chunk i+1's data is enqueued
+        BEFORE chunk i's solve result is consumed (async-dispatch overlap).
+        """
+        results: list[ChunkResult] = []
+        it = iter(chunks)
+        pending = None
+        for start, source in it:
+            nxt = (start, self._prepare(source))
+            if pending is not None:
+                results.append(self._solve(table, *pending))
+            pending = nxt
+        if pending is not None:
+            results.append(self._solve(table, *pending))
+        if not results:
+            return StreamingTrainStats(0, 0, 0, 0.0, 0.0)
+        # ONE device->host fetch for the scalar summaries
+        sums = np.asarray(
+            jnp.stack(
+                [
+                    jnp.sum(
+                        jnp.stack(
+                            [jnp.sum(r.iterations.astype(jnp.float32))
+                             for r in results]
+                        )
+                    ),
+                    jnp.sum(jnp.stack([jnp.sum(r.values) for r in results])),
+                ]
+            )
+        )
+        total_e = sum(r.size for r in results)
+        return StreamingTrainStats(
+            total_entities=total_e,
+            total_coefficients=total_e * table.dim,
+            num_chunks=len(results),
+            mean_iterations=float(sums[0]) / max(total_e, 1),
+            total_final_value=float(sums[1]),
+        )
