@@ -1,0 +1,716 @@
+// Native Avro object-container decoder for TrainingExampleAvro-shaped
+// records — the ingestion hot loop behind
+// photon_ml_tpu.data.avro.read_game_dataset_from_avro.
+//
+// The Python side parses the container HEADER (schema JSON, codec, sync
+// marker) and compiles the record schema into a compact i32 "program"
+// (see photon_ml_tpu/data/avro_native.py). This file interprets that
+// program over every record of every block at C speed: varint/zigzag
+// decoding, deflate inflation (zlib), feature key formation
+// (name '\x01' term — photon-client util/Utils.getFeatureKey), hash
+// lookups into the caller's index map (or interning when the map is
+// being BUILT), and id-column interning. Two-phase C ABI: parse into a
+// heap Result, then copy out into caller-allocated numpy buffers.
+//
+// Reference analog: AvroDataReader.scala:87-237 runs this loop on Spark
+// executors; here it is one host core at ~1e6 rows/s (vs ~1.6e4 for the
+// schema-interpreting pure-Python decoder).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+thread_local std::string g_error;
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  int64_t read_long() {
+    uint64_t acc = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      acc |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) {
+        return static_cast<int64_t>(acc >> 1) ^ -static_cast<int64_t>(acc & 1);
+      }
+      shift += 7;
+      if (shift > 63) break;
+    }
+    fail = true;
+    return 0;
+  }
+
+  bool skip(int64_t n) {
+    if (n < 0 || end - p < n) {
+      fail = true;
+      return false;
+    }
+    p += n;
+    return true;
+  }
+
+  bool read_raw(void* out, int64_t n) {
+    if (end - p < n) {
+      fail = true;
+      return false;
+    }
+    std::memcpy(out, p, n);
+    p += n;
+    return true;
+  }
+
+  double read_double() {
+    double v = 0;
+    read_raw(&v, 8);
+    return v;
+  }
+
+  float read_float() {
+    float v = 0;
+    read_raw(&v, 4);
+    return v;
+  }
+
+  // length-prefixed bytes/string; returns view into the buffer
+  bool read_bytes(const char** out, int64_t* len) {
+    int64_t n = read_long();
+    if (fail || n < 0 || end - p < n) {
+      fail = true;
+      return false;
+    }
+    *out = reinterpret_cast<const char*>(p);
+    *len = n;
+    p += n;
+    return true;
+  }
+};
+
+// program opcodes — mirror photon_ml_tpu/data/avro_native.py
+enum Op : int32_t {
+  OP_SKIP_LONG = 1,    //
+  OP_SKIP_FLOAT = 2,   //
+  OP_SKIP_DOUBLE = 3,  //
+  OP_SKIP_BYTES = 4,   // string/bytes
+  OP_SKIP_BOOL = 5,    //
+  OP_SKIP_FIXED = 6,   // +n
+  OP_SCALAR_D = 7,     // +dest: double -> scalar channel
+  OP_SCALAR_F = 8,     // +dest: float
+  OP_SCALAR_L = 9,     // +dest: int/long
+  OP_SCALAR_B = 10,    // +dest: boolean
+  OP_UNION = 11,       // +n, then n branch lengths, then branches
+  OP_FEATURE_BAG = 12, // +shard, +item_len, then item program
+  OP_FNAME = 13,       //
+  OP_FTERM = 14,       //
+  OP_FVALUE_D = 15,    //
+  OP_FVALUE_F = 16,    //
+  OP_ID_FIELD = 17,    // +col: top-level string id column (overwrites)
+  OP_ID_MAP = 18,      // string->string map matched against id columns
+  OP_ARRAY_SKIP = 19,  // +item_len, then item program
+  OP_MAP_SKIP = 20,    // +value_len, then value program (string keys)
+};
+
+// scalar channel dests
+enum Dest : int32_t { DEST_LABEL = 0, DEST_OFFSET = 1, DEST_WEIGHT = 2 };
+
+inline uint64_t fnv1a(const char* p, int64_t n, uint64_t h) {
+  for (int64_t i = 0; i < n; ++i)
+    h = (h ^ static_cast<uint8_t>(p[i])) * 1099511628211ULL;
+  return h;
+}
+constexpr uint64_t kFnvSeed = 1469598103934665603ULL;
+
+// Open-addressing string map specialized for the per-feature hot loop:
+// the key is (name, optional '\x01' + term) hashed INCREMENTALLY — no
+// composed std::string is ever built for a lookup (std::unordered_map
+// with per-feature string allocation measured ~180 ns/lookup; this is
+// ~3x faster).
+struct FastMap {
+  std::vector<uint64_t> hashes;  // 0 = empty slot
+  std::vector<int64_t> ids;
+  std::vector<uint64_t> key_off;
+  std::vector<uint32_t> key_len;
+  std::string blob;  // all keys concatenated (for collision verify)
+  uint64_t mask = 0;
+  int64_t count = 0;
+
+  void reserve_for(int64_t n) {
+    uint64_t cap = 16;
+    while (cap < static_cast<uint64_t>(n) * 2) cap <<= 1;
+    hashes.assign(cap, 0);
+    ids.assign(cap, -1);
+    key_off.assign(cap, 0);
+    key_len.assign(cap, 0);
+    mask = cap - 1;
+  }
+
+  void grow() {
+    FastMap bigger;
+    bigger.reserve_for(static_cast<int64_t>(hashes.size()));
+    bigger.blob.swap(blob);
+    for (size_t s = 0; s < hashes.size(); ++s) {
+      if (!hashes[s]) continue;
+      uint64_t slot = hashes[s] & bigger.mask;
+      while (bigger.hashes[slot]) slot = (slot + 1) & bigger.mask;
+      bigger.hashes[slot] = hashes[s];
+      bigger.ids[slot] = ids[s];
+      bigger.key_off[slot] = key_off[s];
+      bigger.key_len[slot] = key_len[s];
+    }
+    bigger.count = count;
+    *this = std::move(bigger);
+  }
+
+  bool match(uint64_t slot, const char* a, int64_t an, const char* b,
+             int64_t bn) const {
+    // stored key == a ++ ('\x01' + b when bn > 0)
+    uint64_t total = static_cast<uint64_t>(an) + (bn > 0 ? bn + 1 : 0);
+    if (key_len[slot] != total) return false;
+    const char* k = blob.data() + key_off[slot];
+    if (std::memcmp(k, a, an)) return false;
+    if (bn > 0) {
+      if (k[an] != '\x01') return false;
+      if (std::memcmp(k + an + 1, b, bn)) return false;
+    }
+    return true;
+  }
+
+  static uint64_t hash_parts(const char* a, int64_t an, const char* b,
+                             int64_t bn) {
+    uint64_t h = fnv1a(a, an, kFnvSeed);
+    if (bn > 0) {
+      const char sep = '\x01';
+      h = fnv1a(&sep, 1, h);
+      h = fnv1a(b, bn, h);
+    }
+    return h ? h : 1;  // 0 marks empty slots
+  }
+
+  // lookup only; -1 when absent
+  int64_t find(const char* a, int64_t an, const char* b, int64_t bn) const {
+    uint64_t h = hash_parts(a, an, b, bn);
+    uint64_t slot = h & mask;
+    while (hashes[slot]) {
+      if (hashes[slot] == h && match(slot, a, an, b, bn)) return ids[slot];
+      slot = (slot + 1) & mask;
+    }
+    return -1;
+  }
+
+  // insert-or-get with a caller-chosen id for fresh keys
+  int64_t intern(const char* a, int64_t an, const char* b, int64_t bn) {
+    if (static_cast<uint64_t>(count) * 2 >= hashes.size()) grow();
+    uint64_t h = hash_parts(a, an, b, bn);
+    uint64_t slot = h & mask;
+    while (hashes[slot]) {
+      if (hashes[slot] == h && match(slot, a, an, b, bn)) return ids[slot];
+      slot = (slot + 1) & mask;
+    }
+    hashes[slot] = h;
+    ids[slot] = count++;
+    key_off[slot] = blob.size();
+    blob.append(a, an);
+    if (bn > 0) {
+      blob.push_back('\x01');
+      blob.append(b, bn);
+    }
+    key_len[slot] = static_cast<uint32_t>(blob.size() - key_off[slot]);
+    return ids[slot];
+  }
+
+  // seed one key with an explicit id (lookup-table construction)
+  void put(const char* k, int64_t n, int64_t id) {
+    if (static_cast<uint64_t>(count) * 2 >= hashes.size()) grow();
+    uint64_t h = hash_parts(k, n, nullptr, 0);
+    uint64_t slot = h & mask;
+    while (hashes[slot]) slot = (slot + 1) & mask;
+    hashes[slot] = h;
+    ids[slot] = id;
+    key_off[slot] = blob.size();
+    blob.append(k, n);
+    key_len[slot] = static_cast<uint32_t>(n);
+    ++count;
+  }
+
+  // export interned keys in id order (intern ids are dense 0..count-1)
+  void export_keys(std::vector<std::string>& out) const {
+    out.assign(count, std::string());
+    for (size_t s = 0; s < hashes.size(); ++s) {
+      if (hashes[s])
+        out[ids[s]] = blob.substr(key_off[s], key_len[s]);
+    }
+  }
+};
+
+struct Interner {
+  std::unordered_map<std::string, int64_t> map;
+  std::vector<std::string> order;
+
+  int64_t intern(const std::string& s) {
+    auto it = map.find(s);
+    if (it != map.end()) return it->second;
+    int64_t id = static_cast<int64_t>(order.size());
+    map.emplace(s, id);
+    order.push_back(s);
+    return id;
+  }
+};
+
+struct Shard {
+  // lookup mode: key -> dense id; intern mode: keys interned on the fly
+  FastMap keys;
+  bool interning = false;
+  std::vector<double> vals;
+  std::vector<int64_t> rows;
+  std::vector<int64_t> cols;
+};
+
+struct IdCol {
+  Interner vocab;
+  std::vector<int64_t> codes;  // per row
+};
+
+struct Result {
+  std::vector<double> labels, offsets, weights;
+  std::vector<uint8_t> label_seen;  // genuine NaN labels stay distinguishable
+  std::vector<Shard> shards;
+  std::vector<IdCol> id_cols;
+  std::vector<std::string> id_names;
+  int64_t rows = 0;
+};
+
+struct RecState {
+  // feature name/term as VIEWS into the (stable-for-the-block) payload
+  const char* fname = nullptr;
+  int64_t fname_len = 0;
+  const char* fterm = nullptr;
+  int64_t fterm_len = 0;
+  double fvalue = 0;
+  bool has_name = false, has_value = false;
+  std::vector<int32_t> id_mark;  // 0 unset, 1 map-set, 2 field-set
+};
+
+bool run_program(Cursor& c, const int32_t* prog, int64_t len, Result& res,
+                 RecState& st, int64_t row);
+
+bool run_feature_item(Cursor& c, const int32_t* prog, int64_t len,
+                      Result& res, RecState& st, Shard& sh, int64_t row) {
+  st.fname_len = st.fterm_len = 0;
+  st.has_name = st.has_value = false;
+  if (!run_program(c, prog, len, res, st, row)) return false;
+  if (!st.has_name || !st.has_value) return true;  // malformed item: drop
+  int64_t id;
+  if (sh.interning) {
+    id = sh.keys.intern(st.fname, st.fname_len, st.fterm, st.fterm_len);
+  } else {
+    id = sh.keys.find(st.fname, st.fname_len, st.fterm, st.fterm_len);
+    if (id < 0) return true;  // unknown feature: dropped
+  }
+  sh.vals.push_back(st.fvalue);
+  sh.rows.push_back(row);
+  sh.cols.push_back(id);
+  return true;
+}
+
+bool run_program(Cursor& c, const int32_t* prog, int64_t len, Result& res,
+                 RecState& st, int64_t row) {
+  int64_t i = 0;
+  while (i < len && !c.fail) {
+    int32_t op = prog[i++];
+    switch (op) {
+      case OP_SKIP_LONG:
+        c.read_long();
+        break;
+      case OP_SKIP_FLOAT:
+        c.skip(4);
+        break;
+      case OP_SKIP_DOUBLE:
+        c.skip(8);
+        break;
+      case OP_SKIP_BYTES: {
+        int64_t n = c.read_long();
+        c.skip(n);
+        break;
+      }
+      case OP_SKIP_BOOL:
+        c.skip(1);
+        break;
+      case OP_SKIP_FIXED:
+        c.skip(prog[i++]);
+        break;
+      case OP_SCALAR_D:
+      case OP_SCALAR_F:
+      case OP_SCALAR_L:
+      case OP_SCALAR_B: {
+        int32_t dest = prog[i++];
+        double v;
+        if (op == OP_SCALAR_D) v = c.read_double();
+        else if (op == OP_SCALAR_F) v = c.read_float();
+        else if (op == OP_SCALAR_L) v = static_cast<double>(c.read_long());
+        else {
+          uint8_t b = 0;
+          c.read_raw(&b, 1);
+          v = b ? 1.0 : 0.0;
+        }
+        if (dest == DEST_LABEL) {
+          res.labels[row] = v;
+          res.label_seen[row] = 1;
+        } else if (dest == DEST_OFFSET) res.offsets[row] = v;
+        else if (dest == DEST_WEIGHT) res.weights[row] = v;
+        break;
+      }
+      case OP_UNION: {
+        // layout: n, len_0..len_{n-1}, branch_0 ... branch_{n-1}
+        int32_t n = prog[i++];
+        int64_t branch = c.read_long();
+        if (c.fail || branch < 0 || branch >= n) {
+          g_error = "union branch out of range";
+          c.fail = true;
+          return false;
+        }
+        int64_t off = i + n;
+        for (int32_t b = 0; b < branch; ++b) off += prog[i + b];
+        if (!run_program(c, prog + off, prog[i + branch], res, st, row))
+          return false;
+        int64_t total = 0;
+        for (int32_t b = 0; b < n; ++b) total += prog[i + b];
+        i += n + total;
+        break;
+      }
+      case OP_FEATURE_BAG: {
+        int32_t shard = prog[i++];
+        int32_t item_len = prog[i++];
+        const int32_t* item = prog + i;
+        i += item_len;
+        Shard& sh = res.shards[shard];
+        for (;;) {
+          int64_t n = c.read_long();
+          if (c.fail) return false;
+          if (n == 0) break;
+          if (n < 0) {
+            n = -n;
+            c.read_long();  // block byte size
+          }
+          for (int64_t k = 0; k < n; ++k) {
+            if (!run_feature_item(c, item, item_len, res, st, sh, row))
+              return false;
+            if (c.fail) return false;
+          }
+        }
+        break;
+      }
+      case OP_FNAME:
+      case OP_FTERM: {
+        const char* s;
+        int64_t n;
+        if (!c.read_bytes(&s, &n)) return false;
+        if (op == OP_FNAME) {
+          st.fname = s;
+          st.fname_len = n;
+          st.has_name = true;
+        } else {
+          st.fterm = s;
+          st.fterm_len = n;
+        }
+        break;
+      }
+      case OP_FVALUE_D:
+        st.fvalue = c.read_double();
+        st.has_value = true;
+        break;
+      case OP_FVALUE_F:
+        st.fvalue = c.read_float();
+        st.has_value = true;
+        break;
+      case OP_ID_FIELD: {
+        int32_t col = prog[i++];
+        const char* s;
+        int64_t n;
+        if (!c.read_bytes(&s, &n)) return false;
+        IdCol& ic = res.id_cols[col];
+        ic.codes[row] = ic.vocab.intern(std::string(s, n));
+        st.id_mark[col] = 2;
+        break;
+      }
+      case OP_ID_MAP: {
+        for (;;) {
+          int64_t n = c.read_long();
+          if (c.fail) return false;
+          if (n == 0) break;
+          if (n < 0) {
+            n = -n;
+            c.read_long();
+          }
+          for (int64_t k = 0; k < n; ++k) {
+            const char* ks;
+            int64_t kn;
+            const char* vs;
+            int64_t vn;
+            if (!c.read_bytes(&ks, &kn)) return false;
+            if (!c.read_bytes(&vs, &vn)) return false;
+            for (size_t ci = 0; ci < res.id_names.size(); ++ci) {
+              const std::string& want = res.id_names[ci];
+              if (st.id_mark[ci] == 0 &&
+                  want.size() == static_cast<size_t>(kn) &&
+                  std::memcmp(want.data(), ks, kn) == 0) {
+                IdCol& ic = res.id_cols[ci];
+                ic.codes[row] = ic.vocab.intern(std::string(vs, vn));
+                st.id_mark[ci] = 1;
+              }
+            }
+          }
+        }
+        break;
+      }
+      case OP_ARRAY_SKIP: {
+        int32_t item_len = prog[i++];
+        const int32_t* item = prog + i;
+        i += item_len;
+        for (;;) {
+          int64_t n = c.read_long();
+          if (c.fail) return false;
+          if (n == 0) break;
+          if (n < 0) {
+            n = -n;
+            c.read_long();
+          }
+          for (int64_t k = 0; k < n; ++k)
+            if (!run_program(c, item, item_len, res, st, row)) return false;
+        }
+        break;
+      }
+      case OP_MAP_SKIP: {
+        int32_t val_len = prog[i++];
+        const int32_t* val = prog + i;
+        i += val_len;
+        for (;;) {
+          int64_t n = c.read_long();
+          if (c.fail) return false;
+          if (n == 0) break;
+          if (n < 0) {
+            n = -n;
+            c.read_long();
+          }
+          for (int64_t k = 0; k < n; ++k) {
+            int64_t kn = c.read_long();
+            if (!c.skip(kn)) return false;
+            if (!run_program(c, val, val_len, res, st, row)) return false;
+          }
+        }
+        break;
+      }
+      default:
+        g_error = "bad opcode " + std::to_string(op);
+        c.fail = true;
+        return false;
+    }
+  }
+  return !c.fail;
+}
+
+}  // namespace
+
+extern "C" {
+
+// parse blocks; returns heap Result* or nullptr (avro_last_error()).
+//
+// data/len: the file bytes; block_start: offset of the first block;
+// sync: 16-byte marker; codec_deflate: 1 if blocks are raw-deflate.
+// prog/prog_len: record program. feat tables (per shard, lookup mode):
+// concatenated key bytes + (n+1) offsets + dense ids; n_keys < 0 marks
+// INTERN mode for that shard. id_names: concatenated + offsets.
+void* avro_parse(const uint8_t* data, int64_t len, int64_t block_start,
+                 const uint8_t* sync, int32_t codec_deflate,
+                 const int32_t* prog, int64_t prog_len, int32_t n_shards,
+                 const uint8_t* feat_bytes, const int64_t* feat_offs,
+                 const int64_t* feat_ids, const int64_t* shard_key_counts,
+                 int32_t n_id_cols, const uint8_t* id_name_bytes,
+                 const int64_t* id_name_offs) {
+  g_error.clear();
+  auto res = new Result();
+  res->shards.resize(n_shards);
+  int64_t off_base = 0;  // index into feat_offs (each shard has nk+1 slots)
+  int64_t id_base = 0;   // index into feat_ids (nk per shard)
+  for (int32_t s = 0; s < n_shards; ++s) {
+    int64_t nk = shard_key_counts[s];
+    Shard& sh = res->shards[s];
+    if (nk < 0) {
+      sh.interning = true;
+      sh.keys.reserve_for(1024);
+      continue;
+    }
+    sh.keys.reserve_for(nk > 0 ? nk : 1);
+    for (int64_t k = 0; k < nk; ++k) {
+      const char* p =
+          reinterpret_cast<const char*>(feat_bytes) + feat_offs[off_base + k];
+      int64_t n = feat_offs[off_base + k + 1] - feat_offs[off_base + k];
+      sh.keys.put(p, n, feat_ids[id_base + k]);
+    }
+    off_base += nk + 1;
+    id_base += nk;
+  }
+  res->id_cols.resize(n_id_cols);
+  for (int32_t ci = 0; ci < n_id_cols; ++ci) {
+    const char* p =
+        reinterpret_cast<const char*>(id_name_bytes) + id_name_offs[ci];
+    int64_t n = id_name_offs[ci + 1] - id_name_offs[ci];
+    res->id_names.emplace_back(p, n);
+  }
+
+  std::vector<uint8_t> inflated;
+  RecState st;
+  st.id_mark.assign(n_id_cols, 0);
+  Cursor file{data + block_start, data + len};
+  while (file.p < file.end) {
+    int64_t n_rec = file.read_long();
+    int64_t size = file.read_long();
+    if (file.fail || size < 0 || file.end - file.p < size) {
+      g_error = "corrupt block header";
+      delete res;
+      return nullptr;
+    }
+    const uint8_t* payload = file.p;
+    int64_t payload_len = size;
+    file.p += size;
+    if (codec_deflate) {
+      // raw deflate; grow-only scratch (a clear+resize would memset
+      // multi-MB per block in the hot loop just to be overwritten)
+      size_t want = static_cast<size_t>(size) * 4 + 1024;
+      if (inflated.size() < want) inflated.resize(want);
+      z_stream zs{};
+      if (inflateInit2(&zs, -15) != Z_OK) {
+        g_error = "zlib init failed";
+        delete res;
+        return nullptr;
+      }
+      zs.next_in = const_cast<uint8_t*>(payload);
+      zs.avail_in = static_cast<uInt>(size);
+      size_t out_pos = 0;
+      int zr;
+      do {
+        if (out_pos == inflated.size()) inflated.resize(inflated.size() * 2);
+        zs.next_out = inflated.data() + out_pos;
+        zs.avail_out = static_cast<uInt>(inflated.size() - out_pos);
+        zr = inflate(&zs, Z_NO_FLUSH);
+        out_pos = inflated.size() - zs.avail_out;
+      } while (zr == Z_OK);
+      inflateEnd(&zs);
+      if (zr != Z_STREAM_END) {
+        g_error = "deflate block corrupt";
+        delete res;
+        return nullptr;
+      }
+      payload = inflated.data();
+      payload_len = static_cast<int64_t>(out_pos);
+    }
+    Cursor c{payload, payload + payload_len};
+    for (int64_t r = 0; r < n_rec; ++r) {
+      int64_t row = res->rows++;
+      res->labels.push_back(0.0);
+      res->label_seen.push_back(0);
+      res->offsets.push_back(0.0);
+      res->weights.push_back(1.0);
+      for (auto& ic : res->id_cols) ic.codes.push_back(-1);
+      std::fill(st.id_mark.begin(), st.id_mark.end(), 0);
+      if (!run_program(c, prog, prog_len, *res, st, row)) {
+        if (g_error.empty()) g_error = "corrupt record";
+        delete res;
+        return nullptr;
+      }
+    }
+    uint8_t got_sync[16];
+    if (!file.read_raw(got_sync, 16) || std::memcmp(got_sync, sync, 16)) {
+      g_error = "sync marker mismatch (corrupt block)";
+      delete res;
+      return nullptr;
+    }
+  }
+  return res;
+}
+
+const char* avro_last_error() { return g_error.c_str(); }
+
+int64_t avro_rows(void* h) { return static_cast<Result*>(h)->rows; }
+
+void avro_fill_scalars(void* h, double* labels, double* offsets,
+                       double* weights, uint8_t* label_seen) {
+  auto* r = static_cast<Result*>(h);
+  std::memcpy(labels, r->labels.data(), r->rows * 8);
+  std::memcpy(offsets, r->offsets.data(), r->rows * 8);
+  std::memcpy(weights, r->weights.data(), r->rows * 8);
+  std::memcpy(label_seen, r->label_seen.data(), r->rows);
+}
+
+int64_t avro_shard_nnz(void* h, int32_t s) {
+  return static_cast<int64_t>(static_cast<Result*>(h)->shards[s].vals.size());
+}
+
+void avro_fill_coo(void* h, int32_t s, double* vals, int64_t* rows,
+                   int64_t* cols) {
+  auto& sh = static_cast<Result*>(h)->shards[s];
+  std::memcpy(vals, sh.vals.data(), sh.vals.size() * 8);
+  std::memcpy(rows, sh.rows.data(), sh.rows.size() * 8);
+  std::memcpy(cols, sh.cols.data(), sh.cols.size() * 8);
+}
+
+int64_t avro_shard_vocab_size(void* h, int32_t s) {
+  return static_cast<Result*>(h)->shards[s].keys.count;
+}
+
+int64_t avro_shard_vocab_bytes(void* h, int32_t s) {
+  return static_cast<int64_t>(
+      static_cast<Result*>(h)->shards[s].keys.blob.size());
+}
+
+void avro_fill_shard_vocab(void* h, int32_t s, uint8_t* bytes,
+                           int64_t* offs) {
+  std::vector<std::string> order;
+  static_cast<Result*>(h)->shards[s].keys.export_keys(order);
+  int64_t pos = 0;
+  for (size_t k = 0; k < order.size(); ++k) {
+    offs[k] = pos;
+    std::memcpy(bytes + pos, order[k].data(), order[k].size());
+    pos += static_cast<int64_t>(order[k].size());
+  }
+  offs[order.size()] = pos;
+}
+
+int64_t avro_id_vocab_size(void* h, int32_t c) {
+  return static_cast<int64_t>(
+      static_cast<Result*>(h)->id_cols[c].vocab.order.size());
+}
+
+int64_t avro_id_vocab_bytes(void* h, int32_t c) {
+  int64_t total = 0;
+  for (auto& k : static_cast<Result*>(h)->id_cols[c].vocab.order)
+    total += static_cast<int64_t>(k.size());
+  return total;
+}
+
+void avro_fill_ids(void* h, int32_t c, int64_t* codes, uint8_t* bytes,
+                   int64_t* offs) {
+  auto& ic = static_cast<Result*>(h)->id_cols[c];
+  std::memcpy(codes, ic.codes.data(), ic.codes.size() * 8);
+  int64_t pos = 0;
+  for (size_t k = 0; k < ic.vocab.order.size(); ++k) {
+    offs[k] = pos;
+    std::memcpy(bytes + pos, ic.vocab.order[k].data(),
+                ic.vocab.order[k].size());
+    pos += static_cast<int64_t>(ic.vocab.order[k].size());
+  }
+  offs[ic.vocab.order.size()] = pos;
+}
+
+void avro_free(void* h) { delete static_cast<Result*>(h); }
+
+}  // extern "C"

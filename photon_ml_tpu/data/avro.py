@@ -451,6 +451,82 @@ def build_index_map_from_avro(
     return IndexMap.build(keys(), add_intercept=add_intercept)
 
 
+def _read_game_dataset_native(
+    file_list: list[str],
+    feature_shards: Mapping[str, Sequence[str]],
+    index_maps: Optional[Mapping[str, IndexMap]],
+    id_columns: Sequence[str],
+    add_intercept: bool,
+    is_response_required: bool,
+):
+    """Native-decoder fast path (photon_ml_tpu.data.avro_native); returns
+    the GameDataset or None when the native path is unavailable/unsupported
+    (the pure-Python decoder below then runs — identical semantics)."""
+    from photon_ml_tpu.data.avro_native import read_game_arrays_native
+
+    fast = read_game_arrays_native(
+        file_list, feature_shards, index_maps, id_columns
+    )
+    if fast is None:
+        return None
+    labels, offsets, weights, coo, idvals, vocabs, label_seen = fast
+    n = len(labels)
+    if n == 0:
+        raise ValueError(f"no records in {file_list}")
+    missing = label_seen == 0
+    if np.any(missing) and is_response_required:
+        raise ValueError(
+            f"record {int(np.argmax(missing))} of {file_list} has no label"
+        )
+
+    if index_maps is None:
+        # ONE pass built both the COO (interned ids) and the vocabularies;
+        # materialize the IndexMaps and remap interned -> final dense ids
+        built = {}
+        remapped = []
+        for si, (shard, _) in enumerate(feature_shards.items()):
+            imap = IndexMap.build(
+                iter(vocabs[si]), add_intercept=add_intercept
+            )
+            built[shard] = imap
+            vals, rws, cls = coo[si]
+            remap = np.asarray(
+                [imap.get(k) for k in vocabs[si]], np.int64
+            )
+            remapped.append(
+                (vals, rws, remap[cls] if len(cls) else cls)
+            )
+        index_maps = built
+        coo = remapped
+
+    shards = {}
+    for si, shard in enumerate(feature_shards):
+        vals, rws, cls = coo[si]
+        imap = index_maps[shard]
+        if add_intercept:
+            icept = imap.get(INTERCEPT_KEY)
+            if icept >= 0:
+                vals = np.concatenate([vals, np.ones(n)])
+                rws = np.concatenate([rws, np.arange(n, dtype=np.int64)])
+                cls = np.concatenate(
+                    [cls, np.full(n, icept, np.int64)]
+                )
+        shards[shard] = SparseBatch.from_coo(
+            values=vals,
+            rows=rws,
+            cols=cls,
+            labels=labels,
+            num_features=len(imap),
+        )
+    return build_game_dataset(
+        response=labels,
+        feature_shards=shards,
+        id_columns={c: idvals[ci] for ci, c in enumerate(id_columns)},
+        offset=offsets,
+        weight=weights,
+    )
+
+
 def read_game_dataset_from_avro(
     paths: str | Sequence[str],
     feature_shards: Optional[Mapping[str, Sequence[str]]] = None,
@@ -471,6 +547,13 @@ def read_game_dataset_from_avro(
     """
     feature_shards = dict(feature_shards or {"features": ("features",)})
     file_list = _as_paths(paths)
+
+    fast = _read_game_dataset_native(
+        file_list, feature_shards, index_maps, id_columns,
+        add_intercept, is_response_required,
+    )
+    if fast is not None:
+        return fast
 
     if index_maps is None:
         index_maps = {

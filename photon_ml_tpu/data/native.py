@@ -29,22 +29,39 @@ _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 
 
+_SOURCES = ("fast_parse.cpp", "avro_decode.cpp")
+
+
+def _source_paths() -> list[str]:
+    return [
+        p
+        for p in (os.path.join(_NATIVE_DIR, s) for s in _SOURCES)
+        if os.path.exists(p)
+    ]
+
+
 def _build() -> bool:
-    src = os.path.join(_NATIVE_DIR, "fast_parse.cpp")
-    if not os.path.exists(src):
+    srcs = _source_paths()
+    if not srcs:
         return False
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
-             "-o", _LIB_PATH, src],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        return True
-    except (OSError, subprocess.SubprocessError) as e:
-        logger.info("native build unavailable (%s); using pure python", e)
-        return False
+    attempts = [srcs]
+    if len(srcs) > 1:
+        # avro_decode.cpp needs zlib; if that link fails (no libz on the
+        # host), still build fast_parse alone so the libsvm accelerator
+        # survives
+        attempts.append(srcs[:1])
+    for attempt in attempts:
+        cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+               "-o", _LIB_PATH, *attempt]
+        if any("avro_decode" in s for s in attempt):
+            cmd.append("-lz")
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=240)
+            return True
+        except (OSError, subprocess.SubprocessError) as e:
+            logger.info("native build failed for %s (%s)", attempt, e)
+    logger.info("native build unavailable; using pure python")
+    return False
 
 
 def load_native() -> Optional[ctypes.CDLL]:
@@ -53,11 +70,9 @@ def load_native() -> Optional[ctypes.CDLL]:
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True
-    src = os.path.join(_NATIVE_DIR, "fast_parse.cpp")
-    stale = (
-        os.path.exists(_LIB_PATH)
-        and os.path.exists(src)
-        and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    srcs = _source_paths()
+    stale = os.path.exists(_LIB_PATH) and any(
+        os.path.getmtime(s) > os.path.getmtime(_LIB_PATH) for s in srcs
     )
     if (not os.path.exists(_LIB_PATH) or stale) and not _build():
         if not os.path.exists(_LIB_PATH):

@@ -296,3 +296,80 @@ def test_feature_summary_round_trip(rng, tmp_path):
     assert stats[k0]["variance"] == pytest.approx(
         X[:, 0].var(ddof=1), rel=1e-4
     )
+
+
+def test_native_reader_matches_python(tmp_path, rng):
+    """The C++ fast path must be byte-identical to the pure-Python decoder:
+    same COO, scalars, id values, and index maps — including union-null
+    fields, terms, unknown-feature drops, and multi-file merges."""
+    from photon_ml_tpu.data import avro as A
+    from photon_ml_tpu.data.avro_native import read_game_arrays_native
+
+    n = 300
+    users = rng.integers(0, 9, size=n)
+
+    def recs(lo, hi):
+        for i in range(lo, hi):
+            feats = [
+                {"name": f"f{rng.integers(0, 40)}", "term": "t" if i % 3 else "",
+                 "value": float(rng.normal())}
+                for _ in range(int(rng.integers(1, 6)))
+            ]
+            yield {
+                "uid": str(i) if i % 4 else None,
+                "label": float(i % 2),
+                "features": feats,
+                "metadataMap": {"userId": str(users[i]), "junk": "x"},
+                "weight": 2.0 if i % 5 == 0 else None,
+                "offset": 0.25 if i % 7 == 0 else None,
+            }
+
+    p1 = str(tmp_path / "a.avro")
+    p2 = str(tmp_path / "b.avro")
+    write_avro(p1, TRAINING_EXAMPLE_AVRO, recs(0, 200))
+    write_avro(p2, TRAINING_EXAMPLE_AVRO, recs(200, 300), codec="null")
+
+    native = read_game_arrays_native(
+        [p1, p2], {"features": ("features",)}, None, ("userId",)
+    )
+    if native is None:
+        pytest.skip("native toolchain unavailable")
+
+    ds_native = A.read_game_dataset_from_avro(
+        [p1, p2], id_columns=("userId",)
+    )
+    # force the pure-Python path by making the program uncompilable is
+    # invasive; instead call the internal python loop via a monkeypatch
+    import photon_ml_tpu.data.avro_native as AN
+
+    orig = AN.read_game_arrays_native
+    AN.read_game_arrays_native = lambda *a, **k: None
+    try:
+        ds_python = A.read_game_dataset_from_avro(
+            [p1, p2], id_columns=("userId",)
+        )
+    finally:
+        AN.read_game_arrays_native = orig
+
+    np.testing.assert_array_equal(ds_native.response, ds_python.response)
+    np.testing.assert_array_equal(ds_native.offset, ds_python.offset)
+    np.testing.assert_array_equal(ds_native.weight, ds_python.weight)
+    in_ = ds_native.id_columns["userId"]
+    ip = ds_python.id_columns["userId"]
+    np.testing.assert_array_equal(in_.vocab[in_.codes], ip.vocab[ip.codes])
+    dn = np.asarray(ds_native.shard("features").to_dense())
+    dp = np.asarray(ds_python.shard("features").to_dense())
+    np.testing.assert_allclose(dn, dp, rtol=0, atol=0)
+
+
+def test_native_reader_missing_id_raises(tmp_path, rng):
+    from photon_ml_tpu.data import avro as A
+
+    path = str(tmp_path / "x.avro")
+    write_avro(path, TRAINING_EXAMPLE_AVRO, [
+        {"uid": "0", "label": 1.0,
+         "features": [{"name": "a", "term": "", "value": 1.0}],
+         "metadataMap": {}, "weight": None, "offset": None},
+    ])
+    with pytest.raises(KeyError, match="userId"):
+        A.read_game_dataset_from_avro(path, id_columns=("userId",))
