@@ -20,6 +20,20 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 # Keep CPU compiles single-threaded-ish and quiet for CI stability.
 os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+# Persistent XLA compile cache: the suite is compile-dominated (dozens of
+# while_loop optimizer programs). Env vars (read by jax at import) rather
+# than jax.config.update so CLI-subprocess tests inherit the SAME cache
+# through dict(os.environ); per-user path to avoid /tmp collisions on
+# shared hosts; a pre-set JAX_COMPILATION_CACHE_DIR wins.
+import getpass
+import tempfile
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(),
+                 f"photon_jax_cache_{getpass.getuser()}"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

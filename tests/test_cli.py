@@ -131,6 +131,7 @@ def _run_cli(args, cwd):
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+@pytest.mark.slow
 def test_cli_train_save_score_end_to_end(avro_dataset):
     tmp, train_path, score_path = avro_dataset
     config = {
@@ -213,6 +214,7 @@ def test_parse_coordinate_config_rejects_unknown_keys():
         )
 
 
+@pytest.mark.slow
 def test_cli_index_job(avro_dataset, tmp_path):
     """FeatureIndexingJob analog: scan avro -> persisted mmap index store."""
     from photon_ml_tpu.cli.index import main as index_main
@@ -277,6 +279,7 @@ def test_load_listener_specs():
     assert len(load_listeners([])) == 0
 
 
+@pytest.mark.slow
 def test_cli_train_config_driven_event_listener(avro_dataset):
     """--event-listeners analog: dotted-path listener specs in the train
     config are import-registered at driver startup (Driver.scala:110-118)."""

@@ -103,6 +103,7 @@ def test_peak_f1_perfect_classifier(rng):
 # -- bootstrap --------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_bootstrap_ci_covers_true_coefficients(rng):
     X, y, w_true, batch = _logistic(rng, n=1500, d=5)
     report = bootstrap_train(
@@ -137,6 +138,7 @@ def test_bootstrap_validates_args(rng):
 # -- fitting ----------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_fitting_diagnostic_holdout_improves_with_data(rng):
     X, y, w, batch = _logistic(rng, n=1200, d=8)
     report = fitting_diagnostic(
@@ -230,6 +232,7 @@ def test_prediction_error_independence_subsamples(rng):
 # -- reports ----------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_diagnose_model_renders_html_and_text(rng):
     X, y, w, batch = _logistic(rng, n=400)
     model = make_model("logistic", np.asarray(w, np.float32))
@@ -283,6 +286,7 @@ def test_report_line_plot_svg():
     assert "[plot] learning curve" in txt
 
 
+@pytest.mark.slow
 def test_fitting_report_sections_render(rng):
     X, y, w, batch = _logistic(rng, n=600, d=5)
     from photon_ml_tpu.diagnostics.fitting import fitting_report_sections  # noqa

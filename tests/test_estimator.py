@@ -6,6 +6,7 @@ Parity targets: GameEstimator.scala:76-398 (fit flow), NormalizationTest
 """
 
 import numpy as np
+import pytest
 
 from photon_ml_tpu.data.model_store import load_game_model, load_game_model_metadata
 from photon_ml_tpu.data.normalization import NormalizationType
@@ -53,6 +54,7 @@ def _glmix(rng, n=500, n_users=15):
     return gds
 
 
+@pytest.mark.slow
 def test_estimator_end_to_end_with_save(tmp_path, rng):
     gds = _glmix(rng)
     config = GameConfig(

@@ -43,6 +43,7 @@ def _l2(lam):
     )
 
 
+@pytest.mark.slow
 def test_fit_grid_sweeps_cartesian_product(rng):
     data, X, y, w = _data(rng)
     val, *_ = _data(rng, n=200)
@@ -171,6 +172,7 @@ def test_box_constraints_config_json_round_trip():
     assert parse_game_config(meta).coordinates["fixed"].optimizer == opt
 
 
+@pytest.mark.slow
 def test_event_bus_lifecycle(rng):
     data, *_ = _data(rng, n=150)
     val, *_ = _data(rng, n=100)

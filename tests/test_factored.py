@@ -92,6 +92,7 @@ def test_factored_re_alternation_reduces_training_loss(rng):
     assert model.projection.matrix.shape == (2, 30)
 
 
+@pytest.mark.slow
 def test_factored_beats_plain_re_on_holdout(rng):
     """The MF structure should generalize better than independent per-user
     fits when users have few rows and coefficients are truly low-rank."""
@@ -131,6 +132,7 @@ def test_factored_beats_plain_re_on_holdout(rng):
     assert val_rmse(mf_model) < val_rmse(re_model)
 
 
+@pytest.mark.slow
 def test_factored_in_game_with_fixed_effect(rng):
     """FE + factored RE trained by coordinate descent: the combination must
     fit global + low-rank per-user structure better than FE alone."""
@@ -284,6 +286,7 @@ def test_gaussian_projection_matrix_properties(rng):
     assert back.shape == (100,)
 
 
+@pytest.mark.slow
 def test_factored_mesh_matches_single_device(rng):
     """Entity-sharded latent RE solves + data-parallel latent refit over an
     8-device mesh must reproduce the single-device factored fit."""

@@ -81,6 +81,7 @@ def test_bucketing_roundtrip(rng):
                 assert np.isclose(Xu[grow, gcol], v, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_re_coordinate_matches_per_entity_solves(rng):
     gds, Xg, Xu, users, *_ = _glmix_data(rng, n=300, n_users=8)
     red = build_random_effect_dataset(gds, "userId", "user")
@@ -107,6 +108,7 @@ def test_re_coordinate_matches_per_entity_solves(rng):
         np.testing.assert_allclose(w_game, np.asarray(ref.w), rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_re_scores_match_dense_computation(rng):
     gds, Xg, Xu, users, *_ = _glmix_data(rng, n=250, n_users=7)
     red = build_random_effect_dataset(gds, "userId", "user")
@@ -132,6 +134,7 @@ def test_re_scores_match_dense_computation(rng):
         )
 
 
+@pytest.mark.slow
 def test_coordinate_descent_glmix_beats_fe_only(rng):
     gds, Xg, Xu, users, wg, wu = _glmix_data(rng, n=600, n_users=20)
     red = build_random_effect_dataset(gds, "userId", "user")
@@ -162,6 +165,7 @@ def test_coordinate_descent_glmix_beats_fe_only(rng):
     )
 
 
+@pytest.mark.slow
 def test_best_model_tracking(rng):
     gds, *_ = _glmix_data(rng, n=200, n_users=6)
     red = build_random_effect_dataset(gds, "userId", "user")
@@ -218,6 +222,7 @@ def test_unseen_entity_scores_zero(rng):
     np.testing.assert_allclose(s[:50], 0.0, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_fe_down_sampling_resamples_per_update(rng):
     """Regression (ADVICE r1-d): the FE coordinate must draw a FRESH negative
     down-sample on every update_model call (runWithSampling parity), not
@@ -339,6 +344,7 @@ def test_re_variances_match_hessian_diag(rng):
     assert all(b.variances is None for b in m2.buckets)
 
 
+@pytest.mark.slow
 def test_re_box_constraints_respected_and_match_reference(rng):
     """Per-entity solves honor GLOBAL-space box constraints through the
     index-map projection (SingleNodeOptimizationProblem.scala:124-139)."""

@@ -50,6 +50,7 @@ def test_stage_sequence_train_only(libsvm_files):
     assert len(summary["lambdas"]) == 3
 
 
+@pytest.mark.slow
 def test_stage_sequence_full_pipeline(libsvm_files):
     tmp, train, val = libsvm_files
     out = str(tmp / "out")
@@ -114,6 +115,7 @@ def test_driver_with_normalization(libsvm_files):
     assert summary["best_metric"] > 0.5
 
 
+@pytest.mark.slow
 def test_cli_glm_subprocess(libsvm_files):
     import subprocess
     import sys
@@ -135,6 +137,7 @@ def test_cli_glm_subprocess(libsvm_files):
     assert summary["stages"][-1] == "VALIDATED"
 
 
+@pytest.mark.slow
 def test_validation_feature_space_pinned_to_training(rng, tmp_path):
     """A validation file whose max feature id is smaller than training's
     must still align (num_features pinned; regression for the libsvm

@@ -28,6 +28,8 @@ from photon_ml_tpu.optim import (
 )
 from photon_ml_tpu.parallel import make_mesh
 
+pytestmark = pytest.mark.slow
+
 _OPT = OptimizerConfig(
     optimizer_type=OptimizerType.LBFGS,
     max_iterations=60,

@@ -10,6 +10,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from photon_ml_tpu.data.model_store import (
@@ -87,6 +88,7 @@ def test_glm_roundtrip(tmp_path, rng):
         np.asarray(m.coefficients.variances))
 
 
+@pytest.mark.slow
 def test_game_model_roundtrip_scores_identical(tmp_path, rng):
     gds, _ = _game_setup(rng)
     model = _train_game_model(gds)
@@ -137,6 +139,7 @@ def test_score_entry_point_with_unseen_entities(tmp_path, rng):
     assert not np.allclose(scores[~unseen], fe_scores[~unseen])
 
 
+@pytest.mark.slow
 def test_load_in_fresh_process(tmp_path, rng):
     gds, _ = _game_setup(rng, n=150, n_users=6)
     model = _train_game_model(gds)

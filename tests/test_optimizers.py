@@ -137,6 +137,7 @@ def test_box_constraints_projection_and_kkt(rng):
     assert np.all(g[w <= -0.1 + 1e-6] >= -1e-3 * scale)
 
 
+@pytest.mark.slow
 def test_vmap_batched_lbfgs_matches_individual(rng):
     # the random-effect pattern: vmap over K independent problems
     K, n, d = 5, 40, 6

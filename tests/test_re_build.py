@@ -5,6 +5,7 @@ be bulk-numpy, not per-entity Python)."""
 import time
 
 import numpy as np
+import pytest
 
 from photon_ml_tpu.game import build_game_dataset, build_random_effect_dataset
 from photon_ml_tpu.ops.sparse import SparseBatch
@@ -108,6 +109,7 @@ def test_cap_and_min_rows_vectorized(rng):
     assert n_active + len(red.passive_rows) == 500
 
 
+@pytest.mark.slow
 def test_build_rate_100k_entities_1m_rows(rng):
     """Ingest rate: 100K entities / 1M rows / ~10M nnz must build in bulk
     numpy time (seconds), not per-entity Python time (minutes)."""

@@ -95,6 +95,7 @@ def _chunked_entities(rng, n_ent=24, rows=10, k=6):
     return X, y
 
 
+@pytest.mark.slow
 def test_streaming_trainer_matches_direct_solves(rng):
     X, y = _chunked_entities(rng)
     n_ent, rows, k = X.shape
@@ -148,6 +149,7 @@ def test_streaming_warm_start_reuses_table(rng):
     np.testing.assert_allclose(table.to_numpy(), w1, rtol=1e-3, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_sharded_table_matches_single_device(rng):
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-device virtual CPU mesh")

@@ -52,6 +52,7 @@ def test_sweep_matches_individual_solves(rng):
         )
 
 
+@pytest.mark.slow
 def test_warm_start_beats_cold_start_iterations(rng):
     X, y, batch = _logistic_data(rng, n=600)
     lambdas = [100.0, 10.0, 1.0, 0.1, 0.01]
@@ -207,6 +208,7 @@ def test_owlqn_sweep_sparsity_increases_with_lambda(rng):
     assert nnz_hi < nnz_lo
 
 
+@pytest.mark.slow
 def test_sweep_on_mesh_matches_single_device(rng):
     from photon_ml_tpu.parallel.mesh import make_mesh, shard_rows
 
