@@ -167,6 +167,10 @@ class FixedEffectCoordinate:
                     int(stacked_host.labels.shape[1]),
                 )
             self._stacked = put_sharded(stacked_host, self.mesh, self._axis)
+        elif not self._use_tiled:
+            # single-device COO solve path: upload the design ONCE; per-row
+            # updates (offsets/weights) are swapped onto this device copy
+            self._solve_batch = self._base_batch.device()
 
     def _downsampled_weights(self, batch, update_index: int):
         rate = self.config.down_sampling_rate
@@ -280,9 +284,20 @@ class FixedEffectCoordinate:
                 )
             res = self._solver(self._obj, batch, w0, self._l1, self._constraints)
         else:
-            batch = self._maybe_downsample(self._base_batch, update_index)
+            batch = self._solve_batch
+            if self.config.down_sampling_rate < 1.0:
+                # weights are drawn from the HOST base batch (transfer-free
+                # reads); only the fresh [n] weight vector is uploaded
+                batch = dataclasses.replace(
+                    batch,
+                    weights=self._downsampled_weights(
+                        self._base_batch, update_index
+                    ),
+                )
             if residual_scores is not None:
-                batch = batch.with_offsets(batch.offsets + residual_scores)
+                batch = batch.with_offsets(
+                    self._base_batch.offsets + residual_scores
+                )
             res = self._solver(self._obj, batch, w0, self._l1, self._constraints)
         w = res.w
         from photon_ml_tpu.optim.trackers import FixedEffectOptimizationTracker
@@ -444,6 +459,8 @@ class RandomEffectCoordinate:
                 "coefficient variances need a twice-differentiable loss; "
                 f"'{self.loss_name}' is not"
             )
+        # one shared HBM copy of the bucket stacks (datasets build host-side)
+        self._buckets = self.re_data.device_buckets()
         # Box constraints are declared against GLOBAL feature ids
         # (OptimizerConfig constraintMap); each entity's local space is an
         # index-map renumbering (local k <-> global projection[e, k]), so the
@@ -495,7 +512,7 @@ class RandomEffectCoordinate:
                 projection=b.projection,
                 entity_codes=b.entity_codes,
             )
-            for b in self.re_data.buckets
+            for b in self._buckets
         )
         return RandomEffectModel(
             id_name=self.re_data.id_name,
@@ -516,7 +533,7 @@ class RandomEffectCoordinate:
         tracker_reasons = []
         tracker_vals = []
         n_dev = 0 if self.mesh is None else int(self.mesh.devices.size)
-        for i, (b, bm) in enumerate(zip(self.re_data.buckets, model.buckets)):
+        for i, (b, bm) in enumerate(zip(self._buckets, model.buckets)):
             bucket = (
                 b if residual_scores is None else b.with_extra_offsets(residual_scores)
             )
@@ -558,7 +575,7 @@ class RandomEffectCoordinate:
         model searchsorted path for passive rows."""
         n_pad = self.data.shard(self.re_data.shard_name).num_rows
         scores = jnp.zeros((n_pad,), jnp.float32)
-        for b, bm in zip(self.re_data.buckets, model.buckets):
+        for b, bm in zip(self._buckets, model.buckets):
             margins = self._scorer(bm.coefficients, b.entity_batch())  # [E, R]
             idx = b.row_index.reshape(-1)
             vals = margins.reshape(-1)

@@ -16,7 +16,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Mapping, Optional, Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.ops.sparse import SparseBatch
@@ -77,7 +76,7 @@ class GameDataset:
         def pad(a, fill=0.0):
             out = np.full((n_pad,), fill)
             out[: self.num_rows] = a
-            return jnp.asarray(out, b.dtype)
+            return out.astype(b.dtype)  # host; consumers upload once
 
         return dataclasses.replace(
             b,

@@ -469,7 +469,7 @@ class FactoredRandomEffectCoordinate:
         k = self._proj_rows
         parts = []
         t_its, t_reasons, t_vals = [], [], []
-        for b_idx, b in enumerate(self.re_data.buckets):
+        for b_idx, b in enumerate(self.re_data.device_buckets()):
             bucket = b if residual is None else b.with_extra_offsets(residual)
             E, R = b.num_entities, b.rows_per_entity
             X = _latent_design_fn(R)(
@@ -600,7 +600,7 @@ class FactoredRandomEffectCoordinate:
         a_ext = model.projection.extended()
         n_pad = self._batch.num_rows
         scores = jnp.zeros((n_pad,), jnp.float32)
-        for b_idx, b in enumerate(self.re_data.buckets):
+        for b_idx, b in enumerate(self.re_data.device_buckets()):
             R = b.rows_per_entity
             X = _latent_design_fn(R)(
                 b.values, b.rows, b.cols, b.projection, a_ext

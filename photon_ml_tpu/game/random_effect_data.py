@@ -111,6 +111,15 @@ class RandomEffectDataset:
     passive_rows: np.ndarray  # i64[num_passive] global example rows
     num_global_features: int
 
+    def device_buckets(self) -> tuple[EntityBucket, ...]:
+        """Device copies of the buckets, uploaded once and cached — every
+        coordinate/fit over this dataset shares one HBM copy."""
+        cached = self.__dict__.get("_device_buckets")
+        if cached is None:
+            cached = tuple(jax.device_put(b) for b in self.buckets)
+            object.__setattr__(self, "_device_buckets", cached)
+        return cached
+
     def to_summary_string(self) -> str:
         """RandomEffectDataSet.toSummaryString analog (:174-197): per-bucket
         geometry + active/passive split."""
@@ -223,6 +232,7 @@ def build_random_effect_dataset(
     num_global = batch.num_features
     rng = np.random.default_rng(seed)
 
+    np_dtype = np.dtype(dtype)
     vals = np.asarray(batch.values)
     rows = np.asarray(batch.rows)
     cols = np.asarray(batch.cols)
@@ -387,17 +397,19 @@ def build_random_effect_dataset(
         p_s = proj_slot[psel]
         bp[p_e, p_s] = proj_col[psel]
 
+        # leaves stay HOST numpy (transfer-free build; coordinates upload
+        # once via RandomEffectDataset.device_buckets)
         buckets.append(
             EntityBucket(
-                values=jnp.asarray(bv, dtype),
-                rows=jnp.asarray(br),
-                cols=jnp.asarray(bc),
-                labels=jnp.asarray(bl, dtype),
-                offsets=jnp.asarray(bo, dtype),
-                weights=jnp.asarray(bw, dtype),
-                projection=jnp.asarray(bp),
-                entity_codes=jnp.asarray(bcode),
-                row_index=jnp.asarray(brix),
+                values=bv.astype(np_dtype),
+                rows=br,
+                cols=bc,
+                labels=bl.astype(np_dtype),
+                offsets=bo.astype(np_dtype),
+                weights=bw.astype(np_dtype),
+                projection=bp,
+                entity_codes=bcode,
+                row_index=brix,
                 num_local_features=K,
                 num_global_features=num_global,
             )

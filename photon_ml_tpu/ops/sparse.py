@@ -68,6 +68,11 @@ class SparseBatch:
     offsets and weights are columnar arrays, and features are one padded COO
     block. ``num_features`` is static so downstream gradient shapes are fixed
     under jit.
+
+    Leaves may be HOST numpy arrays (what the constructors produce) or
+    device arrays: host batches make the data plane (grouping, tiling,
+    stats) transfer-free, and a solve path uploads once via :meth:`device`
+    (or implicitly at a jit boundary).
     """
 
     values: Array  # f[nnz_pad] feature values (0 in padding)
@@ -132,17 +137,32 @@ class SparseBatch:
             np.ones(n) if weights is None else np.asarray(weights, np.float64), n_pad
         )
 
+        # leaves stay HOST numpy (dtype applied host-side): construction is
+        # transfer-free, and consumers upload exactly once where the batch
+        # is actually solved/scored (see .device()). This keeps the
+        # host-side data plane (RE grouping, tiling, stats, ingest) off the
+        # PCIe link entirely.
+        np_dtype = np.dtype(dtype)
         return SparseBatch(
-            values=jnp.asarray(_pad(np.asarray(values, np.float64), nnz_pad), dtype),
-            rows=jnp.asarray(
-                _pad(rows.astype(np.int64), nnz_pad, fill=n_pad - 1), jnp.int32
+            values=_pad(np.asarray(values, np.float64), nnz_pad).astype(np_dtype),
+            rows=_pad(rows.astype(np.int64), nnz_pad, fill=n_pad - 1).astype(
+                np.int32
             ),
-            cols=jnp.asarray(_pad(cols.astype(np.int64), nnz_pad), jnp.int32),
-            labels=jnp.asarray(labels_p, dtype),
-            offsets=jnp.asarray(offsets_p, dtype),
-            weights=jnp.asarray(weights_p, dtype),
+            cols=_pad(cols.astype(np.int64), nnz_pad).astype(np.int32),
+            labels=labels_p.astype(np_dtype),
+            offsets=offsets_p.astype(np_dtype),
+            weights=weights_p.astype(np_dtype),
             num_features=int(num_features),
         )
+
+    def device(self, sharding=None) -> "SparseBatch":
+        """Upload every leaf (no-op for leaves already on device)."""
+        put = (
+            jax.device_put
+            if sharding is None
+            else (lambda x: jax.device_put(x, sharding))
+        )
+        return jax.tree.map(put, self)
 
     @staticmethod
     def from_dense(
@@ -302,12 +322,12 @@ class SparseBatch:
             raise ValueError("pad target smaller than current size")
 
         return SparseBatch(
-            values=jnp.asarray(_pad(self.values, nnz_pad)),
-            rows=jnp.asarray(_pad(self.rows, nnz_pad, fill=n_pad - 1)),
-            cols=jnp.asarray(_pad(self.cols, nnz_pad)),
-            labels=jnp.asarray(_pad(self.labels, n_pad)),
-            offsets=jnp.asarray(_pad(self.offsets, n_pad)),
-            weights=jnp.asarray(_pad(self.weights, n_pad)),
+            values=_pad(self.values, nnz_pad),
+            rows=_pad(self.rows, nnz_pad, fill=n_pad - 1),
+            cols=_pad(self.cols, nnz_pad),
+            labels=_pad(self.labels, n_pad),
+            offsets=_pad(self.offsets, n_pad),
+            weights=_pad(self.weights, n_pad),
             num_features=self.num_features,
         )
 
@@ -330,11 +350,11 @@ def concat_batches(batches: Sequence[SparseBatch]) -> SparseBatch:
         weights.append(np.asarray(b.weights))
         row_base += b.num_rows
     return SparseBatch(
-        values=jnp.asarray(np.concatenate(vals)),
-        rows=jnp.asarray(np.concatenate(rows), jnp.int32),
-        cols=jnp.asarray(np.concatenate(cols), jnp.int32),
-        labels=jnp.asarray(np.concatenate(labels)),
-        offsets=jnp.asarray(np.concatenate(offsets)),
-        weights=jnp.asarray(np.concatenate(weights)),
+        values=np.concatenate(vals),
+        rows=np.concatenate(rows).astype(np.int32),
+        cols=np.concatenate(cols).astype(np.int32),
+        labels=np.concatenate(labels),
+        offsets=np.concatenate(offsets),
+        weights=np.concatenate(weights),
         num_features=num_features,
     )
