@@ -123,10 +123,60 @@ def read_input(
     raise ValueError(f"unknown input format '{fmt}'")
 
 
+def _init_distributed_and_mesh(config: Mapping):
+    """Join a multi-host fleet and build the training mesh when configured.
+
+    Config keys (both optional):
+      "distributed": {"coordinator_address", "num_processes", "process_id"}
+        — explicit fleet wiring; omitted fields fall back to PHOTON_ML_*
+        env vars, and on TPU pods everything auto-detects
+        (SparkContextConfiguration.asYarnClient analog).
+      "mesh": true/"auto" for a 1-D mesh over all (global) devices, or
+        {"axis": size, ...} for an explicit shape.
+    """
+    from photon_ml_tpu.parallel import multihost
+
+    dist = config.get("distributed")
+    if dist is not None:
+        env = multihost.DistributedConfig.from_env()
+        multihost.initialize(
+            multihost.DistributedConfig(
+                coordinator_address=dist.get(
+                    "coordinator_address", env.coordinator_address
+                ),
+                num_processes=dist.get("num_processes", env.num_processes),
+                process_id=dist.get("process_id", env.process_id),
+                auto=bool(dist.get("auto", env.auto)),
+            )
+        )
+    if multihost.is_multiprocess():
+        # The estimator pipeline is single-controller: it reads the whole
+        # input and device_puts process-local arrays, which is wrong (and
+        # rejected by jax) across processes. Multi-host training drives
+        # the per-process APIs instead (multihost.process_slice /
+        # host_local_array / game.streaming.LocalChunk — see README
+        # "Multi-host deployment"); the CLI stops here rather than train
+        # one divergent model per host.
+        raise NotImplementedError(
+            "the `train` CLI does not span processes yet; write a worker "
+            "with the per-process APIs (README 'Multi-host deployment')"
+        )
+    mesh_spec = config.get("mesh")
+    if not mesh_spec and dist is None:
+        return None
+    from photon_ml_tpu.parallel import make_mesh
+
+    if mesh_spec in (None, True, "auto") or mesh_spec is False:
+        # a configured fleet defaults to a 1-D 'data' mesh over all devices
+        return None if mesh_spec is False else make_mesh()
+    return make_mesh({k: int(v) for k, v in mesh_spec.items()})
+
+
 def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     """Execute the training pipeline; returns a JSON-safe summary."""
     game_config = parse_game_config(config)
     output_dir = output_dir or config.get("output_dir")
+    mesh = _init_distributed_and_mesh(config)
 
     with timed("read training data"):
         train_data, index_maps = read_input(config["input"])
@@ -150,6 +200,7 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
             train_data,
             validation_data=validation_data,
             output_dir=output_dir,
+            mesh=mesh,
         )
 
     if output_dir is not None and index_maps is not None:

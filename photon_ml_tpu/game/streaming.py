@@ -94,24 +94,72 @@ class ShardedCoefficientTable:
                     f"{n_dev}-device '{axis}' axis (pad the entity count)"
                 )
             self.sharding = NamedSharding(mesh, P(axis, None))
-            self.coefficients = jax.device_put(
-                jnp.zeros((num_entities, dim), dtype), self.sharding
-            )
+            # jit-with-out_shardings materializes the zeros directly in
+            # their sharded layout — no host/full-device copy, and it is
+            # multi-controller-safe (every process runs the same program
+            # and owns only its shards).
+            self.coefficients = jax.jit(
+                partial(jnp.zeros, (num_entities, dim), dtype),
+                out_shardings=self.sharding,
+            )()
 
     @property
     def nbytes(self) -> int:
         return self.num_entities * self.dim * self.coefficients.dtype.itemsize
 
+    def _check_bounds(self, start: int, size: int) -> None:
+        # dynamic_(update_)slice silently CLAMPS an out-of-range start, which
+        # would read/write the wrong entity rows — fail loudly instead.
+        if start < 0 or size < 0 or start + size > self.num_entities:
+            raise ValueError(
+                f"chunk [{start}, {start + size}) out of bounds for table "
+                f"of {self.num_entities} entities"
+            )
+
     def write_chunk(self, start: int, w: Array) -> None:
+        self._check_bounds(start, int(w.shape[0]))
         self.coefficients = _chunk_writer(True)(
             self.coefficients, w, jnp.int32(start)
         )
 
     def read_chunk(self, start: int, size: int) -> Array:
+        self._check_bounds(start, size)
         return _read_chunk(self.coefficients, jnp.int32(start), size)
 
     def to_numpy(self) -> np.ndarray:
-        return np.asarray(self.coefficients)
+        """Full table on the host; multi-process this all-gathers, so use
+        it for models/summaries, or prefer :meth:`local_shard` at scale."""
+        from photon_ml_tpu.parallel.multihost import gather_to_host
+
+        return gather_to_host(self.coefficients)
+
+    def local_shard(self) -> tuple[int, np.ndarray]:
+        """(global row offset, rows) of THIS process's table shard —
+        per-host checkpointing without ever assembling the global table."""
+        if self.sharding is None:
+            return 0, np.asarray(self.coefficients)
+        shards = sorted(
+            self.coefficients.addressable_shards,
+            key=lambda s: s.index[0].start or 0,
+        )
+        lo = shards[0].index[0].start or 0
+        return int(lo), np.concatenate([np.asarray(s.data) for s in shards])
+
+
+@dataclasses.dataclass
+class LocalChunk:
+    """A chunk supplied as PROCESS-LOCAL rows in a multi-host fleet.
+
+    Each process passes only the entities it ingested (its
+    ``process_slice`` of the chunk's global [start, start+global_size)
+    range); the trainer assembles the global sharded batch with
+    ``make_array_from_process_local_data`` — no host ever holds the whole
+    chunk. This is the executor-local-partition analog
+    (RandomEffectDataSet.scala:209-246 reads per-partition on executors).
+    """
+
+    batch: DenseBatch  # numpy leaves, leading dim = this process's rows
+    global_size: int  # entities in the chunk across ALL processes
 
 
 @dataclasses.dataclass
@@ -184,6 +232,18 @@ class StreamingRandomEffectTrainer:
     def _prepare(self, source) -> DenseBatch:
         if callable(source):
             return source()
+        if isinstance(source, LocalChunk):
+            if self._sharding is None:
+                return jax.tree.map(jax.device_put, source.batch)
+            gsize = int(source.global_size)
+
+            def put_local(x):
+                return jax.make_array_from_process_local_data(
+                    self._sharding, np.asarray(x),
+                    global_shape=(gsize,) + tuple(np.shape(x))[1:],
+                )
+
+            return jax.tree.map(put_local, source.batch)
         if isinstance(source, DenseBatch):
             leaves = jax.tree.leaves(source)
             if leaves and isinstance(leaves[0], np.ndarray):

@@ -9,3 +9,12 @@ from photon_ml_tpu.parallel.mesh import (  # noqa: F401
     put_sharded,
     shard_rows,
 )
+from photon_ml_tpu.parallel.multihost import (  # noqa: F401
+    DistributedConfig,
+    gather_to_host,
+    global_mesh,
+    host_local_array,
+    initialize,
+    is_multiprocess,
+    process_slice,
+)

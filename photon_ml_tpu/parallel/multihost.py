@@ -1,0 +1,242 @@
+"""Multi-host (multi-process) execution: jax.distributed wiring and
+per-process sharded data placement.
+
+Reference analog: the reference's defining trait is spanning a *cluster of
+machines* — ``SparkContextConfiguration.asYarnClient`` provisions a YARN
+app over many hosts (/root/reference/photon-api/src/main/scala/com/linkedin/
+photon/ml/SparkContextConfiguration.scala:40-107), and the "hundreds of
+billions of coefficients" claim (/root/reference/README.md:73) only fits in
+many machines' memory. The TPU-native answer:
+
+  - ONE JAX process per host, connected through jax.distributed's
+    coordination service (the GRPC analog of the Spark driver<->executor
+    control plane). On a TPU pod slice, ``jax.distributed.initialize()``
+    auto-detects everything from the TPU environment; off-pod (CPU fleets,
+    tests) the coordinator address / process count / process id come from
+    :class:`DistributedConfig` or ``PHOTON_ML_*`` env vars.
+  - A GLOBAL :class:`~jax.sharding.Mesh` spans every process's devices
+    (``jax.devices()`` is process-major). Collectives ride ICI inside a
+    slice and DCN across slices — XLA picks the transport; nothing in the
+    framework changes between one host and many.
+  - Each process ingests and uploads ONLY its own row/entity range
+    (:func:`process_slice`, :func:`host_local_array` — built on
+    ``jax.make_array_from_process_local_data``). That is the analog of the
+    reference's executor-local partition reads + the bin-packing
+    entity->partition placement (RandomEffectDataSetPartitioner.scala:42-148):
+    entity ranges are contiguous per process, so a process's table shard is
+    co-located with the data it ingested, and per-entity solves stay
+    collective-free.
+
+Tested without TPU hardware by ``__graft_entry__.dryrun_multichip``: two
+OS processes x four virtual CPU devices each form one 8-device global mesh
+(gloo CPU collectives), and the streamed sharded-table fit matches the
+single-process 8-device run bit-for-tolerance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_ENV_COORDINATOR = "PHOTON_ML_COORDINATOR"
+_ENV_NUM_PROCESSES = "PHOTON_ML_NUM_PROCESSES"
+_ENV_PROCESS_ID = "PHOTON_ML_PROCESS_ID"
+_ENV_AUTO = "PHOTON_ML_AUTO_DISTRIBUTED"
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """Where this process sits in the fleet.
+
+    Three modes:
+      - all fields default: no-op — single host, nothing to join;
+      - ``auto=True``: ``jax.distributed.initialize()`` with no arguments —
+        the TPU-pod path, where topology/coordinator come from the TPU
+        runtime environment;
+      - explicit ``coordinator_address`` + ``num_processes`` +
+        ``process_id``: CPU/GPU fleets and multi-process tests.
+    """
+
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    local_device_ids: Optional[tuple[int, ...]] = None
+    auto: bool = False  # TPU-pod auto-detection
+
+    @classmethod
+    def from_env(cls) -> "DistributedConfig":
+        addr = os.environ.get(_ENV_COORDINATOR)
+        nproc = os.environ.get(_ENV_NUM_PROCESSES)
+        pid = os.environ.get(_ENV_PROCESS_ID)
+        auto = os.environ.get(_ENV_AUTO, "").lower() in ("1", "true", "yes")
+        return cls(
+            coordinator_address=addr,
+            num_processes=int(nproc) if nproc else None,
+            process_id=int(pid) if pid else None,
+            auto=auto,
+        )
+
+    @property
+    def is_explicit(self) -> bool:
+        return self.coordinator_address is not None
+
+    def validate(self) -> None:
+        if self.auto and self.is_explicit:
+            raise ValueError(
+                "auto=True (pod auto-detection) conflicts with an explicit "
+                "coordinator_address"
+            )
+        if self.is_explicit:
+            if self.num_processes is None or self.process_id is None:
+                raise ValueError(
+                    "distributed config with a coordinator_address needs "
+                    "num_processes and process_id too"
+                )
+            if not (0 <= self.process_id < self.num_processes):
+                raise ValueError(
+                    f"process_id {self.process_id} out of range for "
+                    f"{self.num_processes} processes"
+                )
+        elif self.num_processes is not None and self.num_processes > 1:
+            raise ValueError(
+                "num_processes > 1 needs either a coordinator_address "
+                "(explicit fleet) or auto=True (TPU pod)"
+            )
+
+
+def initialize(config: Optional[DistributedConfig] = None) -> None:
+    """Connect this process to the fleet (idempotent).
+
+    Must run before the first jax computation. Single-process callers may
+    skip it entirely; :func:`global_mesh` works either way.
+    """
+    global _initialized
+    if _initialized:
+        return
+    cfg = config if config is not None else DistributedConfig.from_env()
+    cfg.validate()
+    if cfg.is_explicit:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+            local_device_ids=cfg.local_device_ids,
+        )
+        _initialized = True
+    elif cfg.auto:
+        # TPU pod: topology/coordinator come from the TPU runtime env.
+        jax.distributed.initialize()
+        _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def global_mesh(
+    axis_sizes: Optional[dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh over ALL processes' devices, process-major on the leading axis.
+
+    ``jax.devices()`` orders devices by process index, so a 1-D mesh (or the
+    leading axis of a 2-D one) assigns each process a CONTIGUOUS block of
+    that axis — the property :func:`process_slice` relies on for co-locating
+    entity table shards with per-process ingestion.
+    """
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axis_sizes, devices=devices)
+
+
+def process_slice(total: int, mesh: Mesh, axis: str) -> tuple[int, int]:
+    """[lo, hi) range of global rows this process owns when an array of
+    ``total`` rows is sharded evenly over ``axis`` of ``mesh``.
+
+    Requires the mesh's ``axis`` to be process-major (true for
+    :func:`global_mesh`) and ``total`` divisible by the axis size.
+    """
+    axis_size = mesh.shape[axis]
+    if total % axis_size:
+        raise ValueError(
+            f"total={total} must divide over the {axis_size}-device "
+            f"'{axis}' axis"
+        )
+    per_device = total // axis_size
+    # devices along `axis` for fixed other-axis coordinates; process-major
+    axes = list(mesh.axis_names)
+    dev_grid = np.moveaxis(mesh.devices, axes.index(axis), 0)
+    dev_line = dev_grid.reshape(dev_grid.shape[0], -1)[:, 0]
+    mine = [i for i, d in enumerate(dev_line) if d.process_index == jax.process_index()]
+    if not mine:
+        return (0, 0)
+    if mine != list(range(mine[0], mine[-1] + 1)):
+        raise ValueError(
+            f"devices of process {jax.process_index()} are not contiguous "
+            f"along axis '{axis}'; use global_mesh() ordering"
+        )
+    return (mine[0] * per_device, (mine[-1] + 1) * per_device)
+
+
+def host_local_array(
+    local: np.ndarray,
+    mesh: Mesh,
+    spec: P,
+    global_shape: Optional[tuple[int, ...]] = None,
+) -> jax.Array:
+    """Assemble a global sharded array from this process's LOCAL rows.
+
+    ``local`` holds only the rows this process owns (its
+    :func:`process_slice` of the leading axis). Single-process, this is just
+    a sharded device_put. Multi-process, no host ever materializes the
+    global array — the Spark-free analog of an RDD whose partitions live
+    where they were read.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, local, global_shape=global_shape
+    )
+
+
+def replicate_to_all(value: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Replicate a host value identically across every process's devices
+    (broadcast analog). All processes must pass the same value."""
+    sharding = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(value), global_shape=np.shape(value)
+    )
+
+
+def gather_to_host(arr: jax.Array) -> np.ndarray:
+    """Fetch a (possibly cross-process) sharded array fully to every host.
+
+    Single-process this is a plain np.asarray. Multi-process it reshards to
+    fully-replicated (XLA all-gather over ICI/DCN) and reads the local
+    copy, so use it for summaries/models, not bulk data.
+    """
+    if jax.process_count() == 1 or getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    mesh = arr.sharding.mesh
+    replicated = jax.jit(
+        lambda x: x, out_shardings=NamedSharding(mesh, P())
+    )(arr)
+    return np.asarray(replicated.addressable_data(0))
