@@ -1,0 +1,178 @@
+// Native TrainingExampleAvro block writer — the fixture-generation side of
+// the ingestion path (photon_ml_tpu.data.avro.write_training_examples_fast).
+//
+// The Python writer (data/avro.py write_avro) walks the schema per record
+// at ~16K rows/s; generating north-star-scale fixtures (20M rows) needs
+// ~100x that. This encoder appends record BLOCKS to a container whose
+// header (magic, schema JSON, codec=null, sync) Python already wrote —
+// the record wire format mirrors data/avro.py _encode for the
+// TrainingExampleAvro shape exactly:
+//   uid: union[null,string]      -> branch 0 (null)
+//   label: double                -> 8 bytes LE
+//   features: array<FeatureAvro> -> count, (name,term,value)*, 0
+//   metadataMap: union[null,map] -> branch 1, count, (key,val)*, 0
+//   weight/offset: union[null,double] -> branch 0
+//
+// Reference analog: the reference ships fixtures and converts LibSVM via
+// dev-scripts/libsvm_text_to_trainingexample_avro.py; generation-at-scale
+// is a bench-infrastructure need unique to this repo.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_enc_error;
+
+inline void put_zigzag(std::string& out, int64_t v) {
+  uint64_t u = (static_cast<uint64_t>(v) << 1) ^
+               static_cast<uint64_t>(v >> 63);
+  while (u >= 0x80) {
+    out.push_back(static_cast<char>((u & 0x7F) | 0x80));
+    u >>= 7;
+  }
+  out.push_back(static_cast<char>(u));
+}
+
+inline void put_str(std::string& out, const char* p, int64_t n) {
+  put_zigzag(out, n);
+  out.append(p, static_cast<size_t>(n));
+}
+
+inline void put_double(std::string& out, double v) {
+  char buf[8];
+  std::memcpy(buf, &v, 8);
+  out.append(buf, 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* avro_encode_last_error() { return g_enc_error.c_str(); }
+
+// Append blocks of TrainingExampleAvro-shaped records to `path` (opened
+// append). The record carries n_bags feature arrays between label and
+// metadataMap (the multi-shard GameDatum featureShardContainer analog);
+// bag b's features for row r are feat_name_id/feat_vals[
+// feat_starts[b*(n_rows+1)+r] : feat_starts[b*(n_rows+1)+r+1]] (absolute
+// into the flat arrays) with names resolved through (name_bytes,
+// name_offs); terms are always "".
+// id columns become metadataMap entries: key strings in
+// (id_key_bytes, id_key_offs); per-row values resolved from each column's
+// vocab via id_codes (laid out [n_ids][n_rows]); per-column vocab c's
+// strings live at id_vocab_offs[vocab_base[c] + code .. +1] into
+// id_vocab_bytes.
+// Returns rows written, or -1 (avro_encode_last_error()).
+int64_t avro_write_training_blocks(
+    const char* path, int64_t n_rows, const double* labels,
+    int32_t n_bags, const int64_t* feat_starts,
+    const int32_t* feat_name_id, const double* feat_vals,
+    const uint8_t* name_bytes, const int64_t* name_offs, int32_t n_ids,
+    const uint8_t* id_key_bytes, const int64_t* id_key_offs,
+    const int64_t* id_codes, const uint8_t* id_vocab_bytes,
+    const int64_t* id_vocab_offs, const int64_t* id_vocab_counts,
+    int64_t block_records, const uint8_t* sync) {
+  g_enc_error.clear();
+  FILE* f = std::fopen(path, "ab");
+  if (!f) {
+    g_enc_error = "cannot open for append";
+    return -1;
+  }
+  // per-column base into the flat id_vocab_offs table (counts+1 slots each)
+  int64_t vocab_base[64];
+  if (n_ids > 64) {
+    g_enc_error = "too many id columns";
+    std::fclose(f);
+    return -1;
+  }
+  int64_t base = 0;
+  for (int32_t c = 0; c < n_ids; ++c) {
+    vocab_base[c] = base;
+    base += id_vocab_counts[c] + 1;
+  }
+
+  std::string block;
+  std::string head;
+  block.reserve(static_cast<size_t>(block_records) * 192);
+  int64_t written = 0;
+  int64_t n_in_block = 0;
+
+  auto flush = [&]() -> bool {
+    if (n_in_block == 0) return true;
+    head.clear();
+    put_zigzag(head, n_in_block);
+    put_zigzag(head, static_cast<int64_t>(block.size()));
+    if (std::fwrite(head.data(), 1, head.size(), f) != head.size() ||
+        std::fwrite(block.data(), 1, block.size(), f) != block.size() ||
+        std::fwrite(sync, 1, 16, f) != 16) {
+      g_enc_error = "write failed";
+      return false;
+    }
+    block.clear();
+    n_in_block = 0;
+    return true;
+  };
+
+  for (int64_t r = 0; r < n_rows; ++r) {
+    put_zigzag(block, 0);  // uid: null branch
+    put_double(block, labels[r]);
+    for (int32_t b = 0; b < n_bags; ++b) {
+      const int64_t* bs = feat_starts + static_cast<int64_t>(b) * (n_rows + 1);
+      int64_t lo = bs[r], hi = bs[r + 1];
+      if (hi > lo) {
+        put_zigzag(block, hi - lo);
+        for (int64_t k = lo; k < hi; ++k) {
+          int64_t nid = feat_name_id[k];
+          put_str(block,
+                  reinterpret_cast<const char*>(name_bytes) + name_offs[nid],
+                  name_offs[nid + 1] - name_offs[nid]);
+          put_zigzag(block, 0);  // term ""
+          put_double(block, feat_vals[k]);
+        }
+      }
+      put_zigzag(block, 0);  // feature array end
+    }
+    if (n_ids > 0) {
+      put_zigzag(block, 1);  // metadataMap: map branch
+      put_zigzag(block, n_ids);
+      for (int32_t c = 0; c < n_ids; ++c) {
+        put_str(block,
+                reinterpret_cast<const char*>(id_key_bytes) + id_key_offs[c],
+                id_key_offs[c + 1] - id_key_offs[c]);
+        int64_t code = id_codes[static_cast<int64_t>(c) * n_rows + r];
+        if (code < 0 || code >= id_vocab_counts[c]) {
+          g_enc_error = "id code out of vocab range (row " +
+                        std::to_string(r) + ")";
+          std::fclose(f);
+          return -1;
+        }
+        const int64_t* offs = id_vocab_offs + vocab_base[c];
+        put_str(block,
+                reinterpret_cast<const char*>(id_vocab_bytes) + offs[code],
+                offs[code + 1] - offs[code]);
+      }
+      put_zigzag(block, 0);  // map end
+    } else {
+      put_zigzag(block, 0);  // metadataMap: null branch
+    }
+    put_zigzag(block, 0);  // weight: null
+    put_zigzag(block, 0);  // offset: null
+    ++n_in_block;
+    ++written;
+    if (n_in_block >= block_records && !flush()) {
+      std::fclose(f);
+      return -1;
+    }
+  }
+  if (!flush()) {
+    std::fclose(f);
+    return -1;
+  }
+  std::fclose(f);
+  return written;
+}
+
+}  // extern "C"

@@ -304,6 +304,115 @@ def write_avro(
     return count_total
 
 
+def training_example_schema(bag_names: "Sequence[str]" = ("features",)) -> dict:
+    """TrainingExampleAvro generalized to several feature bags (the
+    multi-shard featureShardContainer analog): one array<FeatureAvro>
+    field per bag, in order, between label and metadataMap."""
+    if tuple(bag_names) == ("features",):
+        return TRAINING_EXAMPLE_AVRO
+    fields = [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "label", "type": "double"},
+    ]
+    for i, b in enumerate(bag_names):
+        item = FEATURE_AVRO if i == 0 else "FeatureAvro"
+        fields.append({"name": b, "type": {"type": "array", "items": item}})
+    fields += [
+        {
+            "name": "metadataMap",
+            "type": ["null", {"type": "map", "values": "string"}],
+            "default": None,
+        },
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+    ]
+    return {
+        "name": "TrainingExampleAvro", "type": "record", "fields": fields
+    }
+
+
+def write_training_examples_fast(
+    path: str,
+    labels: np.ndarray,
+    bags: "Mapping[str, tuple[np.ndarray, np.ndarray, np.ndarray]]",
+    feature_names: "Sequence[str]",
+    id_columns: "Mapping[str, tuple[np.ndarray, Sequence[str]]]",
+    block_records: int = 65536,
+    sync: bytes = b"photon-ml-tpu-s!",
+) -> int:
+    """Columnar TrainingExampleAvro writer (~100x the per-record python
+    path). ``bags`` maps feature-bag field name -> (starts[n+1], name_id,
+    vals): row r of bag carries features name_id/vals[starts[r]:
+    starts[r+1]] (term always ""); ``id_columns`` maps metadataMap key ->
+    (codes, vocab). Python writes the container header (schema from
+    :func:`training_example_schema`); native/avro_encode.cpp appends the
+    record blocks (codec null). Falls back to the per-record python
+    writer when the native toolchain is unavailable."""
+    from photon_ml_tpu.data.avro_native import write_training_blocks_native
+
+    schema = training_example_schema(list(bags))
+    with open(path + ".tmp", "wb") as f:
+        f.write(_MAGIC)
+        meta = io.BytesIO()
+        _encode(
+            meta,
+            {"type": "map", "values": "bytes"},
+            {
+                "avro.schema": json.dumps(schema).encode(),
+                "avro.codec": b"null",
+            },
+            {},
+        )
+        f.write(meta.getvalue())
+        f.write(sync)
+    try:
+        rc = write_training_blocks_native(
+            path + ".tmp", labels, list(bags.values()), feature_names,
+            id_columns, block_records, sync,
+        )
+    except Exception:
+        os.remove(path + ".tmp")
+        raise
+    if rc is None:
+        os.remove(path + ".tmp")  # header-only stub; fallback rewrites
+        names = list(feature_names)
+        id_items = [
+            (k, np.asarray(codes), [str(v) for v in vocab])
+            for k, (codes, vocab) in id_columns.items()
+        ]
+
+        def recs():
+            for r in range(len(labels)):
+                rec = {
+                    "uid": None,
+                    "label": float(labels[r]),
+                    "metadataMap": {
+                        k: vocab[int(codes[r])]
+                        for k, codes, vocab in id_items
+                    },
+                    "weight": None,
+                    "offset": None,
+                }
+                for bname, (starts, nid, vals) in bags.items():
+                    lo, hi = int(starts[r]), int(starts[r + 1])
+                    rec[bname] = [
+                        {
+                            "name": names[int(nid[k])],
+                            "term": "",
+                            "value": float(vals[k]),
+                        }
+                        for k in range(lo, hi)
+                    ]
+                yield rec
+
+        return write_avro(
+            path, schema, recs(), codec="null",
+            block_records=block_records, sync=sync,
+        )
+    os.replace(path + ".tmp", path)
+    return rc
+
+
 def read_avro(path: str) -> Iterator[dict]:
     """Stream records from an Avro object-container file."""
     with open(path, "rb") as f:
@@ -469,14 +578,20 @@ def _read_game_dataset_native(
     )
     if fast is None:
         return None
-    labels, offsets, weights, coo, idvals, vocabs, label_seen = fast
+    labels, offsets, weights, coo, idvals, vocabs, label_seen, file_rows = fast
     n = len(labels)
     if n == 0:
         raise ValueError(f"no records in {file_list}")
     missing = label_seen == 0
     if np.any(missing) and is_response_required:
+        # report the specific file + per-file record index, matching the
+        # pure-Python fallback's diagnostics
+        merged_idx = int(np.argmax(missing))
+        bases = np.concatenate([[0], np.cumsum(file_rows)])
+        fi = int(np.searchsorted(bases, merged_idx, side="right")) - 1
         raise ValueError(
-            f"record {int(np.argmax(missing))} of {file_list} has no label"
+            f"record {merged_idx - int(bases[fi])} of {file_list[fi]} "
+            "has no label"
         )
 
     if index_maps is None:
@@ -506,10 +621,11 @@ def _read_game_dataset_native(
         if add_intercept:
             icept = imap.get(INTERCEPT_KEY)
             if icept >= 0:
-                vals = np.concatenate([vals, np.ones(n)])
-                rws = np.concatenate([rws, np.arange(n, dtype=np.int64)])
-                cls = np.concatenate(
-                    [cls, np.full(n, icept, np.int64)]
+                # decode emits rows in order; interleave the per-row
+                # intercept arithmetically so the result STAYS row-sorted
+                # (from_coo then skips its argsort over the nnz)
+                vals, rws, cls = _interleave_intercept_sorted(
+                    vals, rws, cls, n, icept
                 )
         shards[shard] = SparseBatch.from_coo(
             values=vals,
@@ -518,13 +634,51 @@ def _read_game_dataset_native(
             labels=labels,
             num_features=len(imap),
         )
+    # native id columns arrive as (interned codes, first-seen vocab):
+    # sort the vocab and remap codes (models score via searchsorted over a
+    # SORTED vocab) — no per-row strings are ever materialized
+    from photon_ml_tpu.game.dataset import IdColumn
+
+    id_cols = {}
+    for ci, c in enumerate(id_columns):
+        codes, vocab = idvals[ci]
+        order = np.argsort(vocab)
+        rank = np.empty(len(order), np.int64)
+        rank[order] = np.arange(len(order))
+        id_cols[c] = IdColumn(
+            codes=rank[codes] if len(codes) else codes, vocab=vocab[order]
+        )
     return build_game_dataset(
         response=labels,
         feature_shards=shards,
-        id_columns={c: idvals[ci] for ci, c in enumerate(id_columns)},
+        id_columns=id_cols,
         offset=offsets,
         weight=weights,
     )
+
+
+def _interleave_intercept_sorted(
+    vals: np.ndarray, rws: np.ndarray, cls: np.ndarray, n: int, icept: int
+):
+    """Insert one intercept nnz after each row's features, preserving row
+    order, in O(nnz) — the sorted-merge of a row-sorted COO with the
+    per-row intercept diagonal."""
+    nnz = len(vals)
+    out_v = np.empty(nnz + n)
+    out_r = np.empty(nnz + n, np.int64)
+    out_c = np.empty(nnz + n, np.int64)
+    # each decode nnz shifts right by the number of intercepts already
+    # placed (= its row index); the intercept of row r lands right after
+    # row r's features
+    dest = np.arange(nnz) + rws
+    out_v[dest] = vals
+    out_r[dest] = rws
+    out_c[dest] = cls
+    idest = np.searchsorted(rws, np.arange(n), side="right") + np.arange(n)
+    out_v[idest] = 1.0
+    out_r[idest] = np.arange(n)
+    out_c[idest] = icept
+    return out_v, out_r, out_c
 
 
 def read_game_dataset_from_avro(

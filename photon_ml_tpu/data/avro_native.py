@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
 from typing import Mapping, Optional, Sequence
 
 import numpy as np
@@ -296,7 +297,7 @@ def _lib():
             u8, ctypes.c_int64, ctypes.c_int64, u8, ctypes.c_int32,
             i32, ctypes.c_int64, ctypes.c_int32,
             u8, i64, i64, i64,
-            ctypes.c_int32, u8, i64,
+            ctypes.c_int32, u8, i64, ctypes.c_int32,
         ]
         lib.avro_last_error.restype = ctypes.c_char_p
         lib.avro_rows.restype = ctypes.c_int64
@@ -320,8 +321,81 @@ def _lib():
             ctypes.c_void_p, ctypes.c_int32, i64, u8, i64,
         ]
         lib.avro_free.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "avro_write_training_blocks"):
+            lib.avro_write_training_blocks.restype = ctypes.c_int64
+            lib.avro_write_training_blocks.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, f64,
+                ctypes.c_int32, i64, i32, f64, u8, i64,
+                ctypes.c_int32, u8, i64, i64, u8, i64, i64,
+                ctypes.c_int64, u8,
+            ]
+            lib.avro_encode_last_error.restype = ctypes.c_char_p
         _proto_ready = True
     return lib
+
+
+def write_training_blocks_native(
+    path: str,
+    labels: np.ndarray,
+    bags: Sequence[tuple[np.ndarray, np.ndarray, np.ndarray]],
+    feature_names: Sequence[str],
+    id_columns: Mapping[str, tuple[np.ndarray, Sequence[str]]],
+    block_records: int,
+    sync: bytes,
+) -> Optional[int]:
+    """Append TrainingExampleAvro-shaped record blocks via
+    native/avro_encode.cpp; None when the native library is unavailable
+    (caller falls back to the pure-Python writer). ``bags`` is the ordered
+    feature arrays, each (starts[n+1], name_id, vals); ``id_columns`` maps
+    metadataMap key -> (codes[n], vocab strings)."""
+    lib = _lib()
+    if lib is None or not hasattr(lib, "avro_write_training_blocks"):
+        return None
+    name_blob, name_offs = _concat_strs(list(feature_names))
+    keys = list(id_columns)
+    key_blob, key_offs = _concat_strs(keys)
+    n = len(labels)
+    # flatten bags: starts become absolute into the concatenated arrays
+    starts_flat = np.empty(len(bags) * (n + 1), np.int64)
+    nid_parts, val_parts = [], []
+    base = 0
+    for b, (starts, nid, vals) in enumerate(bags):
+        starts_flat[b * (n + 1):(b + 1) * (n + 1)] = (
+            np.asarray(starts, np.int64) + base
+        )
+        nid_parts.append(np.asarray(nid, np.int32))
+        val_parts.append(np.asarray(vals, np.float64))
+        base += len(nid_parts[-1])
+    codes_flat = np.empty(len(keys) * n, np.int64)
+    vocab_blobs, vocab_offs, vocab_counts = [], [], []
+    byte_base = 0
+    for ci, k in enumerate(keys):
+        codes, vocab = id_columns[k]
+        codes_flat[ci * n:(ci + 1) * n] = np.asarray(codes, np.int64)
+        blob, offs = _concat_strs([str(v) for v in vocab])
+        vocab_blobs.append(blob)
+        vocab_offs.append(offs + byte_base)
+        byte_base += len(blob)
+        vocab_counts.append(len(vocab))
+    rc = lib.avro_write_training_blocks(
+        path.encode(), n,
+        np.ascontiguousarray(labels, np.float64),
+        len(bags), starts_flat,
+        np.concatenate(nid_parts) if nid_parts else np.zeros(0, np.int32),
+        np.concatenate(val_parts) if val_parts else np.zeros(0, np.float64),
+        name_blob, name_offs,
+        len(keys), key_blob, key_offs, codes_flat,
+        np.concatenate(vocab_blobs) if vocab_blobs else np.zeros(0, np.uint8),
+        np.concatenate(vocab_offs) if vocab_offs else np.zeros(0, np.int64),
+        np.asarray(vocab_counts, np.int64),
+        block_records, np.frombuffer(sync, np.uint8),
+    )
+    if rc < 0:
+        raise ValueError(
+            "native avro write failed: "
+            + lib.avro_encode_last_error().decode()
+        )
+    return int(rc)
 
 
 def _decode_vocab(blob: np.ndarray, offs: np.ndarray) -> np.ndarray:
@@ -339,20 +413,33 @@ def read_game_arrays_native(
     feature_shards: Mapping[str, Sequence[str]],
     index_maps: Optional[Mapping[str, Mapping[str, int]]],
     id_columns: Sequence[str],
+    threads: int = 0,
 ):
     """Parse files into columnar arrays, or None if unsupported.
 
-    Returns ``(labels, offsets, weights, coo_per_shard, id_values,
-    shard_vocabs, label_seen)`` where ``coo_per_shard[shard] =
-    (vals, rows, cols)`` and ``label_seen`` marks rows whose label field
-    was PRESENT (a genuine NaN label stays distinguishable from absent);
-    with ``index_maps`` given, cols are final dense ids and unknown
-    features are dropped; without, cols index ``shard_vocabs[shard]``
-    (first-seen interning order) for the caller to remap.
+    Returns ``(labels, offsets, weights, coo_per_shard, id_cols,
+    shard_vocabs, label_seen, file_rows)`` where ``coo_per_shard[shard] =
+    (vals, rows, cols)``, ``id_cols[ci] = (codes, vocab)`` (dense interned
+    codes + first-seen vocabulary — never materialized per-row strings),
+    ``label_seen`` marks rows whose label field was PRESENT (a genuine
+    NaN label stays distinguishable from absent), and ``file_rows[i]`` is
+    the row count contributed by ``paths[i]`` (diagnostics map merged row
+    indices back to a path + local record); with ``index_maps`` given,
+    cols are final dense ids and unknown features are dropped; without,
+    cols index ``shard_vocabs[shard]`` (first-seen interning order) for
+    the caller to remap.
+
+    ``threads``: parallel block-decode workers (0 = one per host core;
+    env ``PHOTON_AVRO_THREADS`` overrides) — Avro blocks are
+    sync-delimited and independent, so the file decodes block-parallel
+    the way the reference decodes per-partition on executors
+    (AvroDataReader.scala:87-237).
     """
     lib = _lib()
     if lib is None:
         return None
+    if threads <= 0:
+        threads = int(os.environ.get("PHOTON_AVRO_THREADS", "0") or 0)
 
     shard_names = list(feature_shards)
     if index_maps is not None:
@@ -425,7 +512,7 @@ def read_game_arrays_native(
             1 if codec == "deflate" else 0,
             prog_f, len(prog_f), len(shard_names),
             feat_bytes, feat_offs, feat_ids, shard_key_counts,
-            len(id_columns), id_blob, id_offs,
+            len(id_columns), id_blob, id_offs, threads,
         )
         if not handle:
             err = lib.avro_last_error().decode()
@@ -471,7 +558,7 @@ def read_game_arrays_native(
                         f"'{id_columns[ci]}' (top-level field or "
                         "metadataMap entry)"
                     )
-                idvals.append(_decode_vocab(blob, offs)[codes])
+                idvals.append((codes, _decode_vocab(blob, offs)))
         finally:
             lib.avro_free(handle)
         all_parts.append(
@@ -483,9 +570,12 @@ def read_game_arrays_native(
 
 def _merge_parts(parts, n_shards: int, n_ids: int):
     """Concatenate per-file results, re-basing row indices and re-mapping
-    per-file intern vocabularies onto a merged first-seen vocabulary."""
+    per-file intern vocabularies onto a merged first-seen vocabulary.
+    Appends per-file row counts so callers can name the source file of a
+    merged row in diagnostics."""
+    file_rows = [len(p[0]) for p in parts]
     if len(parts) == 1:
-        return parts[0]
+        return (*parts[0], file_rows)
     labels = np.concatenate([p[0] for p in parts])
     label_seen = np.concatenate([p[6] for p in parts])
     offsets = np.concatenate([p[1] for p in parts])
@@ -515,7 +605,21 @@ def _merge_parts(parts, n_shards: int, n_ids: int):
             cols = np.concatenate(col_parts)
             vocabs.append(np.asarray(list(merged)))
         coo.append((vals, rows, cols))
-    idvals = [
-        np.concatenate([p[4][ci] for p in parts]) for ci in range(n_ids)
-    ]
-    return labels, offsets, weights, coo, idvals, vocabs, label_seen
+    idvals = []
+    for ci in range(n_ids):
+        merged_ids: dict[str, int] = {}
+        code_parts = []
+        for p in parts:
+            codes, vocab = p[4][ci]
+            remap = np.empty(len(vocab), np.int64)
+            for i, k in enumerate(vocab):
+                if k not in merged_ids:
+                    merged_ids[k] = len(merged_ids)
+                remap[i] = merged_ids[k]
+            code_parts.append(remap[codes] if len(codes) else codes)
+        idvals.append(
+            (np.concatenate(code_parts), np.asarray(list(merged_ids)))
+        )
+    return (
+        labels, offsets, weights, coo, idvals, vocabs, label_seen, file_rows
+    )

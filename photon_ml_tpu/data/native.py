@@ -29,7 +29,7 @@ _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 
 
-_SOURCES = ("fast_parse.cpp", "avro_decode.cpp")
+_SOURCES = ("fast_parse.cpp", "avro_decode.cpp", "avro_encode.cpp")
 
 
 def _source_paths() -> list[str]:
@@ -51,7 +51,7 @@ def _build() -> bool:
         # survives
         attempts.append(srcs[:1])
     for attempt in attempts:
-        cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+        cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
                "-o", _LIB_PATH, *attempt]
         if any("avro_decode" in s for s in attempt):
             cmd.append("-lz")

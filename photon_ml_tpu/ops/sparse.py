@@ -120,10 +120,13 @@ class SparseBatch:
         rows = np.asarray(rows)
         cols = np.asarray(cols)
         validate_coo_indices(rows, cols, n, num_features)
-        order = np.argsort(rows, kind="stable")
-        values = np.asarray(values)[order]
-        rows = rows[order]
-        cols = cols[order]
+        values = np.asarray(values)
+        if len(rows) and not np.all(rows[1:] >= rows[:-1]):
+            # ingest paths emit row-sorted COO; only re-sort when needed
+            order = np.argsort(rows, kind="stable")
+            values = values[order]
+            rows = rows[order]
+            cols = cols[order]
 
         n_pad = _round_up(n, row_pad_multiple)
         nnz = int(len(values))

@@ -494,8 +494,14 @@ class TiledBatch:
         validate_coo_indices(rows, cols, n, num_features)
 
         tile = rows // R
-        order = np.argsort(tile, kind="stable")
-        tile_s = tile[order]
+        if len(tile) and not np.all(tile[1:] >= tile[:-1]):
+            order = np.argsort(tile, kind="stable")
+            tile_s = tile[order]
+            rows = rows[order]
+            cols = cols[order]
+            values = values[order]
+        else:  # ingest emits row-sorted COO — skip the nnz sort
+            tile_s = tile
         starts = np.searchsorted(tile_s, np.arange(T))
         counts = np.diff(np.append(starts, len(tile_s)))
         S = int(max(LANE, -(-int(counts.max(initial=0)) // LANE) * LANE))
@@ -506,11 +512,10 @@ class TiledBatch:
         hi2 = np.full((T * S,), B, np.int32)   # sentinel: one-hot all-zero
         lo2 = np.zeros((T * S,), np.int32)
         rlo2 = np.zeros((T * S,), np.int32)
-        c_s = cols[order]
-        vals2[dest] = values[order]
-        hi2[dest] = (c_s // LANE).astype(np.int32)
-        lo2[dest] = (c_s % LANE).astype(np.int32)
-        rlo2[dest] = (rows[order] % R).astype(np.int32)
+        vals2[dest] = values
+        hi2[dest] = (cols // LANE).astype(np.int32)
+        lo2[dest] = (cols % LANE).astype(np.int32)
+        rlo2[dest] = (rows % R).astype(np.int32)
 
         npad = T * R
         lab = np.zeros(npad, np.float32)

@@ -29,12 +29,14 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-TARGETS = ("photon_ml_tpu", "tests", "tools", "bench.py", "bench_game.py",
-           "bench_suite.py", "__graft_entry__.py")
+TARGETS = ("photon_ml_tpu", "tests", "tools", "__graft_entry__.py")
 
 
 def source_files() -> list[str]:
-    out = []
+    import glob as _glob
+
+    # every bench script is gated (a literal list silently missed new ones)
+    out = sorted(_glob.glob(os.path.join(REPO, "bench*.py")))
     for t in TARGETS:
         path = os.path.join(REPO, t)
         if os.path.isfile(path):
