@@ -341,13 +341,15 @@ def _make_solve_one(config: OptimizerConfig, compute_variances: bool):
 def _re_solver(
     config: OptimizerConfig,
     loss_name: str,
-    constrained: bool = False,
+    constrained: bool | str = False,
     compute_variances: bool = False,
 ):
     solve_one = _make_solve_one(config, compute_variances)
     # obj, l1 broadcast; batch leaves, w0 (and per-entity constraint boxes,
-    # when present) map over the entity axis
-    c_axis = 0 if constrained else None
+    # when present) map over the entity axis. constrained="shared" keeps one
+    # [K] box broadcast to every entity (the streaming table's dense local
+    # space) instead of materializing [E, K] bounds.
+    c_axis = 0 if constrained is True else None
     return jax.jit(jax.vmap(solve_one, in_axes=(None, 0, 0, None, c_axis)))
 
 
@@ -357,16 +359,20 @@ def _re_solver_sharded(
     loss_name: str,
     mesh: Mesh,
     axis: str,
-    constrained: bool = False,
+    constrained: bool | str = False,
     compute_variances: bool = False,
 ):
     """Entity-sharded bucket solver: explicit shard_map over ``axis`` — each
     device runs the vmapped while-loop solve on its local entity block with
     NO collectives (per-entity problems are independent; the EP-like strategy
-    of SURVEY.md §2.f / RandomEffectCoordinate.scala:101-130)."""
+    of SURVEY.md §2.f / RandomEffectCoordinate.scala:101-130).
+
+    ``constrained="shared"``: one replicated [K] box for every entity
+    (streaming dense space) instead of entity-sharded [E, K] bounds."""
 
     solve_one = _make_solve_one(config, compute_variances)
-    c_axis = 0 if constrained else None
+    c_axis = 0 if constrained is True else None
+    c_spec = P(axis) if constrained is True else P()
 
     def local(obj, bucket_batch, w0, l1, constraints):
         return jax.vmap(solve_one, in_axes=(None, 0, 0, None, c_axis))(
@@ -383,7 +389,7 @@ def _re_solver_sharded(
                 jax.tree.map(lambda _: P(axis), bucket_batch),
                 P(axis),
                 P(),
-                jax.tree.map(lambda _: P(axis), constraints),
+                jax.tree.map(lambda _: c_spec, constraints),
             ),
             out_specs=P(axis),
             check_vma=False,
@@ -431,6 +437,42 @@ def _re_scorer():
     return jax.jit(score_bucket)
 
 
+@lru_cache(maxsize=8)
+def _re_dense_scorer():
+    return jax.jit(lambda coeffs, x: jnp.einsum("erk,ek->er", x, coeffs))
+
+
+# Route a bucket's per-entity solves through the DENSE local-design layout
+# ([E, R, K] batched matmuls on the MXU — the layout the 1B streaming path
+# uses) when the densified design is at most this factor of the padded-COO
+# footprint; the COO gather/scatter path stays for high-dim sparse locals.
+_DENSE_BYTES_FACTOR = 3.0
+
+
+def _bucket_dense_design(b: EntityBucket) -> Optional[np.ndarray]:
+    """Host-side densified [E, R, K] design for a bucket, or None when the
+    COO layout is the better trade (K large / very sparse locals)."""
+    E, R, K = b.num_entities, b.rows_per_entity, b.num_local_features
+    nz = b.values.shape[1]
+    dense_bytes = E * R * K * 4
+    coo_bytes = E * nz * 12
+    if dense_bytes > max(64 << 20, _DENSE_BYTES_FACTOR * coo_bytes):
+        return None
+    vals = np.asarray(b.values)
+    rows = np.asarray(b.rows, np.int64)
+    cols = np.asarray(b.cols, np.int64)
+    e_idx = np.broadcast_to(
+        np.arange(E, dtype=np.int64)[:, None] * (R * K), rows.shape
+    )
+    flat = (e_idx + rows * K + cols).ravel()
+    # padded nnz carry value 0 -> accumulate harmlessly (bincount is the
+    # fast vectorized scatter-add; np.add.at is unbuffered/slow)
+    x = np.bincount(
+        flat, weights=vals.ravel(), minlength=E * R * K
+    ).astype(np.float32)
+    return x.reshape(E, R, K)
+
+
 @dataclasses.dataclass
 class RandomEffectCoordinate:
     """Per-entity GLM blocks (RandomEffectCoordinate.scala:37-208).
@@ -461,6 +503,10 @@ class RandomEffectCoordinate:
             )
         # one shared HBM copy of the bucket stacks (datasets build host-side)
         self._buckets = self.re_data.device_buckets()
+        # dense [E, R, K] designs for small-K buckets: batched-matmul MXU
+        # solves (the streaming-path layout) instead of vmapped COO
+        # gather/scatter — measured ~10x on the GLMix RE coordinate
+        self._dense_x = self.re_data.dense_designs()
         # Box constraints are declared against GLOBAL feature ids
         # (OptimizerConfig constraintMap); each entity's local space is an
         # index-map renumbering (local k <-> global projection[e, k]), so the
@@ -537,7 +583,17 @@ class RandomEffectCoordinate:
             bucket = (
                 b if residual_scores is None else b.with_extra_offsets(residual_scores)
             )
-            bb = bucket.entity_batch()
+            if self._dense_x[i] is not None:
+                from photon_ml_tpu.ops.dense import DenseBatch
+
+                bb = DenseBatch(
+                    x=self._dense_x[i],
+                    labels=bucket.labels,
+                    offsets=bucket.offsets,
+                    weights=bucket.weights,
+                )
+            else:
+                bb = bucket.entity_batch()
             w0 = bm.coefficients
             cons = self._bucket_constraints[i]
             if self.mesh is None:
@@ -575,8 +631,11 @@ class RandomEffectCoordinate:
         model searchsorted path for passive rows."""
         n_pad = self.data.shard(self.re_data.shard_name).num_rows
         scores = jnp.zeros((n_pad,), jnp.float32)
-        for b, bm in zip(self._buckets, model.buckets):
-            margins = self._scorer(bm.coefficients, b.entity_batch())  # [E, R]
+        for i, (b, bm) in enumerate(zip(self._buckets, model.buckets)):
+            if self._dense_x[i] is not None:
+                margins = _re_dense_scorer()(bm.coefficients, self._dense_x[i])
+            else:
+                margins = self._scorer(bm.coefficients, b.entity_batch())  # [E, R]
             idx = b.row_index.reshape(-1)
             vals = margins.reshape(-1)
             scores = scores.at[jnp.maximum(idx, 0)].add(

@@ -65,6 +65,17 @@ class GameDataset:
             )
         return self.feature_shards[name]
 
+    def device_shard(self, name: str) -> SparseBatch:
+        """Device copy of a shard, uploaded once and cached — scoring in
+        the CD loop reuses one HBM copy instead of re-uploading host
+        leaves every call."""
+        cache = self.__dict__.setdefault("_device_shards", {})
+        hit = cache.get(name)
+        if hit is None:
+            hit = self.shard(name).device()
+            cache[name] = hit
+        return hit
+
     def batch_for(
         self, shard_name: str, extra_offsets: Optional[np.ndarray] = None
     ) -> SparseBatch:
@@ -112,6 +123,7 @@ def build_game_dataset(
         weight=np.ones(n) if weight is None else np.asarray(weight, np.float64),
         feature_shards=dict(feature_shards),
         id_columns={
-            k: IdColumn.from_values(v) for k, v in (id_columns or {}).items()
+            k: v if isinstance(v, IdColumn) else IdColumn.from_values(v)
+            for k, v in (id_columns or {}).items()
         },
     )

@@ -43,7 +43,7 @@ class FixedEffectModel:
 
     def score(self, data: GameDataset) -> Array:
         """Raw scores x.w for every example row ([n_pad] aligned array)."""
-        return data.shard(self.shard_name).dot_rows(self.coefficients)
+        return data.device_shard(self.shard_name).dot_rows(self.coefficients)
 
     def to_summary_string(self) -> str:
         w = np.asarray(self.coefficients)

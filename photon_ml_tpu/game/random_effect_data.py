@@ -120,6 +120,20 @@ class RandomEffectDataset:
             object.__setattr__(self, "_device_buckets", cached)
         return cached
 
+    def dense_designs(self) -> tuple:
+        """Per-bucket dense [E, R, K] device designs (None where the COO
+        layout wins) — built host-side once, cached like device_buckets."""
+        from photon_ml_tpu.game.coordinates import _bucket_dense_design
+
+        cached = self.__dict__.get("_dense_designs")
+        if cached is None:
+            cached = tuple(
+                None if x is None else jax.device_put(x)
+                for x in (_bucket_dense_design(b) for b in self.buckets)
+            )
+            object.__setattr__(self, "_dense_designs", cached)
+        return cached
+
     def to_summary_string(self) -> str:
         """RandomEffectDataSet.toSummaryString analog (:174-197): per-bucket
         geometry + active/passive split."""
