@@ -87,7 +87,7 @@ def main():
     elapsed = time.perf_counter() - t0
 
     iters = int(res.iterations)
-    passes = iters + 1  # init value_and_grad + one per iteration
+    passes = int(res.data_passes)  # init eval + one per iteration (LBFGS)
     rows_per_sec = n_rows * passes / elapsed
     layout_line = json.dumps(
         {

@@ -100,12 +100,32 @@ def main():
         t0 = time.perf_counter()
         stats = trainer.train(table, chunks)  # final fetch = true sync
         secs = time.perf_counter() - t0
+        # per-entity tracker sample OUTSIDE the timed window (the packed
+        # telemetry fetch crosses the narrow bench tunnel, which a
+        # PCIe-attached chip would not feel): the FIRST chunk's entities
+        # only — labeled as such below
+        tr_stats = trainer.train(
+            ShardedCoefficientTable(n_entities, dim),
+            chunks[:1],
+            with_tracker=True,
+        ).tracker
+        its = tr_stats.iterations
+        pct = {
+            f"p{p}": int(np.percentile(its, p)) for p in (50, 90, 99)
+        }
         return {
             "name": name,
             "coefficients": stats.total_coefficients,
             "entities": stats.total_entities,
             "chunks": stats.num_chunks,
             "mean_iterations": round(stats.mean_iterations, 2),
+            "tracker_sample_entities": len(its),  # first chunk only
+            "iteration_percentiles_first_chunk": pct,
+            # reasons >= 2: a genuine convergence test fired (codes 0/1 =
+            # not-converged / max-iterations; optim/common.py)
+            "converged_frac_first_chunk": round(
+                float(np.mean(tr_stats.reasons >= 2)), 4
+            ),
             "seconds": round(secs, 3),
             "table_gb": round(table.nbytes / 2**30, 2),
         }

@@ -170,6 +170,7 @@ class ChunkResult:
     size: int
     iterations: Array  # i32[E_c]
     values: Array  # f32[E_c]
+    reasons: Array  # i32[E_c] convergence reason codes
 
 
 @dataclasses.dataclass
@@ -179,6 +180,10 @@ class StreamingTrainStats:
     num_chunks: int
     mean_iterations: float
     total_final_value: float
+    # full per-entity solve telemetry (iterations/reasons/values, one
+    # packed host fetch) — the RandomEffectOptimizationTracker the bucket
+    # path reports, at streaming scale
+    tracker: Optional["RandomEffectOptimizationTracker"] = None
 
 
 class StreamingRandomEffectTrainer:
@@ -198,6 +203,8 @@ class StreamingRandomEffectTrainer:
         config: OptimizerConfig,
         mesh: Optional[Mesh] = None,
         axis: str = "entity",
+        compute_variances: bool = False,
+        prefetch: bool = True,
     ):
         # the vmapped / shard_mapped per-entity solver builders are shared
         # with RandomEffectCoordinate — one lru_cache entry serves both
@@ -205,17 +212,43 @@ class StreamingRandomEffectTrainer:
             _re_solver,
             _re_solver_sharded,
         )
+        from photon_ml_tpu.ops.losses import get_loss
 
         config.validate(loss_name)
+        if compute_variances and not get_loss(loss_name).has_hessian:
+            raise ValueError(
+                "coefficient variances need a twice-differentiable loss; "
+                f"'{loss_name}' is not"
+            )
         self.loss_name = loss_name
         self.config = config
         self.mesh = mesh
+        self.compute_variances = compute_variances
+        # one-chunk-ahead enqueue (H2D transfer of chunk i+1 overlaps chunk
+        # i's solve via async dispatch); False = fully synchronous, the
+        # control arm for measuring the overlap win (bench_overlap.py)
+        self.prefetch = prefetch
+        # the streaming table trains DENSE per-entity models: a global box
+        # constraint on local dim k applies identically to every entity
+        # (the bucket path gathers the same bounds through each entity's
+        # projection; here the projection is the identity)
+        self._constrained = bool(config.box_constraints)
+        constrained_mode = "shared" if self._constrained else False
         self._n_dev = 1 if mesh is None else int(mesh.devices.size)
         key_cfg = dataclasses.replace(config, regularization_weight=0.0)
         if mesh is None:
-            self._solver = _re_solver(key_cfg, loss_name)
+            self._solver = _re_solver(
+                key_cfg, loss_name, constrained_mode, compute_variances
+            )
         else:
-            self._solver = _re_solver_sharded(key_cfg, loss_name, mesh, axis)
+            self._solver = _re_solver_sharded(
+                key_cfg,
+                loss_name,
+                mesh,
+                axis,
+                constrained_mode,
+                compute_variances,
+            )
         self._sharding = (
             None if mesh is None else NamedSharding(mesh, P(axis))
         )
@@ -256,7 +289,26 @@ class StreamingRandomEffectTrainer:
             return source
         raise TypeError(f"chunk source {type(source).__name__}")
 
-    def _solve(self, table, start: int, batch: DenseBatch) -> ChunkResult:
+    def _chunk_constraints(self, dim: int):
+        """ONE [dim] box shared by every entity (vmap broadcasts it) — the
+        [E, K] materialization the bucket path needs for per-entity
+        projections would be dim*entities floats at streaming scale."""
+        if not self._constrained:
+            return None
+        from photon_ml_tpu.optim.common import BoxConstraints
+
+        lower, upper = self.config.dense_box_bounds(dim)
+        return BoxConstraints(
+            lower=jnp.asarray(lower), upper=jnp.asarray(upper)
+        )
+
+    def _solve(
+        self,
+        table,
+        start: int,
+        batch: DenseBatch,
+        variance_table: Optional[ShardedCoefficientTable] = None,
+    ) -> ChunkResult:
         size = batch.labels.shape[0]
         if self.mesh is not None and size % self._n_dev:
             # fail with intent, not a shard-shape error deep inside jax
@@ -265,33 +317,74 @@ class StreamingRandomEffectTrainer:
                 f"{self._n_dev}-device mesh (pad the chunk)"
             )
         w0 = table.read_chunk(start, size)
-        res, _ = self._solver(self._obj, batch, w0, self._l1, None)
+        cons = self._chunk_constraints(table.dim)
+        res, var = self._solver(self._obj, batch, w0, self._l1, cons)
         table.write_chunk(start, res.w)
+        if var is not None:
+            if variance_table is None:
+                raise ValueError(
+                    "compute_variances=True needs a variance_table to "
+                    "write into (train(..., variance_table=...))"
+                )
+            variance_table.write_chunk(start, var)
         return ChunkResult(
             start=start,
             size=size,
             iterations=res.iterations,
             values=res.value,
+            reasons=res.reason,
         )
 
     def train(
         self,
         table: ShardedCoefficientTable,
         chunks: Iterable[tuple[int, DenseBatch | Callable[[], DenseBatch]]],
+        variance_table: Optional[ShardedCoefficientTable] = None,
+        with_tracker: bool = False,
     ) -> StreamingTrainStats:
         """Solve every chunk into ``table``; chunk i+1's data is enqueued
         BEFORE chunk i's solve result is consumed (async-dispatch overlap).
+
+        ``variance_table``: required when ``compute_variances``; receives
+        the per-coefficient Hessian-diagonal-inverse variances
+        (SingleNodeOptimizationProblem.scala:57-88 at streaming scale).
+        ``with_tracker``: also return the full per-entity
+        RandomEffectOptimizationTracker (costs one extra packed
+        device->host fetch of 3 x total_entities values).
         """
+        if self.compute_variances and variance_table is None:
+            raise ValueError(
+                "compute_variances=True needs a variance_table"
+            )
         results: list[ChunkResult] = []
-        it = iter(chunks)
-        pending = None
-        for start, source in it:
-            nxt = (start, self._prepare(source))
+        if self.prefetch:
+            it = iter(chunks)
+            pending = None
+            for start, source in it:
+                nxt = (start, self._prepare(source))
+                if pending is not None:
+                    results.append(
+                        self._solve(
+                            table, *pending, variance_table=variance_table
+                        )
+                    )
+                pending = nxt
             if pending is not None:
-                results.append(self._solve(table, *pending))
-            pending = nxt
-        if pending is not None:
-            results.append(self._solve(table, *pending))
+                results.append(
+                    self._solve(table, *pending, variance_table=variance_table)
+                )
+        else:
+            # control arm: serialize transfer and compute completely
+            for start, source in chunks:
+                results.append(
+                    self._solve(
+                        table,
+                        start,
+                        self._prepare(source),
+                        variance_table=variance_table,
+                    )
+                )
+                jax.block_until_ready(table.coefficients)
         if not results:
             return StreamingTrainStats(0, 0, 0, 0.0, 0.0)
         # ONE device->host fetch for the scalar summaries
@@ -308,6 +401,17 @@ class StreamingRandomEffectTrainer:
                 ]
             )
         )
+        tracker = None
+        if with_tracker:
+            from photon_ml_tpu.optim.trackers import (
+                RandomEffectOptimizationTracker,
+            )
+
+            tracker = RandomEffectOptimizationTracker.from_device_parts(
+                [r.iterations for r in results],
+                [r.reasons for r in results],
+                [r.values for r in results],
+            )
         total_e = sum(r.size for r in results)
         return StreamingTrainStats(
             total_entities=total_e,
@@ -315,4 +419,5 @@ class StreamingRandomEffectTrainer:
             num_chunks=len(results),
             mean_iterations=float(sums[0]) / max(total_e, 1),
             total_final_value=float(sums[1]),
+            tracker=tracker,
         )

@@ -135,6 +135,12 @@ class SolveResult(NamedTuple):
     reason: Array  # i32 convergence code
     values: Array  # f[max_iter + 1]
     grad_norms: Array  # f[max_iter + 1]
+    # i32 count of FULL passes over the training data (value+grad or
+    # Hessian-vector evaluations): benches divide rows*data_passes by
+    # wall-clock so optimizers with inner data loops (TRON's truncated CG
+    # runs one Hv pass per CG step) report throughput comparably with
+    # single-pass-per-iteration optimizers.
+    data_passes: Array = 0
 
 
 def convergence_reason(

@@ -258,6 +258,9 @@ def lbfgs_solve(
 
     final = lax.while_loop(cond, body, init)
     # On line-search failure keep the best iterate seen (pre-failure w).
+    # data passes: the init evaluation + one direction-margins pass per
+    # iteration (line-search re-evaluations ride the carried margins —
+    # O(n) elementwise, not a sparse-data pass).
     return SolveResult(
         w=final.w,
         value=final.value,
@@ -266,4 +269,5 @@ def lbfgs_solve(
         reason=final.reason,
         values=final.values,
         grad_norms=final.grad_norms,
+        data_passes=final.iteration + 1,
     )

@@ -171,4 +171,5 @@ def newton_solve(
         reason=final.reason,
         values=final.values,
         grad_norms=final.grad_norms,
+        data_passes=final.iteration + 1,
     )

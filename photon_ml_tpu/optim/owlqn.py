@@ -204,4 +204,5 @@ def owlqn_solve(
         reason=final.reason,
         values=final.values,
         grad_norms=final.grad_norms,
+        data_passes=final.iteration + 1,
     )

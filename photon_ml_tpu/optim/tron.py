@@ -143,6 +143,7 @@ class _TRONState(NamedTuple):
     values: Array
     grad_norms: Array
     z: Array  # carried margins X'@w (margin-carrying fast path; else [0])
+    passes: Array  # i32 cumulative full data passes (value+grad and CG Hv)
 
 
 def tron_solve(
@@ -198,6 +199,7 @@ def tron_solve(
         values=values,
         grad_norms=gnorms,
         z=z0,
+        passes=jnp.int32(1),  # the init value_and_grad evaluation
     )
 
     def cond(s: _TRONState):
@@ -209,7 +211,7 @@ def tron_solve(
             hvp = lambda v: objective.hvp_at(d2, v)
         else:
             hvp = lambda v: objective.hvp(s.w, v)
-        _, step, residual = _truncated_cg(hvp, s.grad, s.delta, config)
+        cg_its, step, residual = _truncated_cg(hvp, s.grad, s.delta, config)
 
         w_try = s.w + step
         gs = jnp.dot(s.grad, step)
@@ -290,6 +292,10 @@ def tron_solve(
             iteration=it,
             failures=failures,
             reason=reason,
+            # each CG step is one Hv data pass, plus this iteration's
+            # trial-point value_and_grad (lower-bounding CG as 1 when the
+            # trust region truncated it immediately)
+            passes=s.passes + jnp.maximum(cg_its, 1).astype(jnp.int32) + 1,
             z=jnp.where(improved, z_try, s.z),
             values=jnp.where(
                 improved, s.values.at[it].set(f_try), s.values
@@ -313,4 +319,5 @@ def tron_solve(
         reason=final.reason,
         values=final.values,
         grad_norms=final.grad_norms,
+        data_passes=final.passes,
     )
