@@ -124,17 +124,19 @@ def main():
 
 def run_sub_benchmarks():
     """Forward the JSON lines of every sub-benchmark (configs #2-#5 +
-    ingestion), each in its own process."""
+    ingestion + the north-star e2e pipeline), each in its own process."""
     here = os.path.dirname(os.path.abspath(__file__))
+    # north-star (20M-row full pipeline) runs last and longest; the
+    # driver's BASELINE numbers come from the earlier lines either way
     for script in ("bench_suite.py", "bench_game.py", "bench_scale.py",
-                   "bench_ingest.py"):
+                   "bench_ingest.py", "bench_northstar.py"):
         path = os.path.join(here, script)
         try:
             proc = subprocess.run(
                 [sys.executable, path],
                 capture_output=True,
                 text=True,
-                timeout=1500,
+                timeout=1500 if script != "bench_northstar.py" else 3000,
                 cwd=here,
             )
             emitted = False

@@ -36,9 +36,13 @@ N_ROWS = 20_000_000
 N_VAL = 1_000_000
 N_USERS = 138_493
 N_MOVIES = 26_744
-FE_SPACE = 10_000  # movieFeatures id space
-FE_NNZ = 8  # movieFeatures per movie
-CTX = 8  # movieCtx / userCtx dims
+# Feature volumes are sized so the whole pipeline's device residency fits
+# one 16 GB chip alongside the 4 coordinates (FE tiled layout + two dense
+# RE bucket sets + the MF kron refit): ~2.5 GB of design data at 20M
+# rows. Larger per-row feature budgets belong to the multi-host path.
+FE_SPACE = 2_000  # movieFeatures id space
+FE_NNZ = 4  # movieFeatures per movie
+CTX = 4  # movieCtx / userCtx dims
 
 
 def _generate(rng, n, movie_cols, movie_vals, emb_m, emb_u, w_g, a_u, b_m):
@@ -92,6 +96,20 @@ def _opt(opt_type="lbfgs", max_iterations=15):
 
 
 def main():
+    import shutil
+
+    from photon_ml_tpu.utils import setup_logging
+
+    setup_logging()  # phase timers (timed()) go to stderr for diagnosis
+    workdir = tempfile.mkdtemp(prefix="northstar_")
+    try:
+        _run(workdir)
+    finally:
+        # the fixture is ~9 GB — never leave it behind for the next round
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _run(workdir):
     from photon_ml_tpu.data.avro import write_training_examples_fast
 
     rng = np.random.default_rng(0)
@@ -115,7 +133,6 @@ def main():
     user_vocab = [str(u) for u in range(N_USERS)]
     movie_vocab = [str(m) for m in range(N_MOVIES)]
 
-    workdir = tempfile.mkdtemp(prefix="northstar_")
     paths = {}
     gen_s = write_s = 0.0
     for split, n in (("train", N_ROWS), ("val", N_VAL)):
@@ -164,31 +181,33 @@ def main():
             "fixed": {
                 "type": "fixed_effect",
                 "shard_name": "movieFeatures",
-                "optimizer": _opt("lbfgs", 15),
+                "optimizer": _opt("lbfgs", 12),
             },
             "per-user": {
                 "type": "random_effect",
                 "shard_name": "movieCtx",
                 "id_name": "userId",
-                "optimizer": _opt("newton", 12),
+                "optimizer": _opt("newton", 8),
                 "active_rows_per_entity": 256,
             },
             "per-movie": {
                 "type": "random_effect",
                 "shard_name": "userCtx",
                 "id_name": "movieId",
-                "optimizer": _opt("newton", 12),
+                "optimizer": _opt("newton", 8),
                 "active_rows_per_entity": 256,
             },
             "mf": {
                 "type": "factored_random_effect",
                 "shard_name": "movieCtx",
                 "id_name": "userId",
-                "latent_dim": 4,
+                "latent_dim": 2,
                 "mf_iterations": 1,
                 "optimizer": _opt("lbfgs", 8),
                 "latent_optimizer": _opt("lbfgs", 8),
-                "active_rows_per_entity": 256,
+                # the kron refit is built from ACTIVE rows; a tight cap
+                # bounds its nnz at rows_cap * users * dim * latent
+                "active_rows_per_entity": 32,
             },
         },
         "num_iterations": 1,

@@ -121,10 +121,13 @@ def main():
             "mean_iterations": round(stats.mean_iterations, 2),
             "tracker_sample_entities": len(its),  # first chunk only
             "iteration_percentiles_first_chunk": pct,
-            # reasons >= 2: a genuine convergence test fired (codes 0/1 =
-            # not-converged / max-iterations; optim/common.py)
+            # reasons >= 3: a tolerance test fired (codes: 0 not-converged,
+            # 1 max-iterations, 2 line-search stall; optim/common.py)
             "converged_frac_first_chunk": round(
-                float(np.mean(tr_stats.reasons >= 2)), 4
+                float(np.mean(tr_stats.reasons >= 3)), 4
+            ),
+            "stalled_frac_first_chunk": round(
+                float(np.mean(tr_stats.reasons == 2)), 4
             ),
             "seconds": round(secs, 3),
             "table_gb": round(table.nbytes / 2**30, 2),

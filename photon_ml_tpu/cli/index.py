@@ -43,7 +43,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     setup_logging()
 
-    from photon_ml_tpu.data.avro import build_index_map_from_avro
+    from photon_ml_tpu.data.avro import build_index_maps_from_avro
 
     shards: dict[str, tuple[str, ...]] = {}
     for spec in args.shards:
@@ -55,13 +55,13 @@ def main(argv=None) -> int:
         shards = {"features": ("features",)}
 
     summary = {}
-    for shard, bags in shards.items():
-        with timed(f"index shard '{shard}'"):
-            imap = build_index_map_from_avro(
-                args.input, bags, add_intercept=not args.no_intercept
-            )
-            out_dir = os.path.join(args.output, shard)
-            imap.save(out_dir)
+    with timed(f"index {len(shards)} shard(s), one scan"):
+        maps = build_index_maps_from_avro(
+            args.input, shards, add_intercept=not args.no_intercept
+        )
+    for shard, imap in maps.items():
+        out_dir = os.path.join(args.output, shard)
+        imap.save(out_dir)
         logger.info("shard '%s': %d features -> %s", shard, len(imap), out_dir)
         summary[shard] = {"num_features": len(imap), "path": out_dir}
     print(json.dumps(summary))

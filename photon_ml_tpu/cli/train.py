@@ -71,30 +71,24 @@ def read_input(
         paths = expand_input_paths(paths, date_range=dr,
                                    date_range_days_ago=dr_ago)
     if fmt == "avro":
-        from photon_ml_tpu.data.avro import (
-            build_index_map_from_avro,
-            read_game_dataset_from_avro,
-        )
+        from photon_ml_tpu.data.avro import read_game_dataset_from_avro
 
         shards = spec.pop("feature_shards", None)
         shards = {
             k: tuple(v) for k, v in (shards or {"features": ("features",)}).items()
         }
         add_intercept = bool(spec.pop("add_intercept", True))
-        if index_maps is None:
-            index_maps = {
-                shard: build_index_map_from_avro(
-                    paths, bags, add_intercept=add_intercept
-                )
-                for shard, bags in shards.items()
-            }
-        data = read_game_dataset_from_avro(
+        # ONE scan builds the index maps AND the dataset (a separate
+        # index-build pass would decode the whole input twice — at
+        # north-star scale that was the pipeline's dominant cost)
+        data, index_maps = read_game_dataset_from_avro(
             paths,
             feature_shards=shards,
             index_maps=index_maps,
             id_columns=tuple(spec.pop("id_columns", ())),
             add_intercept=add_intercept,
             is_response_required=is_response_required,
+            return_index_maps=True,
         )
         return data, index_maps
     if fmt == "libsvm":

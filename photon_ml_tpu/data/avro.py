@@ -542,22 +542,58 @@ def _as_paths(paths: str | Sequence[str]) -> list[str]:
     return out
 
 
+def build_index_maps_from_avro(
+    paths: str | Sequence[str],
+    feature_shards: Mapping[str, Sequence[str]],
+    add_intercept: bool = True,
+) -> dict[str, IndexMap]:
+    """ONE scan builds the index maps for EVERY shard (the generate-by-scan
+    path of AvroDataReader.scala:208-237 / FeatureIndexingJob). Uses the
+    native decoder's interning pass when available (the vocab keys ARE the
+    composed feature keys); pure-Python record walk otherwise."""
+    from photon_ml_tpu.data.avro_native import read_game_arrays_native
+
+    names = list(feature_shards)
+    try:
+        fast = read_game_arrays_native(
+            _as_paths(paths),
+            {s: tuple(feature_shards[s]) for s in names},
+            None,
+            (),
+            vocab_only=True,  # skip the COO/scalar materialization
+        )
+    except ValueError:
+        fast = None  # corrupt-for-native input: let the python walk report
+    if fast is not None:
+        return {
+            s: IndexMap.build(iter(fast[5][si]),
+                              add_intercept=add_intercept)
+            for si, s in enumerate(names)
+        }
+
+    keysets: dict[str, dict] = {s: {} for s in names}
+    for path in _as_paths(paths):
+        for rec in read_avro(path):
+            for s in names:
+                ks = keysets[s]
+                for bag in feature_shards[s]:
+                    for f in rec.get(bag) or ():
+                        ks.setdefault(feature_key(f["name"], f["term"]))
+    return {
+        s: IndexMap.build(iter(keysets[s]), add_intercept=add_intercept)
+        for s in names
+    }
+
+
 def build_index_map_from_avro(
     paths: str | Sequence[str],
     feature_bags: Sequence[str] = ("features",),
     add_intercept: bool = True,
 ) -> IndexMap:
-    """Scan records and build a feature index map (the generate-by-scan path
-    of AvroDataReader.scala:208-237 / FeatureIndexingJob)."""
-
-    def keys():
-        for path in _as_paths(paths):
-            for rec in read_avro(path):
-                for bag in feature_bags:
-                    for f in rec.get(bag) or ():
-                        yield feature_key(f["name"], f["term"])
-
-    return IndexMap.build(keys(), add_intercept=add_intercept)
+    """Single-shard convenience wrapper over build_index_maps_from_avro."""
+    return build_index_maps_from_avro(
+        paths, {"shard": tuple(feature_bags)}, add_intercept=add_intercept
+    )["shard"]
 
 
 def _read_game_dataset_native(
@@ -569,8 +605,10 @@ def _read_game_dataset_native(
     is_response_required: bool,
 ):
     """Native-decoder fast path (photon_ml_tpu.data.avro_native); returns
-    the GameDataset or None when the native path is unavailable/unsupported
-    (the pure-Python decoder below then runs — identical semantics)."""
+    ``(GameDataset, index_maps)`` or None when the native path is
+    unavailable/unsupported (the pure-Python decoder below then runs —
+    identical semantics). One scan builds BOTH the dataset and, when
+    ``index_maps`` is None, the feature index maps."""
     from photon_ml_tpu.data.avro_native import read_game_arrays_native
 
     fast = read_game_arrays_native(
@@ -648,12 +686,15 @@ def _read_game_dataset_native(
         id_cols[c] = IdColumn(
             codes=rank[codes] if len(codes) else codes, vocab=vocab[order]
         )
-    return build_game_dataset(
-        response=labels,
-        feature_shards=shards,
-        id_columns=id_cols,
-        offset=offsets,
-        weight=weights,
+    return (
+        build_game_dataset(
+            response=labels,
+            feature_shards=shards,
+            id_columns=id_cols,
+            offset=offsets,
+            weight=weights,
+        ),
+        index_maps,
     )
 
 
@@ -688,6 +729,7 @@ def read_game_dataset_from_avro(
     id_columns: Sequence[str] = (),
     add_intercept: bool = True,
     is_response_required: bool = True,
+    return_index_maps: bool = False,
 ) -> GameDataset:
     """Read TrainingExampleAvro-shaped records into a GameDataset.
 
@@ -695,9 +737,13 @@ def read_game_dataset_from_avro(
     MERGE into that shard's column (featureColumnMap semantics,
     AvroDataReader.readMerged); default one shard "features" from the
     ``features`` bag. ``index_maps`` (per shard) translate name+term keys to
-    dense ids — built by scanning when absent. Unknown features are DROPPED
-    (reference: index-map misses are skipped). ``id_columns`` are taken from
-    top-level record fields or the metadataMap (GameConverters:38-110).
+    dense ids — built IN THE SAME SCAN when absent (one pass interns keys
+    and emits the COO; a separate index-build pass would double-decode the
+    input). Unknown features are DROPPED (reference: index-map misses are
+    skipped). ``id_columns`` are taken from top-level record fields or the
+    metadataMap (GameConverters:38-110). ``return_index_maps``: return
+    ``(dataset, index_maps)`` so training drivers can persist the scanned
+    feature space without re-scanning.
     """
     feature_shards = dict(feature_shards or {"features": ("features",)})
     file_list = _as_paths(paths)
@@ -707,7 +753,8 @@ def read_game_dataset_from_avro(
         add_intercept, is_response_required,
     )
     if fast is not None:
-        return fast
+        ds, maps = fast
+        return (ds, maps) if return_index_maps else ds
 
     if index_maps is None:
         index_maps = {
@@ -780,13 +827,14 @@ def read_game_dataset_from_avro(
             labels=np.asarray(labels),
             num_features=len(index_maps[shard]),
         )
-    return build_game_dataset(
+    ds = build_game_dataset(
         response=np.asarray(labels),
         feature_shards=shards,
         id_columns={c: np.asarray(v) for c, v in ids.items()},
         offset=np.asarray(offsets),
         weight=np.asarray(weights),
     )
+    return (ds, index_maps) if return_index_maps else ds
 
 
 def write_training_examples(

@@ -414,6 +414,7 @@ def read_game_arrays_native(
     index_maps: Optional[Mapping[str, Mapping[str, int]]],
     id_columns: Sequence[str],
     threads: int = 0,
+    vocab_only: bool = False,
 ):
     """Parse files into columnar arrays, or None if unsupported.
 
@@ -519,21 +520,33 @@ def read_game_arrays_native(
             raise ValueError(f"{path}: {err}")
         try:
             n = lib.avro_rows(handle)
-            labels = np.empty(n, np.float64)
-            offsets = np.empty(n, np.float64)
-            weights = np.empty(n, np.float64)
-            label_seen = np.empty(n, np.uint8)
-            lib.avro_fill_scalars(handle, labels, offsets, weights,
-                                  label_seen)
+            if vocab_only:
+                # index-building wants only the interned key vocabularies:
+                # skip the COO/scalar numpy materialization (the C-side
+                # buffers are freed with the handle)
+                labels = np.zeros(0, np.float64)
+                offsets = weights = labels
+                label_seen = np.zeros(0, np.uint8)
+            else:
+                labels = np.empty(n, np.float64)
+                offsets = np.empty(n, np.float64)
+                weights = np.empty(n, np.float64)
+                label_seen = np.empty(n, np.uint8)
+                lib.avro_fill_scalars(handle, labels, offsets, weights,
+                                      label_seen)
             coo = []
             vocabs = []
             for si in range(len(shard_names)):
-                nnz = lib.avro_shard_nnz(handle, si)
-                v = np.empty(nnz, np.float64)
-                rw = np.empty(nnz, np.int64)
-                cl = np.empty(nnz, np.int64)
-                lib.avro_fill_coo(handle, si, v, rw, cl)
-                coo.append((v, rw, cl))
+                if vocab_only:
+                    coo.append((np.zeros(0), np.zeros(0, np.int64),
+                                np.zeros(0, np.int64)))
+                else:
+                    nnz = lib.avro_shard_nnz(handle, si)
+                    v = np.empty(nnz, np.float64)
+                    rw = np.empty(nnz, np.int64)
+                    cl = np.empty(nnz, np.int64)
+                    lib.avro_fill_coo(handle, si, v, rw, cl)
+                    coo.append((v, rw, cl))
                 if index_maps is None:
                     nv = lib.avro_shard_vocab_size(handle, si)
                     nb = lib.avro_shard_vocab_bytes(handle, si)

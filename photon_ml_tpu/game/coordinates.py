@@ -308,6 +308,15 @@ class FixedEffectCoordinate:
         return dataclasses.replace(model, coefficients=w)
 
     def score(self, model: FixedEffectModel) -> Array:
+        if self._use_tiled:
+            # the solve layout already holds the design in HBM — score
+            # through it instead of uploading a second (COO) copy
+            w = model.coefficients
+            z = self._tiled.dot_rows(w.astype(jnp.float32))
+            n_pad = self.data.shard(self.shard_name).num_rows
+            if z.shape[0] >= n_pad:
+                return z[:n_pad]
+            return jnp.pad(z, (0, n_pad - z.shape[0]))
         return model.score(self.data)
 
 
@@ -337,14 +346,29 @@ def _make_solve_one(config: OptimizerConfig, compute_variances: bool):
     return solve_one
 
 
+def _adapt_solve_one(config, compute_variances: bool, packed: bool):
+    """Per-entity solve body; ``packed`` reassembles a DenseBatch from the
+    flat packed design inside jit (_packed_dense_batch)."""
+    base_one = _make_solve_one(config, compute_variances)
+    if not packed:
+        return base_one
+
+    def solve_one(obj, batch, w0, l1, constraints):
+        return base_one(obj, _packed_dense_batch(batch, w0), w0, l1,
+                        constraints)
+
+    return solve_one
+
+
 @lru_cache(maxsize=64)
 def _re_solver(
     config: OptimizerConfig,
     loss_name: str,
     constrained: bool | str = False,
     compute_variances: bool = False,
+    packed: bool = False,
 ):
-    solve_one = _make_solve_one(config, compute_variances)
+    solve_one = _adapt_solve_one(config, compute_variances, packed)
     # obj, l1 broadcast; batch leaves, w0 (and per-entity constraint boxes,
     # when present) map over the entity axis. constrained="shared" keeps one
     # [K] box broadcast to every entity (the streaming table's dense local
@@ -361,6 +385,7 @@ def _re_solver_sharded(
     axis: str,
     constrained: bool | str = False,
     compute_variances: bool = False,
+    packed: bool = False,
 ):
     """Entity-sharded bucket solver: explicit shard_map over ``axis`` — each
     device runs the vmapped while-loop solve on its local entity block with
@@ -370,7 +395,7 @@ def _re_solver_sharded(
     ``constrained="shared"``: one replicated [K] box for every entity
     (streaming dense space) instead of entity-sharded [E, K] bounds."""
 
-    solve_one = _make_solve_one(config, compute_variances)
+    solve_one = _adapt_solve_one(config, compute_variances, packed)
     c_axis = 0 if constrained is True else None
     c_spec = P(axis) if constrained is True else P()
 
@@ -439,7 +464,28 @@ def _re_scorer():
 
 @lru_cache(maxsize=8)
 def _re_dense_scorer():
-    return jax.jit(lambda coeffs, x: jnp.einsum("erk,ek->er", x, coeffs))
+    def score(coeffs, x_flat):
+        E, K = coeffs.shape
+        x = x_flat.reshape(E, -1, K)
+        return jnp.einsum("erk,ek->er", x, coeffs)
+
+    return jax.jit(score)
+
+
+def _packed_dense_batch(packed, w0):
+    """Reassemble a DenseBatch from the PACKED per-entity design INSIDE
+    jit: the design is stored flat [R*K] per entity (TPU pads a resident
+    [E, R, K] array's K lanes to 128 — 128/K-fold HBM bloat; the flat
+    layout is padding-free and the in-jit reshape is a transient)."""
+    from photon_ml_tpu.ops.dense import DenseBatch
+
+    x_flat, labels, offsets, weights = packed
+    return DenseBatch(
+        x=x_flat.reshape(-1, w0.shape[0]),
+        labels=labels,
+        offsets=offsets,
+        weights=weights,
+    )
 
 
 # Route a bucket's per-entity solves through the DENSE local-design layout
@@ -450,8 +496,11 @@ _DENSE_BYTES_FACTOR = 3.0
 
 
 def _bucket_dense_design(b: EntityBucket) -> Optional[np.ndarray]:
-    """Host-side densified [E, R, K] design for a bucket, or None when the
-    COO layout is the better trade (K large / very sparse locals)."""
+    """Host-side densified design for a bucket as PACKED [E, R*K] rows
+    (row-major per entity), or None when the COO layout is the better
+    trade (K large / very sparse locals). Packed because a resident
+    [E, R, K] device array pads its K lanes to 128 (128/K-fold HBM
+    bloat); solvers reshape inside jit (_packed_dense_batch)."""
     E, R, K = b.num_entities, b.rows_per_entity, b.num_local_features
     nz = b.values.shape[1]
     dense_bytes = E * R * K * 4
@@ -470,7 +519,7 @@ def _bucket_dense_design(b: EntityBucket) -> Optional[np.ndarray]:
     x = np.bincount(
         flat, weights=vals.ravel(), minlength=E * R * K
     ).astype(np.float32)
-    return x.reshape(E, R, K)
+    return x.reshape(E, R * K)
 
 
 @dataclasses.dataclass
@@ -501,12 +550,12 @@ class RandomEffectCoordinate:
                 "coefficient variances need a twice-differentiable loss; "
                 f"'{self.loss_name}' is not"
             )
-        # one shared HBM copy of the bucket stacks (datasets build host-side)
-        self._buckets = self.re_data.device_buckets()
         # dense [E, R, K] designs for small-K buckets: batched-matmul MXU
         # solves (the streaming-path layout) instead of vmapped COO
-        # gather/scatter — measured ~10x on the GLMix RE coordinate
+        # gather/scatter — measured ~10x on the GLMix RE coordinate; the
+        # device bucket copies skip the COO arrays where dense is active
         self._dense_x = self.re_data.dense_designs()
+        self._buckets = self.re_data.device_buckets_for_dense()
         # Box constraints are declared against GLOBAL feature ids
         # (OptimizerConfig constraintMap); each entity's local space is an
         # index-map renumbering (local k <-> global projection[e, k]), so the
@@ -535,8 +584,21 @@ class RandomEffectCoordinate:
                 constrained,
                 self.compute_variances,
             )
+            self._sharded_dense_solver = _re_solver_sharded(
+                key_cfg,
+                self.loss_name,
+                self.mesh,
+                self.mesh.axis_names[0],
+                constrained,
+                self.compute_variances,
+                packed=True,
+            )
         self._solver = _re_solver(
             key_cfg, self.loss_name, constrained, self.compute_variances
+        )
+        self._dense_solver = _re_solver(
+            key_cfg, self.loss_name, constrained, self.compute_variances,
+            packed=True,
         )
         self._scorer = _re_scorer()
         self._obj = make_objective(
@@ -550,15 +612,17 @@ class RandomEffectCoordinate:
         )
 
     def initialize_model(self) -> RandomEffectModel:
+        # dtype from the HOST buckets: dense-routed device buckets carry
+        # f32 placeholder stubs in `values`, not the dataset's dtype
         buckets = tuple(
             RandomEffectBucketModel(
                 coefficients=jnp.zeros(
-                    (b.num_entities, b.num_local_features), b.values.dtype
+                    (b.num_entities, b.num_local_features), hb.values.dtype
                 ),
                 projection=b.projection,
                 entity_codes=b.entity_codes,
             )
-            for b in self._buckets
+            for b, hb in zip(self._buckets, self.re_data.buckets)
         )
         return RandomEffectModel(
             id_name=self.re_data.id_name,
@@ -583,30 +647,34 @@ class RandomEffectCoordinate:
             bucket = (
                 b if residual_scores is None else b.with_extra_offsets(residual_scores)
             )
-            if self._dense_x[i] is not None:
-                from photon_ml_tpu.ops.dense import DenseBatch
-
-                bb = DenseBatch(
-                    x=self._dense_x[i],
-                    labels=bucket.labels,
-                    offsets=bucket.offsets,
-                    weights=bucket.weights,
+            dense = self._dense_x[i] is not None
+            if dense:
+                # packed flat design + per-row arrays; reshaped to
+                # [E, R, K] INSIDE the solver jit (_packed_dense_batch)
+                bb = (
+                    self._dense_x[i],
+                    bucket.labels,
+                    bucket.offsets,
+                    bucket.weights,
                 )
             else:
                 bb = bucket.entity_batch()
             w0 = bm.coefficients
             cons = self._bucket_constraints[i]
             if self.mesh is None:
-                res, var = self._solver(self._obj, bb, w0, self._l1, cons)
+                solver = self._dense_solver if dense else self._solver
+                res, var = solver(self._obj, bb, w0, self._l1, cons)
                 w = res.w
             else:
                 num_e = w0.shape[0]
                 total = -(-num_e // n_dev) * n_dev
                 bb_p, w0_p = _pad_entities(bb, w0, total)
                 cons_p = _pad_constraints(cons, total)
-                res, var = self._sharded_solver(
-                    self._obj, bb_p, w0_p, self._l1, cons_p
+                solver = (
+                    self._sharded_dense_solver if dense
+                    else self._sharded_solver
                 )
+                res, var = solver(self._obj, bb_p, w0_p, self._l1, cons_p)
                 w = res.w[:num_e]
                 if var is not None:
                     var = var[:num_e]

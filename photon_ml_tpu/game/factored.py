@@ -91,22 +91,34 @@ class FactoredRandomEffectModel:
         vals = np.asarray(batch.values)
         rows = np.asarray(batch.rows)
         cols = np.asarray(batch.cols)
-        live = (vals != 0) & (rows < n)
-        v = jnp.asarray(vals[live], batch.dtype)
-        r = jnp.asarray(rows[live], jnp.int32)
-        g = jnp.asarray(cols[live], jnp.int32)
-        f = jnp.asarray(flat_of_row[rows[live]], jnp.int32)
+        live_idx = np.nonzero((vals != 0) & (rows < n))[0]
 
-        c = self.latent[jnp.maximum(f, 0)]  # [m, K]
-        # features beyond the training dimension score 0 (a scoring shard's
-        # vocabulary may be larger than training's; clamped gathers would
-        # otherwise alias them onto the last training column)
-        known = g < self.projection.original_dim
-        a = self.projection.matrix.T[jnp.minimum(g, self.projection.original_dim - 1)]
-        contrib = jnp.where(
-            (f >= 0) & known, v * jnp.sum(c * a, axis=1), 0.0
-        )
-        return jnp.zeros((batch.num_rows,), batch.dtype).at[r].add(contrib)
+        # TRANSPOSED per-nnz gathers in bounded chunks: [K, m] keeps the
+        # long nnz dim in lanes (a [m, K] gather pads lanes 128/K-fold;
+        # measured 12.3 GB of pure padding at K=2 on 16M nnz), and the
+        # chunking bounds the transient at any shard size
+        CHUNK = 8_000_000
+        out = jnp.zeros((batch.num_rows,), batch.dtype)
+        for lo in range(0, len(live_idx), CHUNK):
+            part = live_idx[lo:lo + CHUNK]
+            v = jnp.asarray(vals[part], batch.dtype)
+            r = jnp.asarray(rows[part], jnp.int32)
+            g = jnp.asarray(cols[part], jnp.int32)
+            f = jnp.asarray(flat_of_row[rows[part]], jnp.int32)
+            c_t = self.latent.T[:, jnp.maximum(f, 0)]  # [K, m]
+            # features beyond the training dimension score 0 (a scoring
+            # shard's vocabulary may be larger than training's; clamped
+            # gathers would otherwise alias them onto the last training
+            # column)
+            known = g < self.projection.original_dim
+            a_t = self.projection.matrix[
+                :, jnp.minimum(g, self.projection.original_dim - 1)
+            ]  # [K, m]
+            contrib = jnp.where(
+                (f >= 0) & known, v * jnp.sum(c_t * a_t, axis=0), 0.0
+            )
+            out = out.at[r].add(contrib)
+        return out
 
     def to_summary_string(self) -> str:
         n_models = int(np.sum(self.entity_flat >= 0))
@@ -169,14 +181,27 @@ class MatrixFactorizationModel:
 
 
 @lru_cache(maxsize=64)
-def _latent_design_fn(R: int):
-    """[E]-vmapped projector: per-entity dense latent design X~ [R, K] from
-    local sparse data and A (extended with a zero sentinel column)."""
+def _latent_design_T_fn(R: int):
+    """[E]-vmapped transposed latent design X~^T [K, R].
+
+    TPU layout note: the latent dim K is tiny (2-16) — any tensor with K
+    as the TRAILING dim pads its lanes 128/K-fold (measured 64x = 12.3 GB
+    of padding on a 197 MB gather at K=2). This variant keeps the long
+    dims (NZ, R) in lanes throughout: the per-row reduction is a
+    [K, NZ] @ [NZ, R] one-hot matmul instead of a segment_sum over
+    [NZ, K] rows."""
 
     def one(values, rows, cols, projection, a_ext):
-        g = projection[cols]  # [NZ] global ids (sentinel -> zero col)
-        a = a_ext[:, g]  # [K, NZ]
-        return jax.ops.segment_sum((values[None, :] * a).T, rows, num_segments=R)
+        g = projection[cols]  # [NZ]
+        a = a_ext[:, g]  # [K, NZ] — lanes = NZ
+        contrib = values[None, :] * a  # [K, NZ]
+        onehot = (
+            rows[None, :] == jnp.arange(R, dtype=rows.dtype)[:, None]
+        ).astype(contrib.dtype)  # [R, NZ]
+        return jax.lax.dot_general(
+            contrib, onehot,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+        )  # [K, R]
 
     return jax.jit(jax.vmap(one, in_axes=(0, 0, 0, 0, None)))
 
@@ -190,8 +215,11 @@ def _latent_fit_solver(config: OptimizerConfig, loss_name: str):
 
 
 @jax.jit
-def _kron_values(vals, ent, latent):
-    return (vals[:, None] * latent[ent]).reshape(-1)
+def _kron_values(vals_sorted, flat_idx, latent):
+    """Row-sorted kron values: pre-permuted base values times a FLAT 1-D
+    latent gather (see the construction comment — 2-D/tiny-trailing-dim
+    gathers pad their program temps to 128 lanes at scale)."""
+    return vals_sorted * jnp.take(latent.reshape(-1), flat_idx)
 
 
 @dataclasses.dataclass
@@ -316,8 +344,6 @@ class FactoredRandomEffectCoordinate:
         g_ent = np.concatenate(g_ent) if g_ent else np.zeros(0, np.int64)
         m = len(g_vals)
 
-        self._kron_vals = jnp.asarray(g_vals, self._batch.dtype)
-        self._kron_ent = jnp.asarray(g_ent, jnp.int32)
         kron_rows = np.repeat(g_rows, k)
         kron_cols = (g_cols[:, None] * k + np.arange(k)[None, :]).reshape(-1)
 
@@ -334,10 +360,21 @@ class FactoredRandomEffectCoordinate:
             off[ri[valid]] = np.asarray(b.offsets)[valid]
         self._base_offsets = off
 
-        # order nnz by row for segment-sum friendliness; the permutation to
-        # apply to freshly-computed kron values is exactly this sort order
+        # order nnz by row for segment-sum friendliness. The base values
+        # and flat latent-gather indices are PRE-PERMUTED on the host so
+        # each matrix step is one flat 1-D take (a runtime [m*k]
+        # permutation gather — or a [m, K] latent gather — lowers with
+        # tiny-trailing-dim index/output temps that pad to 128 lanes:
+        # measured 12+ GB of padding at north-star scale).
         o = np.argsort(kron_rows, kind="stable")
-        self._kron_perm = jnp.asarray(o, jnp.int32)
+        bases = o // k
+        lcol = o % k
+        self._kron_vals_sorted = jnp.asarray(
+            g_vals[bases], self._batch.dtype
+        )
+        self._kron_flat_idx = jnp.asarray(
+            g_ent[bases] * k + lcol, jnp.int32
+        )
         self._num_kron_features = d * k
 
         key_re = dataclasses.replace(self.re_config, regularization_weight=0.0)
@@ -472,9 +509,12 @@ class FactoredRandomEffectCoordinate:
         for b_idx, b in enumerate(self.re_data.device_buckets()):
             bucket = b if residual is None else b.with_extra_offsets(residual)
             E, R = b.num_entities, b.rows_per_entity
-            X = _latent_design_fn(R)(
+            # transposed design (long dims in lanes) then one bounded
+            # [E, R, K] transpose: the direct [.., K]-trailing gather pads
+            # lanes 128/K-fold (12.3 GB of padding at K=2 on this bucket)
+            X = _latent_design_T_fn(R)(
                 b.values, b.rows, b.cols, b.projection, a_ext
-            )  # [E, R, K]
+            ).transpose(0, 2, 1)  # [E, R, K]
             dense = SparseBatch(
                 values=X.reshape(E, R * k),
                 rows=jnp.broadcast_to(
@@ -514,8 +554,9 @@ class FactoredRandomEffectCoordinate:
         """Refit vec(A) as one GLM over the static kronecker structure.
         Returns ``(A', SolveResult)`` — tracker construction (4 scalar host
         fetches) is deferred past the MF loop by update_model."""
-        vals = _kron_values(self._kron_vals, self._kron_ent, latent)
-        vals = vals[self._kron_perm]
+        vals = _kron_values(
+            self._kron_vals_sorted, self._kron_flat_idx, latent
+        )
         w0 = a.T.reshape(-1)  # vec layout matches cols j*K + l
         k = self.latent_dim
         if self.mesh is not None:
@@ -602,9 +643,13 @@ class FactoredRandomEffectCoordinate:
         scores = jnp.zeros((n_pad,), jnp.float32)
         for b_idx, b in enumerate(self.re_data.device_buckets()):
             R = b.rows_per_entity
-            X = _latent_design_fn(R)(
+            # same transposed-design + transpose consumption as
+            # _latent_re_step: feeding the [E, K, R] design straight into
+            # an einsum made XLA materialize the inner gather as a
+            # lane-padded [m, K] fusion output (18 GB at 20M rows)
+            X = _latent_design_T_fn(R)(
                 b.values, b.rows, b.cols, b.projection, a_ext
-            )  # [E, R, K]
+            ).transpose(0, 2, 1)  # [E, R, K]
             c = self._bucket_slice(model.latent, b_idx)  # [E, K]
             margins = jnp.einsum("erk,ek->er", X, c)
             idx = b.row_index.reshape(-1)

@@ -128,26 +128,54 @@ class RandomEffectModel:
         cols = np.asarray(batch.cols)
         live = (vals != 0) & (rows < n)
 
+        # nnz are processed in bounded chunks: the per-nnz [*, K] / [K, *]
+        # gathers otherwise materialize O(total_nnz x 128)-padded fusion
+        # outputs (a 20M-row shard measured a 51 GB allocation attempt)
+        CHUNK = 8_000_000
         scores = jnp.zeros((batch.num_rows,), dtype=batch.dtype)
         for b_idx, bm in enumerate(self.buckets):
             sel = live & (row_bucket[np.minimum(rows, n - 1)] == b_idx)
             if not np.any(sel):
                 continue
-            v = jnp.asarray(vals[sel], batch.dtype)
-            r = jnp.asarray(rows[sel], jnp.int32)
-            g = jnp.asarray(cols[sel], jnp.int32)
-            pos = jnp.asarray(row_pos[rows[sel]], jnp.int32)
+            sel_idx = np.nonzero(sel)[0]
+            K = bm.projection.shape[1]
+            for lo in range(0, len(sel_idx), CHUNK):
+                part = sel_idx[lo:lo + CHUNK]
+                v = jnp.asarray(vals[part], batch.dtype)
+                r = jnp.asarray(rows[part], jnp.int32)
+                g = jnp.asarray(cols[part], jnp.int32)
+                pos = jnp.asarray(row_pos[rows[part]], jnp.int32)
 
-            proj_rows = bm.projection[pos]  # [m, K]
-            k = jax.vmap(jnp.searchsorted)(proj_rows, g)  # [m]
-            k = jnp.minimum(k, bm.projection.shape[1] - 1)
-            hit = jnp.take_along_axis(proj_rows, k[:, None], axis=1)[:, 0] == g
-            w = jnp.where(
-                hit,
-                jnp.take_along_axis(bm.coefficients[pos], k[:, None], axis=1)[:, 0],
-                0.0,
-            )
-            scores = scores.at[r].add(v * w)
+                if K <= 64:
+                    # TRANSPOSED compare-scan: [K, m] keeps the long nnz
+                    # dim in lanes (a [m, K] gather pads lanes 128/K-fold
+                    # — at K=4 that is 32x pure padding); each column
+                    # matches at most one projection slot, so the masked
+                    # sum IS the lookup
+                    proj_t = jnp.asarray(bm.projection).T[:, pos]  # [K, m]
+                    coef_t = bm.coefficients.T[:, pos]  # [K, m]
+                    w = jnp.sum(
+                        jnp.where(proj_t == g[None, :], coef_t, 0.0),
+                        axis=0,
+                    )
+                else:
+                    proj_rows = bm.projection[pos]  # [m, K]
+                    k = jax.vmap(jnp.searchsorted)(proj_rows, g)  # [m]
+                    k = jnp.minimum(k, K - 1)
+                    hit = (
+                        jnp.take_along_axis(
+                            proj_rows, k[:, None], axis=1
+                        )[:, 0]
+                        == g
+                    )
+                    w = jnp.where(
+                        hit,
+                        jnp.take_along_axis(
+                            bm.coefficients[pos], k[:, None], axis=1
+                        )[:, 0],
+                        0.0,
+                    )
+                scores = scores.at[r].add(v * w)
         return scores
 
 

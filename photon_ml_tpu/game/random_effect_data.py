@@ -111,18 +111,43 @@ class RandomEffectDataset:
     passive_rows: np.ndarray  # i64[num_passive] global example rows
     num_global_features: int
 
+    def _device_bucket_full(self, i: int) -> EntityBucket:
+        """Per-bucket device-upload memo: every consumer (COO coordinates,
+        the factored coordinate, stripped dense variants sharing the
+        per-row leaves) resolves through ONE upload per bucket. A full
+        bucket requested after its STRIPPED variant reuses the stripped
+        upload's per-row leaves and only adds the COO arrays."""
+        memo = self.__dict__.setdefault("_device_bucket_memo", {})
+        hit = memo.get(i)
+        if hit is None:
+            stripped = self.__dict__.get(
+                "_device_bucket_stripped_memo", {}
+            ).get(i)
+            b = self.buckets[i]
+            if stripped is not None:
+                hit = dataclasses.replace(
+                    stripped,
+                    values=jax.device_put(b.values),
+                    rows=jax.device_put(b.rows),
+                    cols=jax.device_put(b.cols),
+                )
+            else:
+                hit = jax.device_put(b)
+            memo[i] = hit
+        return hit
+
     def device_buckets(self) -> tuple[EntityBucket, ...]:
         """Device copies of the buckets, uploaded once and cached — every
         coordinate/fit over this dataset shares one HBM copy."""
-        cached = self.__dict__.get("_device_buckets")
-        if cached is None:
-            cached = tuple(jax.device_put(b) for b in self.buckets)
-            object.__setattr__(self, "_device_buckets", cached)
-        return cached
+        return tuple(
+            self._device_bucket_full(i) for i in range(len(self.buckets))
+        )
 
     def dense_designs(self) -> tuple:
-        """Per-bucket dense [E, R, K] device designs (None where the COO
-        layout wins) — built host-side once, cached like device_buckets."""
+        """Per-bucket PACKED dense device designs as [E, R*K] rows
+        (row-major per entity; solvers reshape inside jit — see
+        coordinates._packed_dense_batch), or None where the COO layout
+        wins — built host-side once, cached like device_buckets."""
         from photon_ml_tpu.game.coordinates import _bucket_dense_design
 
         cached = self.__dict__.get("_dense_designs")
@@ -132,6 +157,48 @@ class RandomEffectDataset:
                 for x in (_bucket_dense_design(b) for b in self.buckets)
             )
             object.__setattr__(self, "_dense_designs", cached)
+        return cached
+
+    def device_buckets_for_dense(self) -> tuple[EntityBucket, ...]:
+        """Device buckets with the COO arrays STRIPPED for buckets that
+        solve on their dense design (the dense path never touches
+        values/rows/cols — uploading them would double the HBM/transfer
+        cost). Per-row leaves are SHARED with :meth:`device_buckets`'s
+        uploads when those exist, so a dataset serving both a COO consumer
+        (e.g. the factored coordinate) and a dense one holds one copy of
+        everything and the full COO only where someone needs it."""
+        cached = self.__dict__.get("_device_buckets_dense")
+        if cached is None:
+            dense = self.dense_designs()
+            memo = self.__dict__.setdefault("_device_bucket_memo", {})
+            smemo = self.__dict__.setdefault(
+                "_device_bucket_stripped_memo", {}
+            )
+            out = []
+            for i, (b, x) in enumerate(zip(self.buckets, dense)):
+                if x is None:
+                    out.append(self._device_bucket_full(i))
+                    continue
+                full = memo.get(i)
+                if full is not None:
+                    # COO already resident for another consumer — reuse
+                    # its leaves, nothing new to upload
+                    out.append(full)
+                    continue
+                # (1, 1) stubs: a per-entity (E, 1) placeholder would PAD
+                # its lanes 1->128 on TPU — 70 MB of pure padding per stub
+                # at 138K entities
+                stub = np.zeros((1, 1), np.float32)
+                stub_i = np.zeros((1, 1), np.int32)
+                stripped = jax.device_put(
+                    dataclasses.replace(
+                        b, values=stub, rows=stub_i, cols=stub_i
+                    )
+                )
+                smemo[i] = stripped  # later full requests reuse the leaves
+                out.append(stripped)
+            cached = tuple(out)
+            object.__setattr__(self, "_device_buckets_dense", cached)
         return cached
 
     def to_summary_string(self) -> str:

@@ -33,7 +33,13 @@ os.environ.setdefault(
     os.path.join(tempfile.gettempdir(),
                  f"photon_jax_cache_{getpass.getuser()}"),
 )
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+# Cache EVERY program: the suite's cost is hundreds of 0.1-0.5s compiles
+# (profiled: 81 compiles x 0.138s in ONE game test), all below the 1s
+# default write threshold — without this the "warm" suite recompiles
+# nearly everything, and the CLI subprocess tests can never hit the cache
+# their parent process populated.
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
