@@ -192,8 +192,14 @@ def _latent_design_T_fn(R: int):
     [NZ, K] rows."""
 
     def one(values, rows, cols, projection, a_ext):
+        K, d1 = a_ext.shape
         g = projection[cols]  # [NZ]
-        a = a_ext[:, g]  # [K, NZ] — lanes = NZ
+        # FLAT 1-D take from the flattened table: the 2-D-table gather
+        # a_ext[:, g] materializes an [E*NZ, K] fusion output whose K
+        # lanes pad to 128 (an 18 GB allocation at 20M rows); a 1-D-table
+        # take with [K, NZ] indices keeps NZ in lanes throughout
+        idx2 = g[None, :] + (jnp.arange(K, dtype=g.dtype) * d1)[:, None]
+        a = jnp.take(a_ext.reshape(-1), idx2)  # [K, NZ]
         contrib = values[None, :] * a  # [K, NZ]
         onehot = (
             rows[None, :] == jnp.arange(R, dtype=rows.dtype)[:, None]
