@@ -136,7 +136,7 @@ def run_sub_benchmarks():
                 [sys.executable, path],
                 capture_output=True,
                 text=True,
-                timeout=1500 if script != "bench_northstar.py" else 3000,
+                timeout=1500 if script != "bench_northstar.py" else 4500,
                 cwd=here,
             )
             emitted = False

@@ -181,7 +181,7 @@ def _run(workdir):
             "fixed": {
                 "type": "fixed_effect",
                 "shard_name": "movieFeatures",
-                "optimizer": _opt("lbfgs", 12),
+                "optimizer": _opt("lbfgs", 10),
             },
             "per-user": {
                 "type": "random_effect",
