@@ -33,9 +33,14 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from photon_ml_tpu import telemetry
     from photon_ml_tpu.ops.objective import make_objective
     from photon_ml_tpu.ops.tiled import TiledBatch
     from photon_ml_tpu.optim import LBFGSConfig, glm_adapter, lbfgs_solve
+
+    # spans/metrics opt in via PHOTON_TRACE_OUT / PHOTON_TELEMETRY_OUT; the
+    # snapshot below rides the bench JSON either way (one shared schema)
+    telemetry.configure_from_env()
 
     n_rows = 1_000_000
     n_features = 10_000
@@ -82,8 +87,10 @@ def main():
 
     w0 = jnp.zeros((n_features,), jnp.float32)
     t0 = time.perf_counter()
-    res = run_jit(w0, batch)
-    final_value = float(res.value)  # forces execution + D2H sync
+    with telemetry.span("bench_lbfgs", rows=n_rows, features=n_features):
+        res = run_jit(w0, batch)
+        # forces execution + D2H sync, through the accounted fetch point
+        final_value = float(telemetry.sync_fetch(res.value, label="loss"))
     elapsed = time.perf_counter() - t0
 
     iters = int(res.iterations)
@@ -112,6 +119,9 @@ def main():
                     "final_loss": final_value,
                     "platform": jax.devices()[0].platform,
                     "device": str(jax.devices()[0]),
+                    # same schema as TrainingFinishEvent.metrics_snapshot /
+                    # --telemetry-out: fetch + compile accounting for the run
+                    "telemetry": telemetry.snapshot()["counters"],
                 },
             }
         ),

@@ -215,8 +215,14 @@ def _run(workdir):
         "output_dir": model_out,
     }
 
+    from photon_ml_tpu import telemetry
     from photon_ml_tpu.cli.train import run as train_run
     from photon_ml_tpu.cli.score import run as score_run
+
+    # optional span JSONL / metrics flush via PHOTON_TRACE_OUT /
+    # PHOTON_TELEMETRY_OUT; fetch + compile counters ride the JSON below
+    # either way, so "upload+compile dominated" phases are quantified
+    telemetry.configure_from_env()
 
     t0 = time.perf_counter()
     train_summary = train_run(config)
@@ -265,6 +271,10 @@ def _run(workdir):
                         for e in train_summary.get("history", [])
                     ],
                     "platform": jax.devices()[0].platform,
+                    # shared telemetry schema (counters of snapshot()):
+                    # device_fetches / device_fetch_seconds expose the
+                    # ~100ms tunnel tax, jit_compiles the recompile count
+                    "telemetry": telemetry.snapshot()["counters"],
                 },
             },
             default=float,

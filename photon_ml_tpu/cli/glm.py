@@ -37,6 +37,7 @@ from typing import Mapping, Optional
 
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.cli.train import read_input
 from photon_ml_tpu.utils import logger, setup_logging, timed
 from photon_ml_tpu.utils.events import (
@@ -314,9 +315,12 @@ class GLMDriver:
     # -- pipeline ------------------------------------------------------------
 
     def run(self) -> dict:
-        import time
+        from photon_ml_tpu.utils.timing import Timer
 
-        t0 = time.time()
+        t = Timer().start()
+        trace_out = self.config.get("trace_out")
+        if trace_out:
+            telemetry.configure(trace_out=trace_out)
         self.events.send(SetupEvent(config=self.config))
 
         self._assert_stage(DriverStage.INIT)
@@ -357,9 +361,17 @@ class GLMDriver:
         self.events.send(
             TrainingFinishEvent(
                 best_metric=self.best[1] if self.best else None,
-                seconds=time.time() - t0,
+                seconds=t.stop(),
+                metrics_snapshot=telemetry.snapshot(),
             )
         )
+        telemetry_out = self.config.get("telemetry_out")
+        if telemetry_out:
+            telemetry.flush_metrics(telemetry_out)
+        if trace_out:
+            telemetry.export_chrome_trace(
+                trace_out, telemetry.perfetto_path(trace_out)
+            )
         return {
             "stages": [s.name for s in self.stage_history],
             "lambdas": [e.reg_weight for e in self.sweep],
@@ -380,11 +392,25 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--config", required=True, help="JSON config path")
     parser.add_argument("--output-dir", help="override config output_dir")
+    parser.add_argument(
+        "--trace-out",
+        help="write telemetry spans to this JSONL file (+ a sibling "
+        ".perfetto.json Chrome trace); overrides config trace_out",
+    )
+    parser.add_argument(
+        "--telemetry-out",
+        help="append the final metrics snapshot to this JSONL file; "
+        "overrides config telemetry_out",
+    )
     args = parser.parse_args(argv)
 
     setup_logging()
     with open(args.config) as f:
         config = json.load(f)
+    if args.trace_out:
+        config["trace_out"] = args.trace_out
+    if args.telemetry_out:
+        config["telemetry_out"] = args.telemetry_out
     summary = GLMDriver(config, output_dir=args.output_dir).run()
     print(json.dumps(summary, default=float))
     return 0

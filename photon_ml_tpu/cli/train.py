@@ -33,6 +33,7 @@ from typing import Mapping, Optional
 
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.config import parse_game_config
 from photon_ml_tpu.game.dataset import GameDataset, build_game_dataset
 from photon_ml_tpu.game.estimator import GameEstimator
@@ -167,9 +168,16 @@ def _init_distributed_and_mesh(config: Mapping):
 
 
 def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
-    """Execute the training pipeline; returns a JSON-safe summary."""
+    """Execute the training pipeline; returns a JSON-safe summary.
+
+    Config keys ``trace_out`` (span JSONL; a sibling ``.perfetto.json``
+    Chrome trace is written at the end) and ``telemetry_out`` (metrics
+    snapshot JSONL) — the ``--trace-out`` / ``--telemetry-out`` flags."""
     game_config = parse_game_config(config)
     output_dir = output_dir or config.get("output_dir")
+    trace_out = config.get("trace_out")
+    if trace_out:
+        telemetry.configure(trace_out=trace_out)
     mesh = _init_distributed_and_mesh(config)
 
     with timed("read training data"):
@@ -232,6 +240,14 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
             for entry in result.history
         ],
     }
+    telemetry_out = config.get("telemetry_out")
+    if telemetry_out:
+        summary["telemetry"] = telemetry.flush_metrics(telemetry_out)
+    if trace_out:
+        # one Chrome/Perfetto trace next to the span JSONL, ready to open
+        telemetry.export_chrome_trace(
+            trace_out, telemetry.perfetto_path(trace_out)
+        )
     return summary
 
 
@@ -241,11 +257,25 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--config", required=True, help="JSON config path")
     parser.add_argument("--output-dir", help="override config output_dir")
+    parser.add_argument(
+        "--trace-out",
+        help="write telemetry spans to this JSONL file (+ a sibling "
+        ".perfetto.json Chrome trace); overrides config trace_out",
+    )
+    parser.add_argument(
+        "--telemetry-out",
+        help="append the final metrics snapshot to this JSONL file; "
+        "overrides config telemetry_out",
+    )
     args = parser.parse_args(argv)
 
     setup_logging()
     with open(args.config) as f:
         config = json.load(f)
+    if args.trace_out:
+        config["trace_out"] = args.trace_out
+    if args.telemetry_out:
+        config["telemetry_out"] = args.telemetry_out
     summary = run(config, output_dir=args.output_dir)
     print(json.dumps(summary, default=float))
     return 0

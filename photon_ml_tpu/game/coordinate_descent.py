@@ -18,12 +18,12 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import time
 from typing import Mapping, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.evaluation import EVALUATORS, better_than, sharded_auc, sharded_precision_at_k
 from photon_ml_tpu.evaluation.evaluators import parse_evaluator
 from photon_ml_tpu.game.dataset import GameDataset
@@ -122,47 +122,54 @@ def run_coordinate_descent(
     history: list[dict] = []
 
     for it in range(num_iterations):
-        for name in names:
-            coord = coordinates[name]
-            t0 = time.time()
-            residual = None
-            if len(names) > 1:
-                residual = sum(
-                    (scores[o] for o in names if o != name),
-                    start=jnp.zeros_like(scores[name]),
-                )
-            models[name] = coord.update_model(models[name], residual)
-            scores[name] = coord.score(models[name])
-            # force execution before stopping the clock — block_until_ready
-            # is a no-op on the tunnel TPU; a 1-element fetch truly syncs
-            float(scores[name][0])
+        with telemetry.span("cd_iteration", iteration=it):
+            for name in names:
+                coord = coordinates[name]
+                with telemetry.span(f"coordinate:{name}", iteration=it) as sp:
+                    residual = None
+                    if len(names) > 1:
+                        residual = sum(
+                            (scores[o] for o in names if o != name),
+                            start=jnp.zeros_like(scores[name]),
+                        )
+                    models[name] = coord.update_model(models[name], residual)
+                    scores[name] = coord.score(models[name])
+                    # force execution before stopping the clock —
+                    # block_until_ready is a no-op on the tunnel TPU; a
+                    # 1-element fetch truly syncs (and is accounted)
+                    telemetry.sync_fetch(
+                        scores[name][0], label=f"coordinate:{name}"
+                    )
 
-            entry = {
-                "iteration": it,
-                "coordinate": name,
-                "seconds": time.time() - t0,
-            }
-            tracker = getattr(coord, "last_tracker", None)
-            if tracker is not None:
-                # per-update optimization telemetry (the reference's
-                # OptimizationTracker surfaced in CD logs)
-                entry["tracker"] = tracker.to_summary_string()
-            if validation is not None:
-                game_model = GameModel(task=task, models=dict(models))
-                metrics = _evaluate(game_model, validation)
-                entry["metrics"] = metrics
-                primary = validation.evaluators[0]
-                value = metrics[primary]
-                if best_metric is None or better_than(primary, value, best_metric):
-                    best_metric = value
-                    best_model = game_model
-                logger.info(
-                    "CD iter %d coord %s: %s (%.2fs)", it, name, metrics,
-                    entry["seconds"],
-                )
-            history.append(entry)
-            if on_step is not None:
-                on_step(entry)
+                    entry = {
+                        "iteration": it,
+                        "coordinate": name,
+                        "seconds": telemetry.trace.TRACER.now() - sp.ts,
+                    }
+                    tracker = getattr(coord, "last_tracker", None)
+                    if tracker is not None:
+                        # per-update optimization telemetry (the reference's
+                        # OptimizationTracker surfaced in CD logs)
+                        entry["tracker"] = tracker.to_summary_string()
+                    if validation is not None:
+                        game_model = GameModel(task=task, models=dict(models))
+                        metrics = _evaluate(game_model, validation)
+                        entry["metrics"] = metrics
+                        primary = validation.evaluators[0]
+                        value = metrics[primary]
+                        if best_metric is None or better_than(
+                            primary, value, best_metric
+                        ):
+                            best_metric = value
+                            best_model = game_model
+                        logger.info(
+                            "CD iter %d coord %s: %s (%.2fs)", it, name,
+                            metrics, entry["seconds"],
+                        )
+                    sp.set_attr(seconds=round(entry["seconds"], 6))
+                history.append(entry)
+                if on_step is not None:
+                    on_step(entry)
 
     final = GameModel(task=task, models=dict(models))
     if best_model is None:

@@ -340,44 +340,55 @@ class GameEstimator:
         (cli/game/training/Driver.scala:262-312): ``<output_dir>/final`` and
         ``<output_dir>/best`` model directories.
         """
-        import time
-
+        from photon_ml_tpu import telemetry
         from photon_ml_tpu.utils.events import (
             OptimizationLogEvent,
             SetupEvent,
             TrainingFinishEvent,
             TrainingStartEvent,
         )
+        from photon_ml_tpu.utils.timing import Timer
 
-        t0 = time.time()
+        t = Timer().start()
         self.events.send(SetupEvent(config=_config_metadata(self.config)))
-        coordinates = self._build_coordinates(data, mesh)
-        validation = None
-        if validation_data is not None:
-            if not self.config.evaluators:
-                raise ValueError("validation data provided but no evaluators")
-            validation = ValidationSpec(
-                data=validation_data, evaluators=list(self.config.evaluators)
-            )
-        self.events.send(TrainingStartEvent(num_rows=data.num_rows))
-        result: CoordinateDescentResult = run_coordinate_descent(
-            coordinates,
+        with telemetry.span(
+            "fit",
             task=self.config.task,
-            num_iterations=self.config.num_iterations,
-            validation=validation,
-            initial_models=initial_models,
-            on_step=lambda entry: self.events.send(
-                OptimizationLogEvent(
-                    iteration=entry["iteration"],
-                    coordinate=entry["coordinate"],
-                    seconds=entry["seconds"],
-                    metrics=entry.get("metrics"),
+            num_coordinates=len(self.config.coordinates),
+        ):
+            with telemetry.span("build_coordinates"):
+                coordinates = self._build_coordinates(data, mesh)
+            validation = None
+            if validation_data is not None:
+                if not self.config.evaluators:
+                    raise ValueError(
+                        "validation data provided but no evaluators"
+                    )
+                validation = ValidationSpec(
+                    data=validation_data,
+                    evaluators=list(self.config.evaluators),
                 )
-            ),
-        )
+            self.events.send(TrainingStartEvent(num_rows=data.num_rows))
+            result: CoordinateDescentResult = run_coordinate_descent(
+                coordinates,
+                task=self.config.task,
+                num_iterations=self.config.num_iterations,
+                validation=validation,
+                initial_models=initial_models,
+                on_step=lambda entry: self.events.send(
+                    OptimizationLogEvent(
+                        iteration=entry["iteration"],
+                        coordinate=entry["coordinate"],
+                        seconds=entry["seconds"],
+                        metrics=entry.get("metrics"),
+                    )
+                ),
+            )
         self.events.send(
             TrainingFinishEvent(
-                best_metric=result.best_metric, seconds=time.time() - t0
+                best_metric=result.best_metric,
+                seconds=t.stop(),
+                metrics_snapshot=telemetry.snapshot(),
             )
         )
         fit = GameFitResult(
@@ -428,8 +439,8 @@ class GameEstimator:
         if unknown:
             raise ValueError(f"grid names unknown coordinates: {sorted(unknown)}")
         import itertools
-        import time
 
+        from photon_ml_tpu import telemetry
         from photon_ml_tpu.evaluation import better_than
         from photon_ml_tpu.utils.events import (
             OptimizationLogEvent,
@@ -469,28 +480,33 @@ class GameEstimator:
                 out[n] = coord_cache[key]
             return out
 
+        from photon_ml_tpu.utils.timing import Timer
+
         entries: list[GridFitEntry] = []
-        for combo in combos:
+        for i, combo in enumerate(combos):
             overrides = dict(zip(names, combo))
-            t0 = time.time()
+            t = Timer().start()
             self.events.send(TrainingStartEvent(num_rows=data.num_rows))
-            result = run_coordinate_descent(
-                coordinates_for(overrides),
-                task=self.config.task,
-                num_iterations=self.config.num_iterations,
-                validation=validation,
-                on_step=lambda entry: self.events.send(
-                    OptimizationLogEvent(
-                        iteration=entry["iteration"],
-                        coordinate=entry["coordinate"],
-                        seconds=entry["seconds"],
-                        metrics=entry.get("metrics"),
-                    )
-                ),
-            )
+            with telemetry.span("fit", task=self.config.task, combination=i):
+                result = run_coordinate_descent(
+                    coordinates_for(overrides),
+                    task=self.config.task,
+                    num_iterations=self.config.num_iterations,
+                    validation=validation,
+                    on_step=lambda entry: self.events.send(
+                        OptimizationLogEvent(
+                            iteration=entry["iteration"],
+                            coordinate=entry["coordinate"],
+                            seconds=entry["seconds"],
+                            metrics=entry.get("metrics"),
+                        )
+                    ),
+                )
             self.events.send(
                 TrainingFinishEvent(
-                    best_metric=result.best_metric, seconds=time.time() - t0
+                    best_metric=result.best_metric,
+                    seconds=t.stop(),
+                    metrics_snapshot=telemetry.snapshot(),
                 )
             )
             entries.append(

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.ops.dense import DenseBatch
 from photon_ml_tpu.ops.objective import make_objective
 from photon_ml_tpu.optim.factory import OptimizerConfig
@@ -318,8 +319,11 @@ class StreamingRandomEffectTrainer:
             )
         w0 = table.read_chunk(start, size)
         cons = self._chunk_constraints(table.dim)
-        res, var = self._solver(self._obj, batch, w0, self._l1, cons)
-        table.write_chunk(start, res.w)
+        with telemetry.span("streaming_chunk", start=start, size=int(size)):
+            res, var = self._solver(self._obj, batch, w0, self._l1, cons)
+            table.write_chunk(start, res.w)
+        telemetry.counter("streaming_chunks").inc()
+        telemetry.counter("streaming_entities").inc(int(size))
         if var is not None:
             if variance_table is None:
                 raise ValueError(
@@ -374,7 +378,9 @@ class StreamingRandomEffectTrainer:
                     self._solve(table, *pending, variance_table=variance_table)
                 )
         else:
-            # control arm: serialize transfer and compute completely
+            # control arm: serialize transfer and compute completely — a
+            # 1-element fetch is the only true sync through the tunnel
+            # (block_until_ready is a no-op there, tools/check.py L007)
             for start, source in chunks:
                 results.append(
                     self._solve(
@@ -384,11 +390,13 @@ class StreamingRandomEffectTrainer:
                         variance_table=variance_table,
                     )
                 )
-                jax.block_until_ready(table.coefficients)
+                telemetry.sync_fetch(
+                    table.coefficients[start, 0], label="streaming_sync"
+                )
         if not results:
             return StreamingTrainStats(0, 0, 0, 0.0, 0.0)
         # ONE device->host fetch for the scalar summaries
-        sums = np.asarray(
+        sums = telemetry.sync_fetch(
             jnp.stack(
                 [
                     jnp.sum(
@@ -399,7 +407,8 @@ class StreamingRandomEffectTrainer:
                     ),
                     jnp.sum(jnp.stack([jnp.sum(r.values) for r in results])),
                 ]
-            )
+            ),
+            label="streaming_summary",
         )
         tracker = None
         if with_tracker:

@@ -16,6 +16,7 @@ import dataclasses
 import numpy as np
 
 from photon_ml_tpu.optim.common import CONVERGENCE_REASON_NAMES
+from photon_ml_tpu.telemetry import metrics as _metrics
 
 
 @dataclasses.dataclass(frozen=True)
@@ -30,6 +31,8 @@ class FixedEffectOptimizationTracker:
     @staticmethod
     def from_result(res) -> "FixedEffectOptimizationTracker":
         it = int(res.iterations)
+        _metrics.counter("fe_solves").inc()
+        _metrics.histogram("fe_solve_iterations").observe(it)
         return FixedEffectOptimizationTracker(
             iterations=it,
             reason=CONVERGENCE_REASON_NAMES.get(int(res.reason), "Unknown"),
@@ -75,16 +78,19 @@ class RandomEffectOptimizationTracker:
         """Build from per-bucket DEVICE arrays (padding already sliced off)
         with ONE packed host fetch: the f32 terminal values ride the i32
         concat via bitcast — each device->host fetch costs a ~100ms tunnel
-        round trip, so all three telemetry vectors cross together."""
+        round trip, so all three telemetry vectors cross together (and the
+        crossing is accounted by telemetry.sync_fetch)."""
         import jax
         import jax.numpy as jnp
+
+        from photon_ml_tpu.telemetry import sync_fetch
 
         if not its:
             z = np.zeros(0, np.int32)
             return RandomEffectOptimizationTracker(
                 iterations=z, reasons=z, final_values=np.zeros(0, np.float32)
             )
-        packed = np.asarray(
+        packed = sync_fetch(
             jnp.concatenate(
                 [
                     jnp.concatenate(its).astype(jnp.int32),
@@ -93,14 +99,22 @@ class RandomEffectOptimizationTracker:
                         jnp.concatenate(vals).astype(jnp.float32), jnp.int32
                     ),
                 ]
-            )
+            ),
+            label="re_tracker",
         )
         n = len(packed) // 3
-        return RandomEffectOptimizationTracker(
+        tracker = RandomEffectOptimizationTracker(
             iterations=packed[:n],
             reasons=packed[n : 2 * n],
             final_values=packed[2 * n :].view(np.float32),
         )
+        _metrics.counter("re_solved_entities").inc(n)
+        # per-entity solve-iteration distribution, the registry-level view
+        # of getNumIterationStats (fed once per coordinate update)
+        _metrics.histogram("re_solve_iterations").observe_many(
+            tracker.iterations
+        )
+        return tracker
 
     def count_convergence_reasons(self) -> dict[str, int]:
         """countConvergenceReasons analog: reason name -> entity count."""

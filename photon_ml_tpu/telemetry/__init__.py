@@ -1,0 +1,109 @@
+"""Structured telemetry: tracing spans, a metrics registry, and
+device/transfer accounting for the whole training stack.
+
+Three layers (ISSUE: you can't optimize what you can't measure):
+
+- :mod:`photon_ml_tpu.telemetry.trace` — ``span(name, **attrs)`` opens a
+  node of a thread-safe hierarchical span tree with a JSONL sink and a
+  Chrome-trace/Perfetto exporter. ``utils.timing.timed()`` is a thin
+  wrapper over it, so every driver phase is already a span.
+- :mod:`photon_ml_tpu.telemetry.metrics` — process-global counters /
+  gauges / histograms with a ``snapshot()`` dict and a JSONL flush;
+  attached to the final ``TrainingFinishEvent`` and the bench JSON.
+- :mod:`photon_ml_tpu.telemetry.device` — ``sync_fetch()``, the one
+  sanctioned device->host fetch point (fetches / bytes / blocking
+  seconds), plus per-compile counters via ``jax.monitoring``.
+
+Typical use::
+
+    from photon_ml_tpu import telemetry
+
+    telemetry.configure(trace_out="run.trace.jsonl")
+    with telemetry.span("fit", task="logistic"):
+        ...
+        value = float(telemetry.sync_fetch(result.value, label="loss"))
+    telemetry.flush_metrics("run.metrics.jsonl")
+    telemetry.export_chrome_trace("run.trace.jsonl", "run.perfetto.json")
+
+Importing this package installs the jit compile hooks (idempotent, and a
+no-op without jax.monitoring), so recompiles are counted from the first
+traced program onward.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from photon_ml_tpu.telemetry import metrics, trace  # noqa: F401
+from photon_ml_tpu.telemetry.device import (  # noqa: F401
+    install_compile_hooks,
+    sync_fetch,
+)
+from photon_ml_tpu.telemetry.metrics import (  # noqa: F401
+    counter,
+    gauge,
+    histogram,
+    snapshot,
+)
+from photon_ml_tpu.telemetry.metrics import flush_jsonl as flush_metrics  # noqa: F401
+from photon_ml_tpu.telemetry.trace import (  # noqa: F401
+    add_event,
+    current_span,
+    export_chrome_trace,
+    finished_spans,
+    perfetto_path,
+    span,
+    to_chrome_trace,
+)
+
+__all__ = [
+    "span",
+    "current_span",
+    "add_event",
+    "finished_spans",
+    "counter",
+    "gauge",
+    "histogram",
+    "snapshot",
+    "flush_metrics",
+    "sync_fetch",
+    "install_compile_hooks",
+    "to_chrome_trace",
+    "export_chrome_trace",
+    "perfetto_path",
+    "configure",
+    "configure_from_env",
+    "reset",
+]
+
+
+def configure(
+    trace_out: Optional[str] = None,
+    buffer_limit: Optional[int] = None,
+) -> None:
+    """Point the span JSONL sink at ``trace_out`` (None = leave as-is)."""
+    trace.configure(jsonl_path=trace_out, buffer_limit=buffer_limit)
+
+
+def configure_from_env() -> None:
+    """Honor ``PHOTON_TRACE_OUT`` / ``PHOTON_TELEMETRY_OUT`` env vars: the
+    span sink opens immediately; the metrics snapshot flushes at process
+    exit. Lets benchmarks and ad-hoc scripts opt in without new flags."""
+    trace_out = os.environ.get("PHOTON_TRACE_OUT")
+    if trace_out:
+        configure(trace_out=trace_out)
+    metrics_out = os.environ.get("PHOTON_TELEMETRY_OUT")
+    if metrics_out:
+        import atexit
+
+        atexit.register(flush_metrics, metrics_out)
+
+
+def reset() -> None:
+    """Clear spans and metrics and close the trace sink (test isolation)."""
+    trace.reset()
+    metrics.reset()
+
+
+install_compile_hooks()

@@ -1,0 +1,108 @@
+"""Device/transfer accounting: the sanctioned device->host fetch point and
+jit compile counters.
+
+PERF_NOTES.md's two invisible costs become metrics here:
+
+- Every host-visible fetch through the tunnel costs ~100 ms of fixed
+  latency, and ``block_until_ready()`` is a NO-OP there — a device->host
+  fetch is the only true sync. :func:`sync_fetch` is the one place the
+  library crosses that boundary: it counts fetches, bytes, and blocking
+  seconds, and stamps a ``device_fetch`` event on the open span
+  (``tools/check.py`` L007 points bare ``block_until_ready()`` calls here).
+- Silent recompiles dominated the 20M north-star run (FE 1501 s
+  "upload+compile dominated"). :func:`install_compile_hooks` subscribes to
+  ``jax.monitoring``'s backend-compile duration events, so every compile
+  increments ``jit_compiles``, feeds the ``jit_compile_seconds`` histogram,
+  and shows up as a named ``compile`` event on whatever span was open.
+
+Metric names emitted:
+
+- ``device_fetches`` / ``device_fetch_bytes`` / ``device_fetch_seconds``
+  (counters) and ``device_fetch_seconds`` (histogram)
+- ``jit_compiles`` / ``jit_compile_seconds`` (counter) and
+  ``jit_compile_seconds`` (histogram)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from photon_ml_tpu.telemetry import metrics, trace
+
+__all__ = ["sync_fetch", "install_compile_hooks"]
+
+# jax.monitoring duration events counted as compiles: the backend (XLA)
+# compile is the expensive one; trace/lowering durations are recorded
+# under their own short names for completeness.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_hooks_lock = threading.Lock()
+_hooks_installed = False
+
+
+def sync_fetch(x: Any, label: Optional[str] = None) -> np.ndarray:
+    """Fetch a device array to the host — the ONE sanctioned sync point.
+
+    Returns ``np.asarray(x)`` (a true device->host copy, which really
+    synchronizes even through the tunnel, unlike ``block_until_ready``)
+    while accounting for the crossing: counters ``device_fetches``,
+    ``device_fetch_bytes``, ``device_fetch_seconds``, a blocking-time
+    histogram, and a ``device_fetch`` event on the current span.
+
+    Use it for every result the host must observe (convergence scalars,
+    tracker vectors, timing syncs); batch values into one array first —
+    each call pays the full tunnel round trip.
+    """
+    t0 = time.monotonic()
+    out = np.asarray(x)
+    dt = time.monotonic() - t0
+    metrics.counter("device_fetches").inc()
+    metrics.counter("device_fetch_bytes").inc(out.nbytes)
+    metrics.counter("device_fetch_seconds").inc(dt)
+    metrics.histogram("device_fetch_seconds").observe(dt)
+    trace.add_event(
+        "device_fetch",
+        label=label,
+        bytes=out.nbytes,
+        seconds=round(dt, 6),
+    )
+    return out
+
+
+def install_compile_hooks() -> bool:
+    """Subscribe compile counters to ``jax.monitoring`` (idempotent).
+
+    Returns True when the hook is (already) installed, False when the
+    running jax has no monitoring API. Registered once per process; jax
+    offers no unregister, so the listener guards itself against a reset
+    registry and never raises into the compiler.
+    """
+    global _hooks_installed
+    with _hooks_lock:
+        if _hooks_installed:
+            return True
+        try:
+            from jax import monitoring
+        except ImportError:
+            return False
+        if not hasattr(monitoring, "register_event_duration_secs_listener"):
+            return False
+
+        def _on_duration(event: str, duration: float, **_kw: Any) -> None:
+            try:
+                if event != _COMPILE_EVENT:
+                    return
+                metrics.counter("jit_compiles").inc()
+                metrics.counter("jit_compile_seconds").inc(duration)
+                metrics.histogram("jit_compile_seconds").observe(duration)
+                trace.add_event("compile", seconds=round(duration, 6))
+            except Exception:  # noqa: BLE001 — never fail a compile
+                pass
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _hooks_installed = True
+        return True

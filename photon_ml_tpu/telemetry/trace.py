@@ -1,0 +1,343 @@
+"""Hierarchical tracing spans with a JSONL sink and a Chrome-trace exporter.
+
+The photon-ml driver wraps every phase in named ``Timed`` blocks
+(util/Timed.scala) but only ever logs flat durations. Here every phase is a
+*span* in a thread-safe tree: ``with trace.span("fit"):`` nests under
+whatever span is open on the current thread, records monotonic wall time,
+arbitrary attributes, and point-in-time events (device fetches, jit
+compiles). Completed spans stream to a JSONL file (one object per line) and
+convert to the Chrome trace-event format, so a full GAME fit opens as a
+flame chart in Perfetto (https://ui.perfetto.dev).
+
+Durations use ``time.monotonic()`` exclusively — wall-clock steps (NTP,
+DST) corrupt phase timings (PERF_NOTES.md "fake timing" gotcha). The one
+wall-clock anchor, recorded at configure time for human correlation, comes
+from ``datetime`` so the ``time.time()`` lint stays meaningful.
+
+Span JSONL schema (one line per completed span)::
+
+    {"type": "span", "id": 7, "parent": 3, "name": "coordinate:fixed",
+     "ts": 1.042, "dur": 0.381, "thread": "MainThread",
+     "attrs": {"iteration": 0},
+     "events": [{"name": "device_fetch", "ts": 1.401,
+                 "attrs": {"bytes": 4, "seconds": 0.1}}]}
+
+``ts`` is seconds since the tracer's monotonic anchor; ``events[].ts``
+shares the same timebase.
+"""
+
+from __future__ import annotations
+
+import datetime
+import itertools
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Iterable, Iterator, Optional
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "TRACER",
+    "span",
+    "current_span",
+    "add_event",
+    "configure",
+    "reset",
+    "finished_spans",
+    "to_chrome_trace",
+    "export_chrome_trace",
+    "perfetto_path",
+]
+
+
+class Span:
+    """One timed phase: a node of the per-thread span tree."""
+
+    __slots__ = (
+        "name", "span_id", "parent_id", "ts", "dur", "attrs", "events",
+        "thread",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        span_id: int,
+        parent_id: Optional[int],
+        ts: float,
+        thread: str,
+        attrs: dict[str, Any],
+    ):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.ts = ts
+        self.dur: Optional[float] = None  # set when the span closes
+        self.attrs = attrs
+        self.events: list[dict[str, Any]] = []
+        self.thread = thread
+
+    def set_attr(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def add_event(self, name: str, ts: float, **attrs: Any) -> None:
+        self.events.append({"name": name, "ts": ts, "attrs": attrs})
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "type": "span",
+            "id": self.span_id,
+            "parent": self.parent_id,
+            "name": self.name,
+            "ts": round(self.ts, 6),
+            "dur": None if self.dur is None else round(self.dur, 6),
+            "thread": self.thread,
+            "attrs": self.attrs,
+            "events": self.events,
+        }
+
+
+class Tracer:
+    """Thread-safe span collector: per-thread open-span stacks, a shared
+    bounded buffer of completed spans, and an optional JSONL sink.
+
+    Tracing must never fail training: sink write errors are swallowed after
+    disabling the sink, and attribute values that are not JSON-serializable
+    are stringified.
+    """
+
+    def __init__(self, buffer_limit: int = 50_000):
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._anchor = time.monotonic()
+        self._finished: list[Span] = []
+        # every thread's open-span stack, so reset() can clear them ALL
+        # (threading.local is only visible from its own thread)
+        self._all_stacks: list[list[Span]] = []
+        self._buffer_limit = buffer_limit
+        self._sink_path: Optional[str] = None
+        self._sink_fh = None
+        self._wall_anchor: Optional[str] = None
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(
+        self,
+        jsonl_path: Optional[str] = None,
+        buffer_limit: Optional[int] = None,
+    ) -> None:
+        """Set (or replace) the JSONL sink and/or the in-memory buffer cap."""
+        with self._lock:
+            if buffer_limit is not None:
+                self._buffer_limit = int(buffer_limit)
+            if jsonl_path is not None and jsonl_path != self._sink_path:
+                self._close_sink_locked()
+                self._sink_path = jsonl_path
+                # truncate: one session per file — appending a rerun would
+                # mix incompatible monotonic timebases (and a second
+                # mid-file trace_header) into one Perfetto export
+                self._sink_fh = open(jsonl_path, "w", encoding="utf-8")
+                self._wall_anchor = datetime.datetime.now(
+                    datetime.timezone.utc
+                ).isoformat()
+                header = {
+                    "type": "trace_header",
+                    "wall_time": self._wall_anchor,
+                    "monotonic_anchor": round(time.monotonic() - self._anchor, 6),
+                }
+                self._sink_fh.write(json.dumps(header) + "\n")
+                self._sink_fh.flush()
+
+    def _close_sink_locked(self) -> None:
+        if self._sink_fh is not None:
+            try:
+                self._sink_fh.close()
+            except OSError:
+                pass
+        self._sink_fh = None
+        self._sink_path = None
+
+    def reset(self) -> None:
+        """Drop all finished spans, close the sink, and clear EVERY
+        thread's open-span stack (test isolation; a span left open on a
+        worker thread must not parent post-reset spans)."""
+        with self._lock:
+            self._finished.clear()
+            self._close_sink_locked()
+            for stack in self._all_stacks:
+                stack.clear()
+
+    # -- span lifecycle ------------------------------------------------------
+
+    def _stack(self) -> list[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+            with self._lock:
+                self._all_stacks.append(stack)
+        return stack
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def now(self) -> float:
+        """Seconds on the tracer's monotonic timebase."""
+        return time.monotonic() - self._anchor
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Span]:
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        s = Span(
+            name=name,
+            span_id=next(self._ids),
+            parent_id=None if parent is None else parent.span_id,
+            ts=self.now(),
+            thread=threading.current_thread().name,
+            attrs=dict(attrs),
+        )
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.dur = self.now() - s.ts
+            # close even if exits arrive out of order (a leaked child span)
+            while stack and stack[-1] is not s:
+                stack.pop()
+            if stack:
+                stack.pop()
+            self._finish(s)
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        """Attach a point-in-time event to the current span (no-op when no
+        span is open — telemetry must never fail the caller)."""
+        cur = self.current()
+        if cur is not None:
+            cur.add_event(name, ts=self.now(), **attrs)
+
+    def _finish(self, s: Span) -> None:
+        with self._lock:
+            self._finished.append(s)
+            if len(self._finished) > self._buffer_limit:
+                del self._finished[: len(self._finished) - self._buffer_limit]
+            if self._sink_fh is not None:
+                try:
+                    self._sink_fh.write(
+                        json.dumps(s.to_dict(), default=str) + "\n"
+                    )
+                    self._sink_fh.flush()
+                except (OSError, ValueError):
+                    self._close_sink_locked()  # never fail training
+
+    # -- inspection ----------------------------------------------------------
+
+    def finished_spans(self, name: Optional[str] = None) -> list[Span]:
+        with self._lock:
+            spans = list(self._finished)
+        if name is not None:
+            spans = [s for s in spans if s.name == name]
+        return spans
+
+
+#: Process-global tracer; module-level helpers below delegate to it.
+TRACER = Tracer()
+
+span = TRACER.span
+current_span = TRACER.current
+add_event = TRACER.add_event
+configure = TRACER.configure
+reset = TRACER.reset
+finished_spans = TRACER.finished_spans
+
+
+# -- Chrome trace (Perfetto) export ------------------------------------------
+
+
+def to_chrome_trace(records: Iterable[dict]) -> dict:
+    """Convert span dicts (``Span.to_dict()`` / JSONL lines) to the Chrome
+    trace-event JSON object Perfetto and chrome://tracing load directly.
+
+    Complete spans become ``ph: "X"`` duration events; span events become
+    ``ph: "i"`` thread-scoped instants. Timestamps are microseconds on the
+    tracer's monotonic timebase.
+    """
+    tids: dict[str, int] = {}
+    events: list[dict] = []
+    meta: list[dict] = []
+
+    def tid(thread: str) -> int:
+        if thread not in tids:
+            tids[thread] = len(tids) + 1
+            meta.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 1,
+                    "tid": tids[thread],
+                    "args": {"name": thread},
+                }
+            )
+        return tids[thread]
+
+    for rec in records:
+        if rec.get("type") != "span":
+            continue
+        t = tid(rec.get("thread", "main"))
+        events.append(
+            {
+                "name": rec["name"],
+                "cat": "span",
+                "ph": "X",
+                "ts": round(rec["ts"] * 1e6, 3),
+                "dur": round((rec.get("dur") or 0.0) * 1e6, 3),
+                "pid": 1,
+                "tid": t,
+                "args": rec.get("attrs", {}),
+            }
+        )
+        for ev in rec.get("events", ()):
+            events.append(
+                {
+                    "name": ev["name"],
+                    "cat": "event",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": round(ev["ts"] * 1e6, 3),
+                    "pid": 1,
+                    "tid": t,
+                    "args": ev.get("attrs", {}),
+                }
+            )
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def perfetto_path(trace_out: str) -> str:
+    """The sibling ``.perfetto.json`` path for a span JSONL path (shared by
+    every driver that auto-exports a Chrome trace next to its JSONL)."""
+    base = trace_out[:-6] if trace_out.endswith(".jsonl") else trace_out
+    return base + ".perfetto.json"
+
+
+def export_chrome_trace(jsonl_path: str, out_path: str) -> int:
+    """Convert a span JSONL file to a Chrome/Perfetto trace JSON file.
+
+    Returns the number of trace events written. Unparseable lines are
+    skipped (a crashed run leaves a truncated last line)."""
+    records = []
+    with open(jsonl_path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    doc = to_chrome_trace(records)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    return len(doc["traceEvents"])

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.data.normalization import NormalizationContext
 from photon_ml_tpu.evaluation.evaluators import EVALUATORS, better_than
 from photon_ml_tpu.models.glm import GeneralizedLinearModel, make_model
@@ -141,55 +142,63 @@ def train_glm(
 
     results: dict[int, SweepEntry] = {}
     w_prev = w_start
-    for i in order:
-        lam = float(lambdas[i])
-        l2 = config.regularization.l2_weight(lam)
-        l1 = config.regularization.l1_weight(lam)
-        if mesh is not None:
-            res = distributed_solve(
-                task,
-                batch,
-                dataclasses.replace(config, regularization_weight=lam),
-                w_prev,
-                mesh,
-                axis=axis,
-                constraints=constraints,
-                factors=factors,
-                shifts=shifts,
-            )
-        else:
-            res = _solve(
-                base_obj.with_l2(l2), batch, w_prev, jnp.float32(l1), constraints
-            )
-        w_opt = res.w
-        w_prev = w_opt  # warm start the next (smaller) lambda
+    with telemetry.span("train_glm", task=task, num_lambdas=len(lambdas)):
+        for i in order:
+            lam = float(lambdas[i])
+            with telemetry.span("lambda_solve", reg_weight=lam):
+                l2 = config.regularization.l2_weight(lam)
+                l1 = config.regularization.l1_weight(lam)
+                if mesh is not None:
+                    res = distributed_solve(
+                        task,
+                        batch,
+                        dataclasses.replace(
+                            config, regularization_weight=lam
+                        ),
+                        w_prev,
+                        mesh,
+                        axis=axis,
+                        constraints=constraints,
+                        factors=factors,
+                        shifts=shifts,
+                    )
+                else:
+                    res = _solve(
+                        base_obj.with_l2(l2), batch, w_prev, jnp.float32(l1),
+                        constraints,
+                    )
+                w_opt = res.w
+                w_prev = w_opt  # warm start the next (smaller) lambda
+                telemetry.counter("glm_sweep_solves").inc()
 
-        variances = None
-        if compute_variances:
-            if not get_loss(task).has_hessian:
-                raise ValueError(
-                    f"variances need a twice-differentiable loss; '{task}' is not"
+                variances = None
+                if compute_variances:
+                    if not get_loss(task).has_hessian:
+                        raise ValueError(
+                            "variances need a twice-differentiable loss; "
+                            f"'{task}' is not"
+                        )
+                    obj_l = base_obj.with_l2(l2)
+                    variances = _variances(obj_l, w_opt, batch, mesh, axis)
+
+                means = w_opt
+                if normalization is not None:
+                    means = normalization.transform_model_coefficients(w_opt)
+                    if variances is not None:
+                        # DELIBERATE deviation from the reference, which
+                        # applies the MEANS transform to variances too
+                        # (GeneralizedLinearOptimizationProblem.scala:90-96)
+                        # — that scales by factor instead of factor^2 and
+                        # the intercept shift cross-term can drive variances
+                        # negative. Var(c*X) = c^2 Var(X): scale by
+                        # factor^2, no shift term.
+                        if normalization.factors is not None:
+                            variances = variances * normalization.factors**2
+                results[i] = SweepEntry(
+                    reg_weight=lam,
+                    model=make_model(task, means, variances=variances),
+                    result=res,
                 )
-            obj_l = base_obj.with_l2(l2)
-            variances = _variances(obj_l, w_opt, batch, mesh, axis)
-
-        means = w_opt
-        if normalization is not None:
-            means = normalization.transform_model_coefficients(w_opt)
-            if variances is not None:
-                # DELIBERATE deviation from the reference, which applies the
-                # MEANS transform to variances too
-                # (GeneralizedLinearOptimizationProblem.scala:90-96) — that
-                # scales by factor instead of factor^2 and the intercept
-                # shift cross-term can drive variances negative. Var(c*X) =
-                # c^2 Var(X): scale by factor^2, no shift term.
-                if normalization.factors is not None:
-                    variances = variances * normalization.factors**2
-        results[i] = SweepEntry(
-            reg_weight=lam,
-            model=make_model(task, means, variances=variances),
-            result=res,
-        )
 
     return [results[i] for i in range(len(lambdas))]
 

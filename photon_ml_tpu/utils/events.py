@@ -36,8 +36,17 @@ class TrainingStartEvent(Event):
 
 @dataclasses.dataclass(frozen=True)
 class TrainingFinishEvent(Event):
+    """End-of-training event; ``metrics_snapshot`` carries the process
+    telemetry registry state (``telemetry.snapshot()``) at finish time, so
+    listeners see fetch/compile/solve counters without importing telemetry.
+
+    Counters are CUMULATIVE across the process, not per-fit: repeated
+    ``fit()`` calls (or ``fit_grid`` combinations) each report the running
+    totals — diff consecutive snapshots for per-run deltas."""
+
     best_metric: Optional[float]
     seconds: float
+    metrics_snapshot: Optional[Mapping[str, Any]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,18 +97,31 @@ class EventEmitter:
     """register/send/clear listener registry (EventEmitter.scala analog).
 
     A listener raising is logged and skipped — observability must never
-    fail training."""
+    fail training. ``register`` is idempotent (a listener registered twice
+    would double-fire on every OptimizationLogEvent) and every send bumps a
+    per-event-type telemetry counter (``events.<EventClassName>``)."""
 
     def __init__(self):
         self._listeners: list[Callable[[Event], None]] = []
 
     def register(self, listener: Callable[[Event], None]) -> None:
-        self._listeners.append(listener)
+        if listener not in self._listeners:
+            self._listeners.append(listener)
+
+    def unregister(self, listener: Callable[[Event], None]) -> None:
+        """Remove one listener; unknown listeners are a no-op."""
+        try:
+            self._listeners.remove(listener)
+        except ValueError:
+            pass
 
     def clear(self) -> None:
         self._listeners.clear()
 
     def send(self, event: Event) -> None:
+        from photon_ml_tpu.telemetry.metrics import counter
+
+        counter(f"events.{type(event).__name__}").inc()
         for listener in self._listeners:
             try:
                 listener(event)

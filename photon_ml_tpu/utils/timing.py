@@ -4,6 +4,10 @@ Reference analog: photon-lib util/Timed.scala:33-77 (named duration blocks
 logged around every driver phase, cli/game/training/Driver.scala:60-86) and
 util/Timer.scala; PhotonLogger's role (SLF4J to HDFS) collapses to stdlib
 logging configured once per process.
+
+``timed()`` is a thin wrapper over :func:`photon_ml_tpu.telemetry.trace.span`:
+every timed phase is also a node of the telemetry span tree, so the legacy
+log lines and the JSONL/Perfetto trace always agree.
 """
 
 from __future__ import annotations
@@ -12,6 +16,8 @@ import logging
 import time
 from contextlib import contextmanager
 from typing import Iterator, Optional
+
+from photon_ml_tpu.telemetry import trace
 
 logger = logging.getLogger("photon_ml_tpu")
 
@@ -34,7 +40,10 @@ def setup_logging(level: int = logging.INFO, log_file: Optional[str] = None) -> 
             for h in root.handlers
         ):
             return
-        handler = logging.FileHandler(log_file)
+        # hand FileHandler the RESOLVED path: baseFilename is derived from
+        # its argument, so a relative log_file plus a later os.chdir would
+        # defeat the dedup check above (handler and check must agree)
+        handler = logging.FileHandler(target)
     else:
         if any(
             isinstance(h, logging.StreamHandler)
@@ -50,30 +59,35 @@ def setup_logging(level: int = logging.INFO, log_file: Optional[str] = None) -> 
 
 
 class Timer:
-    """Simple stopwatch (util/Timer.scala analog)."""
+    """Simple stopwatch (util/Timer.scala analog).
+
+    Monotonic clock: wall-clock steps (NTP slew, DST) must never corrupt a
+    phase duration."""
 
     def __init__(self):
         self._start: Optional[float] = None
         self.seconds: float = 0.0
 
     def start(self) -> "Timer":
-        self._start = time.time()
+        self._start = time.monotonic()
         return self
 
     def stop(self) -> float:
         if self._start is None:
             raise RuntimeError("Timer.stop() before start()")
-        self.seconds = time.time() - self._start
+        self.seconds = time.monotonic() - self._start
         self._start = None
         return self.seconds
 
 
 @contextmanager
 def timed(name: str, log: logging.Logger = logger) -> Iterator[Timer]:
-    """Log the wall-clock duration of a named phase (Timed.scala analog)."""
-    t = Timer().start()
-    try:
-        yield t
-    finally:
-        t.stop()
-        log.info("%s: %.3fs", name, t.seconds)
+    """Log the wall-clock duration of a named phase (Timed.scala analog)
+    and record it as a telemetry span of the same name."""
+    with trace.span(name):
+        t = Timer().start()
+        try:
+            yield t
+        finally:
+            t.stop()
+            log.info("%s: %.3fs", name, t.seconds)

@@ -227,6 +227,99 @@ def test_sweep_on_mesh_matches_single_device(rng):
         )
 
 
+def test_game_fit_finish_event_carries_telemetry_snapshot(rng, tmp_path):
+    """A toy GameEstimator.fit emits TrainingFinishEvent with the metrics
+    snapshot attached — nonzero device_fetches, compile counters, and a
+    JSONL span tree nesting fit > cd_iteration > coordinate:<name> that the
+    Perfetto exporter converts without error (ISSUE 1 acceptance)."""
+    import json
+
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.game import (
+        FixedEffectConfig,
+        GameConfig,
+        GameEstimator,
+        RandomEffectConfig,
+        build_game_dataset,
+    )
+    from photon_ml_tpu.utils.events import TrainingFinishEvent
+
+    telemetry.reset()
+    trace_out = tmp_path / "fit.trace.jsonl"
+    telemetry.configure(trace_out=str(trace_out))
+    try:
+        X = rng.normal(size=(120, 5))
+        users = rng.integers(0, 3, 120)
+        y = (rng.random(120) < 0.5).astype(float)
+        data = build_game_dataset(
+            response=y,
+            feature_shards={"f": SparseBatch.from_dense(X, y)},
+            id_columns={"u": users},
+        )
+        est = GameEstimator(
+            GameConfig(
+                task="logistic",
+                coordinates={
+                    "fixed": FixedEffectConfig(shard_name="f"),
+                    "perUser": RandomEffectConfig(shard_name="f", id_name="u"),
+                },
+            )
+        )
+        seen = []
+        est.events.register(seen.append)
+        est.fit(data)
+
+        (finish,) = [e for e in seen if isinstance(e, TrainingFinishEvent)]
+        snap = finish.metrics_snapshot
+        assert snap is not None
+        assert snap["counters"]["device_fetches"] > 0
+        assert snap["counters"]["device_fetch_bytes"] > 0
+        assert "jit_compiles" in snap["counters"]
+        assert snap["histograms"]["re_solve_iterations"]["count"] > 0
+
+        # per-coordinate span names, nested fit > cd_iteration > coordinate:*
+        spans = telemetry.finished_spans()
+        by_id = {s.span_id: s for s in spans}
+        names = {s.name for s in spans}
+        assert {"fit", "cd_iteration", "coordinate:fixed",
+                "coordinate:perUser"} <= names
+        for cname in ("coordinate:fixed", "coordinate:perUser"):
+            (coord,) = [s for s in spans if s.name == cname]
+            cd = by_id[coord.parent_id]
+            assert cd.name == "cd_iteration"
+            assert by_id[cd.parent_id].name == "fit"
+
+        # the JSONL sink saw the same tree; the Perfetto export round-trips
+        recorded = {
+            json.loads(line)["name"]
+            for line in trace_out.read_text().splitlines()
+            if json.loads(line).get("type") == "span"
+        }
+        assert "coordinate:perUser" in recorded
+        out = tmp_path / "fit.perfetto.json"
+        assert telemetry.export_chrome_trace(str(trace_out), str(out)) > 0
+        json.loads(out.read_text())
+    finally:
+        telemetry.reset()
+
+
+def test_train_glm_emits_sweep_spans(rng):
+    from photon_ml_tpu import telemetry
+
+    telemetry.reset()
+    try:
+        X, y, batch = _logistic_data(rng, n=100, d=6)
+        train_glm(batch, "logistic", [1.0, 0.1], _l2_config())
+        (sweep,) = telemetry.finished_spans("train_glm")
+        assert sweep.attrs["num_lambdas"] == 2
+        solves = telemetry.finished_spans("lambda_solve")
+        assert [s.attrs["reg_weight"] for s in solves] == [1.0, 0.1]
+        assert all(s.parent_id == sweep.span_id for s in solves)
+        assert telemetry.snapshot()["counters"]["glm_sweep_solves"] == 2
+    finally:
+        telemetry.reset()
+
+
 def test_variances_with_normalization_positive_and_scaled(rng):
     """The variance back-transform deviates from the reference deliberately:
     Var(c*X) = c^2 Var(X) — factor-squared scaling, no intercept shift term

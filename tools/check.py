@@ -14,6 +14,11 @@ Runs, in order:
        - mutable default arguments (list/dict/set literals)
        - `== None` / `!= None` comparisons
        - f-strings with no placeholders
+       - library-only (photon_ml_tpu/) fake-timing rules from PERF_NOTES.md:
+         `time.time()` (wall-clock steps corrupt durations — use
+         time.monotonic()/utils.timing.Timer) and bare
+         `block_until_ready()` statements (a NO-OP sync through the
+         tunnel — use telemetry.sync_fetch, the accounted fetch point)
   3. ruff + mypy, IF installed (configs live in pyproject.toml)
 
 Exit code 0 = clean. Any finding prints `path:line: code message` and the
@@ -61,11 +66,16 @@ def check_syntax(files: list[str]) -> list[str]:
 
 
 class _Lint(ast.NodeVisitor):
-    def __init__(self, path: str, tree: ast.Module):
+    def __init__(self, path: str, tree: ast.Module, library: bool = False):
         self.path = path
+        # library code (photon_ml_tpu/) additionally gets the fake-timing
+        # rules L006/L007; benches and tests may time however they like
+        self.library = library
         self.findings: list[str] = []
         self.imported: dict[str, int] = {}  # name -> lineno (module scope)
         self.used: set[str] = set()
+        # names bound to the wall clock by `from time import time [as x]`
+        self._time_aliases: set[str] = set()
         self._collect(tree)
 
     def _report(self, node: ast.AST, code: str, msg: str) -> None:
@@ -84,6 +94,8 @@ class _Lint(ast.NodeVisitor):
                     continue
                 for a in node.names:
                     self.imported[a.asname or a.name] = node.lineno
+                    if node.module == "time" and a.name == "time":
+                        self._time_aliases.add(a.asname or a.name)
         self.visit(tree)
 
     def visit_Name(self, node: ast.Name) -> None:
@@ -126,6 +138,56 @@ class _Lint(ast.NodeVisitor):
                 isinstance(comp, ast.Constant) and comp.value is None
             ):
                 self._report(node, "L004", "use `is None` / `is not None`")
+        self.generic_visit(node)
+
+    def _is_wall_clock_call(self, node: ast.Call) -> bool:
+        # `time.time()` or a bare `time()` bound by `from time import time`
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "time"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "time"
+        ):
+            return True
+        return isinstance(f, ast.Name) and f.id in self._time_aliases
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.library and self._is_wall_clock_call(node):
+            self._report(
+                node,
+                "L006",
+                "time.time() in library code — wall-clock steps corrupt "
+                "phase durations; use time.monotonic() / utils.timing.Timer",
+            )
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        # a bare `x.block_until_ready()` / `jax.block_until_ready(x)` /
+        # from-imported `block_until_ready(x)` STATEMENT is a timing sync —
+        # which is a no-op through the tunnel (PERF_NOTES.md); uses whose
+        # result feeds real code are fine
+        call = node.value
+        if (
+            self.library
+            and isinstance(call, ast.Call)
+            and (
+                (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "block_until_ready"
+                )
+                or (
+                    isinstance(call.func, ast.Name)
+                    and call.func.id == "block_until_ready"
+                )
+            )
+        ):
+            self._report(
+                node,
+                "L007",
+                "bare block_until_ready() for timing is a no-op sync on the "
+                "tunnel TPU; fetch via telemetry.sync_fetch instead",
+            )
         self.generic_visit(node)
 
     def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
@@ -171,7 +233,10 @@ def check_lint(files: list[str]) -> list[str]:
                 tree = ast.parse(fh.read(), filename=f)
             except SyntaxError:
                 continue  # reported by the syntax phase
-        lint = _Lint(os.path.relpath(f, REPO), tree)
+        rel = os.path.relpath(f, REPO)
+        lint = _Lint(
+            rel, tree, library=rel.startswith("photon_ml_tpu" + os.sep)
+        )
         lint.unused_imports(tree)
         findings.extend(lint.findings)
     return findings
