@@ -35,8 +35,14 @@ import numpy as np
 
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.config import parse_game_config
+from photon_ml_tpu.game.checkpoint import (
+    CheckpointSpec,
+    GracefulStop,
+    TrainingInterrupted,
+)
 from photon_ml_tpu.game.dataset import GameDataset, build_game_dataset
 from photon_ml_tpu.game.estimator import GameEstimator
+from photon_ml_tpu.optim.guard import GuardSpec
 from photon_ml_tpu.utils import setup_logging, timed
 
 
@@ -167,17 +173,76 @@ def _init_distributed_and_mesh(config: Mapping):
     return make_mesh({k: int(v) for k, v in mesh_spec.items()})
 
 
+def _parse_checkpoint_spec(config: Mapping) -> Optional[CheckpointSpec]:
+    """Config key ``"checkpoint": {"dir", "every", "keep_last", "resume"}``
+    (the --checkpoint-dir/--checkpoint-every/--resume flags).
+
+    ``resume`` defaults to TRUE: a scheduler restarting a preempted run
+    with identical argv must continue it, not wipe it. Set
+    ``"resume": false`` explicitly for a fresh fit into the directory
+    (which clears existing checkpoints)."""
+    import dataclasses
+
+    spec = config.get("checkpoint")
+    if not spec:
+        return None
+    spec = dict(spec)
+    if "dir" not in spec:
+        raise ValueError("checkpoint config needs a 'dir' key")
+    spec["directory"] = spec.pop("dir")
+    # defaults come from CheckpointSpec itself — no duplicated literals
+    fields = {f.name for f in dataclasses.fields(CheckpointSpec)}
+    unknown = set(spec) - fields
+    if unknown:
+        raise ValueError(
+            f"unknown checkpoint config keys: {sorted(unknown)}"
+        )
+    return CheckpointSpec(**spec)
+
+
+def _parse_guard_spec(config: Mapping) -> Optional[GuardSpec]:
+    """Config key ``"guard"``: true (default — divergence recovery on),
+    false to disable, or an object overriding GuardSpec fields (defaults
+    come from GuardSpec itself)."""
+    import dataclasses
+
+    spec = config.get("guard", True)
+    if spec is False:
+        return None
+    if spec is True:
+        return GuardSpec()
+    spec = dict(spec)
+    unknown = set(spec) - {f.name for f in dataclasses.fields(GuardSpec)}
+    if unknown:
+        raise ValueError(f"unknown guard config keys: {sorted(unknown)}")
+    return GuardSpec(**spec)
+
+
 def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     """Execute the training pipeline; returns a JSON-safe summary.
 
     Config keys ``trace_out`` (span JSONL; a sibling ``.perfetto.json``
     Chrome trace is written at the end) and ``telemetry_out`` (metrics
-    snapshot JSONL) — the ``--trace-out`` / ``--telemetry-out`` flags."""
+    snapshot JSONL) — the ``--trace-out`` / ``--telemetry-out`` flags.
+
+    Fault tolerance: the ``checkpoint`` config object persists coordinate-
+    descent state per step and resumes from it; a SIGTERM/SIGINT during the
+    fit finishes the current step, writes a final checkpoint, and exits
+    with ``"interrupted": true`` in the summary (graceful preemption). The
+    ``guard`` object (on by default) retries diverging solves with
+    escalating L2 damping and rolls back solves that stay divergent."""
     game_config = parse_game_config(config)
     output_dir = output_dir or config.get("output_dir")
     trace_out = config.get("trace_out")
     if trace_out:
         telemetry.configure(trace_out=trace_out)
+    checkpoint_spec = _parse_checkpoint_spec(config)
+    guard = _parse_guard_spec(config)
+    stop = GracefulStop()
+    if checkpoint_spec is not None:
+        # without a checkpoint there is nothing durable to write on SIGTERM;
+        # default signal handling (die immediately) is then the right call
+        stop.install()
     mesh = _init_distributed_and_mesh(config)
 
     with timed("read training data"):
@@ -197,13 +262,35 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
 
         for listener in load_listeners(config["event_listeners"]):
             estimator.events.register(listener)
-    with timed("fit"):
-        result = estimator.fit(
-            train_data,
-            validation_data=validation_data,
-            output_dir=output_dir,
-            mesh=mesh,
-        )
+    try:
+        with timed("fit"):
+            result = estimator.fit(
+                train_data,
+                validation_data=validation_data,
+                output_dir=output_dir,
+                mesh=mesh,
+                checkpoint_spec=checkpoint_spec,
+                guard=guard,
+                should_stop=stop if checkpoint_spec is not None else None,
+            )
+    except TrainingInterrupted as e:
+        # graceful preemption: the final checkpoint is on disk; report and
+        # stop instead of crashing (a restart with the same argv resumes)
+        summary = {
+            "interrupted": True,
+            "interrupted_at_step": e.step,
+            "checkpoint": e.checkpoint_path,
+            "output_dir": output_dir,
+            "num_rows": train_data.num_rows,
+        }
+        telemetry_out = config.get("telemetry_out")
+        if telemetry_out:
+            summary["telemetry"] = telemetry.flush_metrics(telemetry_out)
+        if trace_out:
+            telemetry.export_chrome_trace(
+                trace_out, telemetry.perfetto_path(trace_out)
+            )
+        return summary
 
     if output_dir is not None and index_maps is not None:
         # persist the feature space next to the models so scoring reproduces
@@ -267,6 +354,26 @@ def main(argv=None) -> int:
         help="append the final metrics snapshot to this JSONL file; "
         "overrides config telemetry_out",
     )
+    parser.add_argument(
+        "--checkpoint-dir",
+        help="persist coordinate-descent state here after each "
+        "(iteration, coordinate) step; SIGTERM/SIGINT then writes a final "
+        "checkpoint before exiting (overrides config checkpoint.dir)",
+    )
+    parser.add_argument(
+        "--checkpoint-every",
+        type=int,
+        help="save every N steps (default 1; overrides checkpoint.every)",
+    )
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from the newest valid checkpoint in --checkpoint-dir, "
+        "skipping completed steps (this is already the default when a "
+        "checkpoint dir is configured — a restarted job continues; set "
+        'config checkpoint {"resume": false} for a fresh fit that clears '
+        "the directory)",
+    )
     args = parser.parse_args(argv)
 
     setup_logging()
@@ -276,9 +383,23 @@ def main(argv=None) -> int:
         config["trace_out"] = args.trace_out
     if args.telemetry_out:
         config["telemetry_out"] = args.telemetry_out
+    if args.checkpoint_dir or args.checkpoint_every is not None or args.resume:
+        ckpt = dict(config.get("checkpoint") or {})
+        if args.checkpoint_dir:
+            ckpt["dir"] = args.checkpoint_dir
+        if args.checkpoint_every is not None:
+            # invalid values (e.g. 0) reach CheckpointSpec validation
+            ckpt["every"] = args.checkpoint_every
+        if args.resume:
+            ckpt["resume"] = True
+        if "dir" not in ckpt:
+            parser.error("--checkpoint-every/--resume need --checkpoint-dir "
+                         "(or a config checkpoint.dir)")
+        config["checkpoint"] = ckpt
     summary = run(config, output_dir=args.output_dir)
     print(json.dumps(summary, default=float))
-    return 0
+    # a preempted run is incomplete: exit non-zero so schedulers restart it
+    return 75 if summary.get("interrupted") else 0
 
 
 if __name__ == "__main__":

@@ -20,6 +20,8 @@ from typing import Iterable, Iterator, Mapping, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.utils.atomic import atomic_write_json, atomic_write_npy
+
 DELIMITER = "\x01"
 INTERCEPT_KEY = "(INTERCEPT)"  # reference: GLMSuite/Constants INTERCEPT_NAME_TERM
 
@@ -101,15 +103,21 @@ class IndexMap(Mapping[str, int]):
                 "the mmap store cannot represent this vocabulary"
             )
         order = np.argsort(hashes)
-        np.save(os.path.join(directory, "hashes.npy"), hashes[order])
-        np.save(
+        # atomic + fsynced writes (utils.atomic): the index map is shipped
+        # next to the model; a crash mid-save must not leave a truncated
+        # table that scoring would silently mmap (tools/check.py L008)
+        atomic_write_npy(
+            os.path.join(directory, "hashes.npy"), hashes[order]
+        )
+        atomic_write_npy(
             os.path.join(directory, "ids.npy"),
             np.asarray(order, dtype=np.int64),
         )
-        with open(os.path.join(directory, "names.json"), "w") as f:
-            json.dump(self._names, f)
-        with open(os.path.join(directory, "meta.json"), "w") as f:
-            json.dump({"num_features": len(self._names), "format": 1}, f)
+        atomic_write_json(os.path.join(directory, "names.json"), self._names)
+        atomic_write_json(
+            os.path.join(directory, "meta.json"),
+            {"num_features": len(self._names), "format": 1},
+        )
 
     @staticmethod
     def load(directory: str) -> "IndexMap":

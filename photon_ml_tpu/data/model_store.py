@@ -21,12 +21,14 @@ from __future__ import annotations
 
 import json
 import os
+import zipfile
 from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.projection import ProjectionMatrix
+from photon_ml_tpu.utils.atomic import atomic_write_json, atomic_write_npz
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.factored import (
     FactoredRandomEffectModel,
@@ -44,20 +46,99 @@ _METADATA_FILE = "model-metadata.json"
 _FORMAT_VERSION = 1
 
 
+class ModelLoadError(ValueError):
+    """A model directory failed to load: the message names the offending
+    path and what was wrong (missing file, truncated npz, missing array
+    key, unsupported format_version). Subclasses ValueError so callers
+    matching the old untyped errors keep working."""
+
+    def __init__(self, path: str, message: str):
+        super().__init__(f"{path}: {message}")
+        self.path = path
+
+
 def _write_json(path: str, obj) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f, indent=2, sort_keys=True)
-    os.replace(tmp, path)
+    # fsync-before-rename (utils.atomic): a crash right after save_* returns
+    # must never leave empty metadata next to a valid model
+    atomic_write_json(path, obj, indent=2, sort_keys=True)
 
 
 def _write_npz(path: str, **arrays) -> None:
-    """Atomic npz write (tmp + rename) so a crash mid-save into an existing
-    model directory can never leave a truncated array file next to valid
-    metadata — every file in a model dir is replaced whole or not at all."""
-    tmp = path + ".tmp.npz"
-    np.savez(tmp, **arrays)
-    os.replace(tmp, path)
+    """Atomic npz write (tmp + fsync + rename) so a crash mid-save into an
+    existing model directory can never leave a truncated array file next to
+    valid metadata — every file in a model dir is replaced whole or not at
+    all."""
+    atomic_write_npz(path, **arrays)
+
+
+def _read_metadata(model_dir: str, expected_type: str) -> dict:
+    """Load + validate model-metadata.json with typed errors naming the
+    offending path (a truncated save must not surface as a bare KeyError)."""
+    path = os.path.join(model_dir, _METADATA_FILE)
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+    except FileNotFoundError:
+        raise ModelLoadError(path, "missing metadata file") from None
+    except json.JSONDecodeError as e:
+        raise ModelLoadError(path, f"corrupt metadata JSON ({e})") from None
+    version = meta.get("format_version")
+    if version != _FORMAT_VERSION:
+        raise ModelLoadError(
+            path,
+            f"unsupported format_version {version!r} "
+            f"(this build reads version {_FORMAT_VERSION})",
+        )
+    if meta.get("model_type") != expected_type:
+        raise ModelLoadError(
+            path, f"does not contain a {expected_type.upper()} model"
+        )
+    return meta
+
+
+class _NpzReader:
+    """npz access where a missing key raises ModelLoadError with the path
+    (np.load's bare KeyError names neither file nor context)."""
+
+    def __init__(self, z, path: str):
+        self._z = z
+        self._path = path
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._z
+
+    def __getitem__(self, key: str):
+        try:
+            return self._z[key]
+        except KeyError:
+            raise ModelLoadError(
+                self._path, f"missing array key '{key}'"
+            ) from None
+        except (zipfile.BadZipFile, OSError, ValueError) as e:
+            raise ModelLoadError(
+                self._path, f"corrupt array '{key}' ({e})"
+            ) from None
+
+
+class _open_npz:
+    """Context manager: np.load with load failures mapped to ModelLoadError
+    (FileNotFoundError / BadZipFile / truncated-container ValueError)."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def __enter__(self) -> _NpzReader:
+        try:
+            self._z = np.load(self._path, allow_pickle=False)
+        except FileNotFoundError:
+            raise ModelLoadError(self._path, "missing array file") from None
+        except (zipfile.BadZipFile, OSError, ValueError) as e:
+            raise ModelLoadError(self._path, f"corrupt npz ({e})") from None
+        return _NpzReader(self._z, self._path)
+
+    def __exit__(self, *exc):
+        self._z.close()
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -80,11 +161,8 @@ def save_glm(model: GeneralizedLinearModel, path: str) -> None:
 
 
 def load_glm(path: str) -> GeneralizedLinearModel:
-    with open(os.path.join(path, _METADATA_FILE)) as f:
-        meta = json.load(f)
-    if meta.get("model_type") != "glm":
-        raise ValueError(f"{path} does not contain a GLM model")
-    with np.load(os.path.join(path, "coefficients.npz")) as z:
+    meta = _read_metadata(path, "glm")
+    with _open_npz(os.path.join(path, "coefficients.npz")) as z:
         means = jnp.asarray(z["means"])
         variances = jnp.asarray(z["variances"]) if "variances" in z else None
     return GeneralizedLinearModel(
@@ -112,7 +190,7 @@ def _save_fixed_effect(model: FixedEffectModel, path: str) -> dict:
 
 
 def _load_fixed_effect(path: str, spec: dict) -> FixedEffectModel:
-    with np.load(os.path.join(path, "coefficients.npz")) as z:
+    with _open_npz(os.path.join(path, "coefficients.npz")) as z:
         coefficients = jnp.asarray(z["coefficients"])
     return FixedEffectModel(
         coefficients=coefficients, shard_name=spec["shard_name"]
@@ -143,7 +221,7 @@ def _save_random_effect(model: RandomEffectModel, path: str) -> dict:
 
 
 def _load_random_effect(path: str, spec: dict) -> RandomEffectModel:
-    with np.load(os.path.join(path, "model.npz"), allow_pickle=False) as z:
+    with _open_npz(os.path.join(path, "model.npz")) as z:
         buckets = tuple(
             RandomEffectBucketModel(
                 coefficients=jnp.asarray(z[f"coefficients_{i}"]),
@@ -186,7 +264,7 @@ def _save_factored_random_effect(model: FactoredRandomEffectModel, path: str) ->
 
 
 def _load_factored_random_effect(path: str, spec: dict) -> FactoredRandomEffectModel:
-    with np.load(os.path.join(path, "model.npz"), allow_pickle=False) as z:
+    with _open_npz(os.path.join(path, "model.npz")) as z:
         return FactoredRandomEffectModel(
             id_name=spec["id_name"],
             shard_name=spec["shard_name"],
@@ -216,7 +294,7 @@ def _save_matrix_factorization(model: MatrixFactorizationModel, path: str) -> di
 
 
 def _load_matrix_factorization(path: str, spec: dict) -> MatrixFactorizationModel:
-    with np.load(os.path.join(path, "model.npz"), allow_pickle=False) as z:
+    with _open_npz(os.path.join(path, "model.npz")) as z:
         return MatrixFactorizationModel(
             row_effect=spec["row_effect"],
             col_effect=spec["col_effect"],
@@ -273,13 +351,18 @@ def save_game_model(
 
 
 def load_game_model(path: str) -> GameModel:
-    with open(os.path.join(path, _METADATA_FILE)) as f:
-        meta = json.load(f)
-    if meta.get("model_type") != "game":
-        raise ValueError(f"{path} does not contain a GAME model")
+    meta = _read_metadata(path, "game")
     models = {}
+    meta_path = os.path.join(path, _METADATA_FILE)
+    if "coordinate_order" not in meta:
+        # a silently-empty model would score all-offsets; fail loudly
+        raise ModelLoadError(meta_path, "missing coordinate_order")
     for name in meta["coordinate_order"]:
-        spec = meta["coordinates"][name]
+        spec = meta.get("coordinates", {}).get(name)
+        if spec is None:
+            raise ModelLoadError(
+                meta_path, f"coordinate '{name}' listed but not described"
+            )
         if spec["type"] == "fixed_effect":
             models[name] = _load_fixed_effect(
                 os.path.join(path, "fixed-effect", name), spec

@@ -46,11 +46,23 @@ def validate(
     task: str,
     mode: ValidationMode = ValidationMode.FULL,
     seed: int = 0,
+    collect_all: bool = False,
 ) -> None:
-    """Raise DataValidationError on the first failed check."""
+    """Raise DataValidationError on the first failed check.
+
+    ``collect_all=True`` runs EVERY check and aggregates the failures into
+    one DataValidationError — the full damage report from one pass, so an
+    operator fixing a bad ingest sees every problem at once instead of
+    replaying the pipeline per failure."""
     if mode == ValidationMode.DISABLED:
         return
     rng = np.random.default_rng(seed)
+    failures: list[str] = []
+
+    def fail(message: str) -> None:
+        if not collect_all:
+            raise DataValidationError(message)
+        failures.append(message)
 
     labels = np.asarray(batch.labels)
     offsets = np.asarray(batch.offsets)
@@ -71,24 +83,31 @@ def validate(
     samp = lambda arr: arr[row_mask] if sampling else arr  # noqa: E731
 
     if not np.all(np.isfinite(vals)):
-        raise DataValidationError("non-finite feature values")
+        fail("non-finite feature values")
     for name, arr in (("labels", labels), ("offsets", offsets)):
         if not np.all(np.isfinite(arr[mask] if sampling else arr[valid_rows])):
-            raise DataValidationError(f"non-finite {name}")
+            fail(f"non-finite {name}")
     if not np.all(np.isfinite(samp(weights))):
-        raise DataValidationError("non-finite weights")
+        fail("non-finite weights")
     if np.any(samp(weights) < 0):
-        raise DataValidationError("negative weights")
+        fail("negative weights")
 
     task_l = task.lower()
     if "logistic" in task_l or "hinge" in task_l or "svm" in task_l:
         lab = labels[mask]
+        lab = lab[np.isfinite(lab)]  # non-finite labels already reported
         ok = np.isin(lab, (0.0, 1.0)) | np.isin(lab, (-1.0, 1.0))
         if not np.all(ok):
-            raise DataValidationError(
+            fail(
                 f"binary task requires labels in {{0,1}} or {{-1,1}}; "
                 f"found {np.unique(lab[~ok])[:5]}"
             )
     if "poisson" in task_l:
         if np.any(labels[mask] < 0):
-            raise DataValidationError("poisson task requires non-negative labels")
+            fail("poisson task requires non-negative labels")
+
+    if failures:
+        raise DataValidationError(
+            f"{len(failures)} validation check(s) failed: "
+            + "; ".join(failures)
+        )

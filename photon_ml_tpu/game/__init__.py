@@ -1,3 +1,11 @@
+from photon_ml_tpu.game.checkpoint import (  # noqa: F401
+    CheckpointError,
+    CheckpointManager,
+    CheckpointSpec,
+    CheckpointState,
+    GracefulStop,
+    TrainingInterrupted,
+)
 from photon_ml_tpu.game.coordinate_descent import (  # noqa: F401
     CoordinateDescentResult,
     ValidationSpec,

@@ -1,0 +1,301 @@
+"""Checkpoint/resume for coordinate descent: atomic step snapshots,
+corrupt-checkpoint fallback, and graceful-preemption plumbing.
+
+The reference inherits fault tolerance from Spark (RDD lineage re-executes
+lost partitions; the driver survives executor loss). The TPU port replaced
+that substrate with long-lived device arrays, so a preemption or OOM used
+to discard the whole GAME fit. This module restores durability at the
+``(iteration, coordinate)`` granularity:
+
+Layout (one directory per completed step)::
+
+    <checkpoint_dir>/
+      step-00000007/
+        manifest.json        step cursor, best metric, JSON-safe history
+        model/               full GAME model (model_store savers)
+        best/                best-so-far model (present iff validation ran)
+
+Atomicity: each checkpoint is assembled in a ``.tmp-step-*`` sibling and
+``os.rename``d into place (readers never see a partial directory); the
+manifest is written last inside the tmp dir, so a directory missing its
+manifest is by definition incomplete. ``restore`` walks step directories
+newest-first and falls back past corrupt or partial ones (counted in the
+``checkpoint.corrupt`` telemetry counter). Retention keeps the newest
+``keep_last`` checkpoints.
+
+Graceful preemption: :class:`GracefulStop` turns SIGTERM/SIGINT into a
+"finish this step, write a final checkpoint, raise
+:class:`TrainingInterrupted`" request — the train CLI installs it so a
+preempted run restarts with ``--resume`` instead of from scratch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import shutil
+import signal
+from typing import Optional
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.game.models import GameModel
+from photon_ml_tpu.utils.atomic import atomic_write_json, fsync_dir
+
+logger = logging.getLogger("photon_ml_tpu.game.checkpoint")
+
+_MANIFEST_FILE = "manifest.json"
+_FORMAT_VERSION = 1
+_STEP_RE = re.compile(r"^step-(\d{8})$")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint directory is unusable (corrupt, partial, or written by
+    an incompatible run)."""
+
+
+class TrainingInterrupted(RuntimeError):
+    """Raised after a graceful-stop request once the final checkpoint is on
+    disk; carries where training stopped so drivers can report it."""
+
+    def __init__(self, step: int, checkpoint_path: Optional[str]):
+        super().__init__(
+            f"training interrupted after step {step}"
+            + (f"; checkpoint at {checkpoint_path}" if checkpoint_path else "")
+        )
+        self.step = step
+        self.checkpoint_path = checkpoint_path
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointSpec:
+    """Checkpointing policy for a fit.
+
+    ``every`` saves after every N completed ``(iteration, coordinate)``
+    steps (a stop request always forces a final save). ``resume=False``
+    is a FRESH fit into the directory: existing step checkpoints are
+    cleared at manager construction (otherwise a stale run's
+    higher-numbered steps would outlive this run's through retention and
+    hijack a later resume).
+    """
+
+    directory: str
+    every: int = 1
+    keep_last: int = 3
+    resume: bool = True
+
+    def __post_init__(self):
+        if self.every < 1:
+            raise ValueError("checkpoint every must be >= 1")
+        if self.keep_last < 1:
+            raise ValueError("checkpoint keep_last must be >= 1")
+
+
+@dataclasses.dataclass
+class CheckpointState:
+    """Everything coordinate descent needs to continue a fit: the step
+    cursor (last COMPLETED global step), the live per-coordinate models,
+    the best-model tracking, the JSON-safe step history, and the guard's
+    rollback bookkeeping (so a resumed fit does not re-attempt solves a
+    frozen coordinate already proved divergent)."""
+
+    step: int
+    model: GameModel
+    best_model: Optional[GameModel]
+    best_metric: Optional[float]
+    history: list
+    frozen: list = dataclasses.field(default_factory=list)
+    consecutive_rollbacks: Optional[dict] = None
+
+
+def _step_dirname(step: int) -> str:
+    return f"step-{step:08d}"
+
+
+class CheckpointManager:
+    """Atomic save / newest-valid restore / retention over one directory."""
+
+    def __init__(self, spec: CheckpointSpec):
+        self.spec = spec
+        os.makedirs(spec.directory, exist_ok=True)
+        if not spec.resume:
+            stale = self._step_dirs()
+            if stale:
+                logger.warning(
+                    "resume=False: clearing %d existing checkpoint(s) "
+                    "under %s for a fresh fit", len(stale), spec.directory,
+                )
+            for _step, path in stale:
+                shutil.rmtree(path, ignore_errors=True)
+
+    # -- save ----------------------------------------------------------------
+
+    def should_save(self, step: int) -> bool:
+        return (step + 1) % self.spec.every == 0
+
+    def save(self, state: CheckpointState) -> str:
+        """Persist ``state`` as ``step-<step>``; returns the final path."""
+        from photon_ml_tpu.data.model_store import save_game_model
+
+        final = os.path.join(self.spec.directory, _step_dirname(state.step))
+        tmp = os.path.join(
+            self.spec.directory, f".tmp-{_step_dirname(state.step)}"
+        )
+        with telemetry.span("checkpoint:save", step=state.step):
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            save_game_model(state.model, os.path.join(tmp, "model"))
+            if state.best_model is not None:
+                save_game_model(state.best_model, os.path.join(tmp, "best"))
+            # the manifest lands LAST: its presence certifies completeness
+            atomic_write_json(
+                os.path.join(tmp, _MANIFEST_FILE),
+                {
+                    "format_version": _FORMAT_VERSION,
+                    "step": state.step,
+                    "coordinate_order": list(state.model.models),
+                    "best_metric": state.best_metric,
+                    "has_best": state.best_model is not None,
+                    "history": state.history,
+                    "frozen": list(state.frozen),
+                    "consecutive_rollbacks": state.consecutive_rollbacks or {},
+                },
+                indent=2,
+                sort_keys=True,
+            )
+            if os.path.exists(final):  # re-save of a step (resume overlap)
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            fsync_dir(self.spec.directory)
+        telemetry.counter("checkpoint.saves").inc()
+        telemetry.gauge("checkpoint.last_step").set(state.step)
+        self._apply_retention()
+        return final
+
+    def _apply_retention(self) -> None:
+        steps = self._step_dirs()
+        for step, path in steps[: -self.spec.keep_last]:
+            shutil.rmtree(path, ignore_errors=True)
+        for name in os.listdir(self.spec.directory):
+            # abandoned tmp dirs from a crashed save
+            if name.startswith(".tmp-step-"):
+                shutil.rmtree(
+                    os.path.join(self.spec.directory, name),
+                    ignore_errors=True,
+                )
+
+    # -- restore -------------------------------------------------------------
+
+    def _step_dirs(self) -> list[tuple[int, str]]:
+        """(step, path) for every step directory, oldest first."""
+        out = []
+        for name in os.listdir(self.spec.directory):
+            m = _STEP_RE.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.spec.directory, name)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._step_dirs()
+        return steps[-1][0] if steps else None
+
+    def _load(self, path: str) -> CheckpointState:
+        from photon_ml_tpu.data.model_store import load_game_model
+
+        manifest_path = os.path.join(path, _MANIFEST_FILE)
+        try:
+            import json
+
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            raise CheckpointError(
+                f"{path}: incomplete checkpoint (no manifest)"
+            ) from None
+        except ValueError as e:
+            raise CheckpointError(
+                f"{manifest_path}: corrupt manifest ({e})"
+            ) from None
+        if manifest.get("format_version") != _FORMAT_VERSION:
+            raise CheckpointError(
+                f"{manifest_path}: unsupported format_version "
+                f"{manifest.get('format_version')!r}"
+            )
+        model = load_game_model(os.path.join(path, "model"))
+        best_model = None
+        if manifest.get("has_best"):
+            best_model = load_game_model(os.path.join(path, "best"))
+        return CheckpointState(
+            step=int(manifest["step"]),
+            model=model,
+            best_model=best_model,
+            best_metric=manifest.get("best_metric"),
+            history=list(manifest.get("history", ())),
+            frozen=list(manifest.get("frozen", ())),
+            consecutive_rollbacks=dict(
+                manifest.get("consecutive_rollbacks") or {}
+            ),
+        )
+
+    def restore(self) -> Optional[CheckpointState]:
+        """Newest VALID checkpoint, or None. Corrupt/partial checkpoints
+        (truncated npz, missing manifest, bad metadata) are skipped with a
+        warning and counted, falling back to the next older one."""
+        if not self.spec.resume:
+            return None
+        with telemetry.span("checkpoint:restore"):
+            for step, path in reversed(self._step_dirs()):
+                try:
+                    state = self._load(path)
+                except (CheckpointError, ValueError, OSError) as e:
+                    # ModelLoadError is a ValueError; OSError covers a
+                    # half-deleted directory
+                    telemetry.counter("checkpoint.corrupt").inc()
+                    logger.warning(
+                        "skipping corrupt checkpoint %s: %s", path, e
+                    )
+                    continue
+                telemetry.counter("checkpoint.restores").inc()
+                logger.info("resuming from checkpoint %s (step %d)",
+                            path, state.step)
+                return state
+        return None
+
+
+class GracefulStop:
+    """SIGTERM/SIGINT -> cooperative stop flag (the preemption handshake).
+
+    The first signal requests a graceful stop: the training loop finishes
+    its current step, writes a final checkpoint, and raises
+    :class:`TrainingInterrupted`. A second signal restores the previous
+    handler's behavior by re-raising KeyboardInterrupt immediately (an
+    operator mashing Ctrl-C still wins).
+    """
+
+    def __init__(self):
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._installed = False
+
+    def install(self, signums=(signal.SIGTERM, signal.SIGINT)) -> "GracefulStop":
+        for s in signums:
+            signal.signal(s, self._handle)
+        self._installed = True
+        return self
+
+    def _handle(self, signum, frame):
+        if self.requested:
+            raise KeyboardInterrupt
+        self.requested = True
+        self.signum = signum
+        logger.warning(
+            "received signal %d: finishing current step, then writing a "
+            "final checkpoint and exiting", signum,
+        )
+
+    def __call__(self) -> bool:
+        """Stop-predicate form, passed as ``should_stop=``."""
+        return self.requested

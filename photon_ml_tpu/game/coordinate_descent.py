@@ -26,8 +26,15 @@ import numpy as np
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.evaluation import EVALUATORS, better_than, sharded_auc, sharded_precision_at_k
 from photon_ml_tpu.evaluation.evaluators import parse_evaluator
+from photon_ml_tpu.game.checkpoint import (
+    CheckpointError,
+    CheckpointManager,
+    CheckpointState,
+    TrainingInterrupted,
+)
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.models import GameModel
+from photon_ml_tpu.optim.guard import GuardSpec, model_is_finite
 
 logger = logging.getLogger("photon_ml_tpu.game")
 
@@ -91,6 +98,46 @@ def _evaluate(model: GameModel, spec: ValidationSpec) -> dict[str, float]:
     return out
 
 
+def _guarded_update(coord, model, residual, guard: GuardSpec, name: str):
+    """One guarded coordinate update: solve, health-check, damped retries,
+    rollback. Returns ``(model', attempts_used, rolled_back)``.
+
+    Coordinates exposing ``extra_l2`` get damped retries (the l2 leaf is
+    traced, so retries reuse the compiled solver); others — whose re-run
+    would be bit-identical — roll straight back after the first divergence.
+    """
+    supports_damping = hasattr(coord, "extra_l2")
+    if hasattr(coord, "health_check"):
+        coord.health_check = True  # opt the coordinate into health reduces
+    max_attempts = (guard.max_retries if supports_damping else 0) + 1
+    for attempt in range(max_attempts):
+        if attempt:
+            telemetry.counter("solves.retried").inc()
+            logger.warning(
+                "coordinate %s diverged; retrying with extra L2 damping %g",
+                name, guard.damping_for(attempt),
+            )
+        if supports_damping:
+            coord.extra_l2 = guard.damping_for(attempt)
+        try:
+            new_model = coord.update_model(model, residual)
+        finally:
+            if supports_damping:
+                coord.extra_l2 = 0.0
+        health = getattr(coord, "last_health", None)
+        if health is None:
+            health = model_is_finite(new_model)
+        if bool(telemetry.sync_fetch(health, label=f"guard:{name}")):
+            return new_model, attempt, False
+        telemetry.counter("solves.diverged").inc()
+    telemetry.counter("solves.rolled_back").inc()
+    logger.warning(
+        "coordinate %s still diverging after %d attempt(s); rolling back "
+        "to the pre-solve model", name, max_attempts,
+    )
+    return model, max_attempts - 1, True
+
+
 def run_coordinate_descent(
     coordinates: Mapping[str, object],
     task: str,
@@ -98,6 +145,9 @@ def run_coordinate_descent(
     validation: Optional[ValidationSpec] = None,
     initial_models: Optional[Mapping[str, object]] = None,
     on_step=None,
+    guard: Optional[GuardSpec] = None,
+    checkpoint: Optional[CheckpointManager] = None,
+    should_stop=None,
 ) -> CoordinateDescentResult:
     """Train all coordinates for ``num_iterations`` outer sweeps.
 
@@ -105,6 +155,20 @@ def run_coordinate_descent(
     enables warm-starting whole coordinates from a previous run.
     ``on_step(entry)`` fires after every (iteration, coordinate) update
     with that step's telemetry dict (the event-bus hook).
+
+    Fault tolerance (game.checkpoint / optim.guard):
+
+    - ``checkpoint``: a CheckpointManager. On entry the newest valid
+      checkpoint is restored — models reloaded, completed steps skipped,
+      scores recomputed; after each completed step (per the spec's
+      ``every``) the full state is atomically persisted.
+    - ``guard``: a GuardSpec; every coordinate solve is health-checked and
+      diverging solves are retried with escalating L2 damping, then rolled
+      back. A coordinate rolling back ``freeze_after`` consecutive times is
+      frozen (skipped; its last good model keeps scoring).
+    - ``should_stop``: zero-arg predicate polled after every step; when it
+      turns true a final checkpoint is written and TrainingInterrupted is
+      raised (the graceful-preemption handshake).
     """
     names = list(coordinates)
     models = {
@@ -115,15 +179,50 @@ def run_coordinate_descent(
         )
         for name in names
     }
-    scores = {name: coordinates[name].score(models[name]) for name in names}
 
     best_model: Optional[GameModel] = None
     best_metric: Optional[float] = None
     history: list[dict] = []
+    start_step = 0
+    if checkpoint is not None:
+        restored = checkpoint.restore()
+        if restored is not None:
+            if list(restored.model.models) != names:
+                raise CheckpointError(
+                    f"checkpoint at {checkpoint.spec.directory} was written "
+                    f"by a fit with coordinates "
+                    f"{list(restored.model.models)}, not {names}"
+                )
+            models = dict(restored.model.models)
+            best_model = restored.best_model
+            best_metric = restored.best_metric
+            history = list(restored.history)
+            start_step = restored.step + 1
+    # scores recomputed from the (possibly restored) models — checkpoints
+    # persist models only; scores are derived state
+    scores = {name: coordinates[name].score(models[name]) for name in names}
+
+    # guard bookkeeping survives resume: a coordinate already proved
+    # divergent must not re-burn its retries every remaining iteration.
+    # Restored ONLY when a guard is active — resuming with guard=None is
+    # an explicit request to train every coordinate again.
+    frozen: set[str] = set()
+    consecutive_rollbacks = {name: 0 for name in names}
+    if guard is not None and checkpoint is not None and restored is not None:
+        frozen = {n for n in restored.frozen if n in consecutive_rollbacks}
+        for n, count in (restored.consecutive_rollbacks or {}).items():
+            if n in consecutive_rollbacks:
+                consecutive_rollbacks[n] = int(count)
+    last_ckpt_path: Optional[str] = None
 
     for it in range(num_iterations):
         with telemetry.span("cd_iteration", iteration=it):
-            for name in names:
+            for idx, name in enumerate(names):
+                step = it * len(names) + idx
+                if step < start_step:
+                    continue  # completed before the restored checkpoint
+                if name in frozen:
+                    continue  # divergent coordinate: last good model stands
                 coord = coordinates[name]
                 with telemetry.span(f"coordinate:{name}", iteration=it) as sp:
                     residual = None
@@ -132,8 +231,24 @@ def run_coordinate_descent(
                             (scores[o] for o in names if o != name),
                             start=jnp.zeros_like(scores[name]),
                         )
-                    models[name] = coord.update_model(models[name], residual)
-                    scores[name] = coord.score(models[name])
+                        if guard is not None:
+                            # a NaN-scoring coordinate (e.g. rolled back to
+                            # zeros over NaN features) must not poison its
+                            # neighbors' solves through the residual
+                            residual = jnp.nan_to_num(
+                                residual, nan=0.0, posinf=0.0, neginf=0.0
+                            )
+                    rolled_back = False
+                    attempts = 0
+                    if guard is None:
+                        models[name] = coord.update_model(models[name], residual)
+                    else:
+                        models[name], attempts, rolled_back = _guarded_update(
+                            coord, models[name], residual, guard, name
+                        )
+                    if not rolled_back:
+                        # a rolled-back model is unchanged; its scores stand
+                        scores[name] = coord.score(models[name])
                     # force execution before stopping the clock —
                     # block_until_ready is a no-op on the tunnel TPU; a
                     # 1-element fetch truly syncs (and is accounted)
@@ -146,8 +261,11 @@ def run_coordinate_descent(
                         "coordinate": name,
                         "seconds": telemetry.trace.TRACER.now() - sp.ts,
                     }
+                    if guard is not None and (attempts or rolled_back):
+                        entry["solve_retries"] = attempts
+                        entry["rolled_back"] = rolled_back
                     tracker = getattr(coord, "last_tracker", None)
-                    if tracker is not None:
+                    if tracker is not None and not rolled_back:
                         # per-update optimization telemetry (the reference's
                         # OptimizationTracker surfaced in CD logs)
                         entry["tracker"] = tracker.to_summary_string()
@@ -170,6 +288,37 @@ def run_coordinate_descent(
                 history.append(entry)
                 if on_step is not None:
                     on_step(entry)
+
+                if rolled_back:
+                    consecutive_rollbacks[name] += 1
+                    if consecutive_rollbacks[name] >= guard.freeze_after:
+                        frozen.add(name)
+                        telemetry.counter("solves.frozen").inc()
+                        logger.warning(
+                            "coordinate %s frozen after %d consecutive "
+                            "rollbacks; its last good model keeps scoring",
+                            name, consecutive_rollbacks[name],
+                        )
+                else:
+                    consecutive_rollbacks[name] = 0
+
+                stop = should_stop is not None and should_stop()
+                if checkpoint is not None and (
+                    stop or checkpoint.should_save(step)
+                ):
+                    last_ckpt_path = checkpoint.save(
+                        CheckpointState(
+                            step=step,
+                            model=GameModel(task=task, models=dict(models)),
+                            best_model=best_model,
+                            best_metric=best_metric,
+                            history=history,
+                            frozen=sorted(frozen),
+                            consecutive_rollbacks=dict(consecutive_rollbacks),
+                        )
+                    )
+                if stop:
+                    raise TrainingInterrupted(step, last_ckpt_path)
 
     final = GameModel(task=task, models=dict(models))
     if best_model is None:

@@ -40,6 +40,7 @@ from photon_ml_tpu.ops.tiled import ROWS_PER_TILE, TiledBatch
 from photon_ml_tpu.optim.adapter import glm_adapter
 from photon_ml_tpu.optim.common import BoxConstraints
 from photon_ml_tpu.optim.factory import OptimizerConfig, dispatch_solve
+from photon_ml_tpu.optim.guard import damped_objective, solve_health
 from photon_ml_tpu.parallel.distributed import distributed_solve
 from photon_ml_tpu.parallel.mesh import put_sharded, shard_rows, shard_tiles
 
@@ -106,6 +107,13 @@ class FixedEffectCoordinate:
         # re-samples on every coordinate update, DistributedOptimizationProblem
         # .scala:113-125); counter salts the rng so updates differ
         self._update_count = 0
+        # guarded-solve hooks (optim.guard): extra L2 added to the next
+        # update's objective (traced leaf -> no recompile), and the device
+        # health scalar of the last solve — computed only when the guard
+        # flips health_check on (unguarded fits skip the extra reduces)
+        self.extra_l2 = 0.0
+        self.health_check = False
+        self.last_health = None
         key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
         self._solver = _fe_solver(key_cfg, self.loss_name)
         self._constraints = self.config.build_box_constraints(
@@ -234,6 +242,9 @@ class FixedEffectCoordinate:
             w0 = norm.inverse_transform_model_coefficients(w0)
         update_index = self._update_count
         self._update_count += 1
+        # damped retry (optim.guard): l2_weight is a traced leaf, so the
+        # compiled solver is reused unchanged
+        obj = damped_objective(self._obj, self.extra_l2)
         off_field = "offsets3" if self._use_tiled else "offsets"
         wgt_field = "weights3" if self._use_tiled else "weights"
         if self.mesh is not None:
@@ -265,6 +276,7 @@ class FixedEffectCoordinate:
                 constraints=self._constraints,
                 factors=None if norm is None else norm.factors,
                 shifts=None if norm is None else norm.shifts,
+                extra_l2=self.extra_l2,
             )
         elif self._use_tiled:
             batch = self._tiled
@@ -282,7 +294,7 @@ class FixedEffectCoordinate:
                         reshape=False,
                     )
                 )
-            res = self._solver(self._obj, batch, w0, self._l1, self._constraints)
+            res = self._solver(obj, batch, w0, self._l1, self._constraints)
         else:
             batch = self._solve_batch
             if self.config.down_sampling_rate < 1.0:
@@ -298,13 +310,14 @@ class FixedEffectCoordinate:
                 batch = batch.with_offsets(
                     self._base_batch.offsets + residual_scores
                 )
-            res = self._solver(self._obj, batch, w0, self._l1, self._constraints)
+            res = self._solver(obj, batch, w0, self._l1, self._constraints)
         w = res.w
         from photon_ml_tpu.optim.trackers import FixedEffectOptimizationTracker
 
         self.last_tracker = FixedEffectOptimizationTracker.from_result(res)
         if norm is not None:
             w = norm.transform_model_coefficients(w)
+        self.last_health = solve_health(res, w) if self.health_check else None
         return dataclasses.replace(model, coefficients=w)
 
     def score(self, model: FixedEffectModel) -> Array:
@@ -610,6 +623,11 @@ class RandomEffectCoordinate:
         self._l1 = jnp.float32(
             self.config.regularization.l1_weight(self.config.regularization_weight)
         )
+        # guarded-solve hooks (optim.guard); health reduces only when the
+        # guard flips health_check on
+        self.extra_l2 = 0.0
+        self.health_check = False
+        self.last_health = None
 
     def initialize_model(self) -> RandomEffectModel:
         # dtype from the HOST buckets: dense-routed device buckets carry
@@ -642,6 +660,8 @@ class RandomEffectCoordinate:
         tracker_its = []
         tracker_reasons = []
         tracker_vals = []
+        healths = []
+        obj = damped_objective(self._obj, self.extra_l2)
         n_dev = 0 if self.mesh is None else int(self.mesh.devices.size)
         for i, (b, bm) in enumerate(zip(self._buckets, model.buckets)):
             bucket = (
@@ -663,7 +683,7 @@ class RandomEffectCoordinate:
             cons = self._bucket_constraints[i]
             if self.mesh is None:
                 solver = self._dense_solver if dense else self._solver
-                res, var = solver(self._obj, bb, w0, self._l1, cons)
+                res, var = solver(obj, bb, w0, self._l1, cons)
                 w = res.w
             else:
                 num_e = w0.shape[0]
@@ -674,7 +694,7 @@ class RandomEffectCoordinate:
                     self._sharded_dense_solver if dense
                     else self._sharded_solver
                 )
-                res, var = solver(self._obj, bb_p, w0_p, self._l1, cons_p)
+                res, var = solver(obj, bb_p, w0_p, self._l1, cons_p)
                 w = res.w[:num_e]
                 if var is not None:
                     var = var[:num_e]
@@ -686,9 +706,18 @@ class RandomEffectCoordinate:
             tracker_its.append(res.iterations[:n_real])
             tracker_reasons.append(res.reason[:n_real])
             tracker_vals.append(res.value[:n_real])
+            if self.health_check:
+                # mesh-padded entities are all-zero problems (value 0 at
+                # w=0), so the full padded res passes the reduce harmlessly
+                healths.append(solve_health(res, res.w))
             new_buckets.append(
                 dataclasses.replace(bm, coefficients=w, variances=var)
             )
+        self.last_health = (
+            (jnp.all(jnp.stack(healths)) if healths else jnp.bool_(True))
+            if self.health_check
+            else None
+        )
         self.last_tracker = RandomEffectOptimizationTracker.from_device_parts(
             tracker_its, tracker_reasons, tracker_vals
         )

@@ -241,6 +241,11 @@ class GameEstimator:
                     coord._update_count = 0
                 if hasattr(coord, "last_tracker"):
                     coord.last_tracker = None
+                if hasattr(coord, "health_check"):
+                    # guard state is per-fit: re-opted-in by _guarded_update
+                    coord.health_check = False
+                    coord.extra_l2 = 0.0
+                    coord.last_health = None
                 coords[name] = coord
                 continue
             if isinstance(c, FixedEffectConfig):
@@ -327,6 +332,9 @@ class GameEstimator:
         initial_models: Optional[Mapping[str, object]] = None,
         output_dir: Optional[str] = None,
         mesh: Optional[Mesh] = None,
+        checkpoint_spec: Optional["CheckpointSpec"] = None,
+        guard: Optional["GuardSpec"] = None,
+        should_stop=None,
     ) -> GameFitResult:
         """Train; optionally save final + best models under ``output_dir``.
 
@@ -336,11 +344,19 @@ class GameEstimator:
         axis (shard_map, no cross-entity comms) — the GAME analog of the
         reference's cluster mode. Results match the single-device fit.
 
+        Fault tolerance: ``checkpoint_spec`` (game.checkpoint.CheckpointSpec)
+        persists coordinate-descent state after each step and resumes from
+        the newest valid checkpoint; ``guard`` (optim.guard.GuardSpec)
+        health-checks every solve with damped-retry/rollback recovery;
+        ``should_stop`` is polled per step — when true, a final checkpoint
+        is written and game.checkpoint.TrainingInterrupted raised.
+
         Output layout mirrors the reference training driver
         (cli/game/training/Driver.scala:262-312): ``<output_dir>/final`` and
         ``<output_dir>/best`` model directories.
         """
         from photon_ml_tpu import telemetry
+        from photon_ml_tpu.game.checkpoint import CheckpointManager
         from photon_ml_tpu.utils.events import (
             OptimizationLogEvent,
             SetupEvent,
@@ -383,6 +399,12 @@ class GameEstimator:
                         metrics=entry.get("metrics"),
                     )
                 ),
+                guard=guard,
+                checkpoint=(
+                    None if checkpoint_spec is None
+                    else CheckpointManager(checkpoint_spec)
+                ),
+                should_stop=should_stop,
             )
         self.events.send(
             TrainingFinishEvent(

@@ -31,6 +31,7 @@ virtual CPU mesh.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import lru_cache, partial
 from typing import Callable, Iterable, Optional
 
@@ -43,8 +44,11 @@ from photon_ml_tpu import telemetry
 from photon_ml_tpu.ops.dense import DenseBatch
 from photon_ml_tpu.ops.objective import make_objective
 from photon_ml_tpu.optim.factory import OptimizerConfig
+from photon_ml_tpu.optim.guard import GuardSpec, damped_objective, solve_health
 
 Array = jax.Array
+
+logger = logging.getLogger("photon_ml_tpu.game.streaming")
 
 
 @lru_cache(maxsize=16)
@@ -206,6 +210,8 @@ class StreamingRandomEffectTrainer:
         axis: str = "entity",
         compute_variances: bool = False,
         prefetch: bool = True,
+        guard: Optional[GuardSpec] = None,
+        feed_retries: int = 2,
     ):
         # the vmapped / shard_mapped per-entity solver builders are shared
         # with RandomEffectCoordinate — one lru_cache entry serves both
@@ -229,6 +235,15 @@ class StreamingRandomEffectTrainer:
         # i's solve via async dispatch); False = fully synchronous, the
         # control arm for measuring the overlap win (bench_overlap.py)
         self.prefetch = prefetch
+        # per-chunk divergence guard (optim.guard). NOTE: the health check is
+        # one scalar fetch per chunk, which serializes the chunk pipeline —
+        # enable it for robustness, not for peak-throughput benches.
+        self._guard = guard
+        # bounded retry around host->device chunk feeding (a flaky tunnel /
+        # storage read should not kill a billion-coefficient run)
+        if feed_retries < 0:
+            raise ValueError("feed_retries must be >= 0")
+        self._feed_retries = feed_retries
         # the streaming table trains DENSE per-entity models: a global box
         # constraint on local dim k applies identically to every entity
         # (the bucket path gathers the same bounds through each entity's
@@ -290,6 +305,32 @@ class StreamingRandomEffectTrainer:
             return source
         raise TypeError(f"chunk source {type(source).__name__}")
 
+    # retryable feed failures: storage/tunnel I/O and runtime transfer
+    # errors (jax surfaces device/transfer faults as RuntimeError
+    # subclasses). Deterministic programming errors (TypeError/ValueError/
+    # KeyError/shape bugs) raise immediately — re-running cannot help.
+    _TRANSIENT_FEED_ERRORS = (OSError, RuntimeError, ConnectionError,
+                              TimeoutError)
+
+    def _feed(self, source) -> DenseBatch:
+        """_prepare with bounded retry: transient host->device feed failures
+        (generator I/O, tunnel hiccups) re-attempt up to ``feed_retries``
+        times before surfacing; programming errors raise immediately."""
+        last_err: Optional[Exception] = None
+        for attempt in range(self._feed_retries + 1):
+            if attempt:
+                telemetry.counter("streaming.feed_retries").inc()
+                logger.warning(
+                    "chunk feed failed (%s); retry %d/%d",
+                    last_err, attempt, self._feed_retries,
+                )
+            try:
+                return self._prepare(source)
+            except self._TRANSIENT_FEED_ERRORS as e:
+                last_err = e
+        assert last_err is not None
+        raise last_err
+
     def _chunk_constraints(self, dim: int):
         """ONE [dim] box shared by every entity (vmap broadcasts it) — the
         [E, K] materialization the bucket path needs for per-entity
@@ -319,23 +360,59 @@ class StreamingRandomEffectTrainer:
             )
         w0 = table.read_chunk(start, size)
         cons = self._chunk_constraints(table.dim)
+        rolled_back = False
         with telemetry.span("streaming_chunk", start=start, size=int(size)):
-            res, var = self._solver(self._obj, batch, w0, self._l1, cons)
-            table.write_chunk(start, res.w)
+            attempt = 0
+            while True:
+                obj = self._obj
+                if attempt:
+                    telemetry.counter("solves.retried").inc()
+                    obj = damped_objective(
+                        obj, self._guard.damping_for(attempt)
+                    )
+                res, var = self._solver(obj, batch, w0, self._l1, cons)
+                if self._guard is None:
+                    break
+                ok = bool(
+                    telemetry.sync_fetch(
+                        solve_health(res, res.w), label="streaming_guard"
+                    )
+                )
+                if ok:
+                    break
+                telemetry.counter("solves.diverged").inc()
+                if attempt >= self._guard.max_retries:
+                    # rollback: the chunk's table rows keep their pre-solve
+                    # coefficients; telemetry values are sanitized so the
+                    # run summary stays finite
+                    telemetry.counter("solves.rolled_back").inc()
+                    logger.warning(
+                        "chunk [%d, %d) still diverging after %d damped "
+                        "retries; keeping previous coefficients",
+                        start, start + size, self._guard.max_retries,
+                    )
+                    rolled_back = True
+                    break
+                attempt += 1
+            if not rolled_back:
+                table.write_chunk(start, res.w)
         telemetry.counter("streaming_chunks").inc()
         telemetry.counter("streaming_entities").inc(int(size))
-        if var is not None:
+        if var is not None and not rolled_back:
             if variance_table is None:
                 raise ValueError(
                     "compute_variances=True needs a variance_table to "
                     "write into (train(..., variance_table=...))"
                 )
             variance_table.write_chunk(start, var)
+        values = res.value
+        if rolled_back:
+            values = jnp.where(jnp.isfinite(values), values, 0.0)
         return ChunkResult(
             start=start,
             size=size,
             iterations=res.iterations,
-            values=res.value,
+            values=values,
             reasons=res.reason,
         )
 
@@ -365,7 +442,7 @@ class StreamingRandomEffectTrainer:
             it = iter(chunks)
             pending = None
             for start, source in it:
-                nxt = (start, self._prepare(source))
+                nxt = (start, self._feed(source))
                 if pending is not None:
                     results.append(
                         self._solve(
@@ -386,7 +463,7 @@ class StreamingRandomEffectTrainer:
                     self._solve(
                         table,
                         start,
-                        self._prepare(source),
+                        self._feed(source),
                         variance_table=variance_table,
                     )
                 )

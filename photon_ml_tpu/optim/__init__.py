@@ -19,6 +19,11 @@ from photon_ml_tpu.optim.factory import (  # noqa: F401
     build_objective,
     solve,
 )
+from photon_ml_tpu.optim.guard import (  # noqa: F401
+    GuardSpec,
+    model_is_finite,
+    solve_health,
+)
 from photon_ml_tpu.optim.newton import NewtonConfig, newton_solve  # noqa: F401
 from photon_ml_tpu.optim.lbfgs import LBFGSConfig, lbfgs_solve  # noqa: F401
 from photon_ml_tpu.optim.owlqn import owlqn_solve  # noqa: F401

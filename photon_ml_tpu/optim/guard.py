@@ -1,0 +1,123 @@
+"""Guarded solves: divergence detection, damped retries, rollback.
+
+The Spark reference inherits per-task fault tolerance from RDD lineage; a
+solve that NaNs there just fails its task and recomputes. On the TPU port a
+single NaN-producing entity or an ill-conditioned residual poisons a
+device array and — unguarded — the whole multi-hour GAME fit. The guard
+layer restores graceful degradation:
+
+  - after each coordinate (or streaming chunk) solve, a device-side health
+    reduce checks the new coefficients and final loss for non-finite values
+    and for loss regression (line searches are monotone, so a final value
+    above the initial one marks a diverged solve);
+  - on divergence the pre-solve model is kept and the solve retried with
+    escalating extra L2 damping (the l2 weight is a traced leaf of the
+    objective, so retries reuse the compiled program);
+  - if every retry diverges, the previous model is rolled back and training
+    continues — one bad coordinate degrades, it no longer kills the fit.
+
+Telemetry: ``solves.diverged`` (health checks that failed),
+``solves.retried`` (damped re-runs), ``solves.rolled_back`` (solves whose
+result was discarded), ``solves.frozen`` (coordinates dropped from the
+updating sequence after repeated rollbacks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# Relative slack for the loss-regression check: warm-started re-solves may
+# end epsilon above f_0 from padding/reduction-order noise; only a real
+# regression (or a non-finite value) should trip the guard.
+_REGRESSION_RTOL = 1e-3
+_REGRESSION_ATOL = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardSpec:
+    """Divergence-recovery policy for guarded solves.
+
+    ``max_retries`` damped re-runs follow a diverged solve; retry ``k``
+    (1-based) adds ``initial_damping * damping_factor**(k-1)`` extra L2.
+    After ``freeze_after`` CONSECUTIVE rollbacks a coordinate is frozen —
+    dropped from the updating sequence for the rest of the fit (its last
+    good model keeps scoring).
+    """
+
+    max_retries: int = 2
+    initial_damping: float = 1.0
+    damping_factor: float = 10.0
+    freeze_after: int = 2
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.initial_damping <= 0 or self.damping_factor < 1.0:
+            raise ValueError(
+                "damping must be positive and escalate (factor >= 1)"
+            )
+        if self.freeze_after < 1:
+            raise ValueError("freeze_after must be >= 1")
+
+    def damping_for(self, attempt: int) -> float:
+        """Extra L2 weight for ``attempt`` (0 = the original solve)."""
+        if attempt <= 0:
+            return 0.0
+        return self.initial_damping * self.damping_factor ** (attempt - 1)
+
+
+def damped_objective(obj, extra_l2: float):
+    """``obj`` with ``extra_l2`` added to its (traced) l2 leaf — the damped
+    retry uses the same compiled program. The one place damping composes,
+    shared by the coordinate, streaming, and mesh solve paths."""
+    if not extra_l2:
+        return obj
+    return dataclasses.replace(
+        obj, l2_weight=obj.l2_weight + jnp.float32(extra_l2)
+    )
+
+
+def solve_health(res, w: Array) -> Array:
+    """Device boolean scalar: ``res`` (a SolveResult, possibly with a
+    leading entity axis) produced finite coefficients ``w`` and a finite
+    final loss no worse than its initial value ``res.values[..., 0]``.
+
+    Stays on device — callers fetch it once via telemetry.sync_fetch so a
+    guarded solve costs exactly one accounted scalar round trip.
+    """
+    finite_w = jnp.all(jnp.isfinite(w))
+    v = res.value
+    v0 = jnp.take(res.values, 0, axis=-1)
+    budget = _REGRESSION_RTOL * jnp.abs(v0) + _REGRESSION_ATOL
+    ok_v = jnp.all(jnp.isfinite(v) & (v <= v0 + budget))
+    return jnp.logical_and(finite_w, ok_v)
+
+
+def _coefficient_arrays(model) -> list:
+    """Coefficient-like leaves of a (sub)model, duck-typed across the model
+    zoo (FixedEffect / RandomEffect buckets / factored latent tables)."""
+    out = []
+    if hasattr(model, "coefficients"):
+        out.append(model.coefficients)
+    for bm in getattr(model, "buckets", ()):
+        out.append(bm.coefficients)
+    if hasattr(model, "latent"):
+        out.append(model.latent)
+    return out
+
+
+def model_is_finite(model) -> Array:
+    """Device boolean scalar: every coefficient array of ``model`` is
+    finite. The fallback health check for coordinates that don't expose a
+    per-solve ``last_health``."""
+    arrays = _coefficient_arrays(model)
+    if not arrays:
+        return jnp.bool_(True)
+    return jnp.all(
+        jnp.stack([jnp.all(jnp.isfinite(a)) for a in arrays])
+    )

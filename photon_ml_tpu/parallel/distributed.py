@@ -95,16 +95,25 @@ def distributed_solve(
     shifts: Optional[Array] = None,
     init_value: Optional[Array] = None,
     init_grad_norm: Optional[Array] = None,
+    extra_l2: float = 0.0,
 ) -> SolveResult:
     """Solve a GLM with examples sharded over ``axis`` of ``mesh``.
 
     ``stacked_batch`` leaves carry a leading [num_shards, ...] axis with
     LOCAL row indices per shard (see parallel.mesh.shard_rows).
+    ``extra_l2`` adds damping on top of the configured regularization (the
+    guarded-solve retry path, optim.guard) — a traced objective leaf, so
+    damped retries hit the same compiled program.
     """
     import dataclasses as _dc
 
+    from photon_ml_tpu.optim.guard import damped_objective
+
     config.validate(loss_name)
-    obj = build_objective(loss_name, config, factors=factors, shifts=shifts)
+    obj = damped_objective(
+        build_objective(loss_name, config, factors=factors, shifts=shifts),
+        extra_l2,
+    )
     l1 = jnp.float32(config.regularization.l1_weight(config.regularization_weight))
     key_config = _dc.replace(config, regularization_weight=0.0)
     solver = _build_solver(key_config, mesh, axis)

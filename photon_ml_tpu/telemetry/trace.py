@@ -338,6 +338,9 @@ def export_chrome_trace(jsonl_path: str, out_path: str) -> int:
             except json.JSONDecodeError:
                 continue
     doc = to_chrome_trace(records)
-    with open(out_path, "w", encoding="utf-8") as fh:
-        json.dump(doc, fh)
+    # atomic write (tools/check.py L008): a crash mid-export must not leave
+    # a truncated trace that viewers reject wholesale
+    from photon_ml_tpu.utils.atomic import atomic_write_json
+
+    atomic_write_json(out_path, doc)
     return len(doc["traceEvents"])

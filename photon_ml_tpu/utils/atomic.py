@@ -1,0 +1,71 @@
+"""Crash-durable file writes: write-to-tmp, fsync, rename.
+
+The blessed atomic persistence primitives (tools/check.py lint L008 rejects
+raw ``np.savez``/``json.dump``-to-final-path writes in library code outside
+this module and the model/checkpoint stores built on it). The contract:
+after ``atomic_*`` returns, the destination path holds either the complete
+new content or — if the process died mid-write — whatever was there before;
+a reader can never observe a truncated file. The fsync before ``os.replace``
+matters: without it a crash AFTER the rename can still surface an empty
+file on ext4/xfs (rename is metadata-journaled ahead of data).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a just-renamed entry survives a crash (POSIX
+    renames are durable only once the parent directory is synced)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; best effort
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_json(path: str, obj, **dump_kwargs) -> None:
+    """Serialize ``obj`` as JSON at ``path`` atomically and durably."""
+    atomic_write_bytes(
+        path, json.dumps(obj, **dump_kwargs).encode("utf-8")
+    )
+
+
+def atomic_write_npz(path: str, **arrays) -> None:
+    """Atomic + fsynced npz write so a crash mid-save can never leave a
+    truncated array container next to valid metadata."""
+    import numpy as np
+
+    tmp = path + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_npy(path: str, arr) -> None:
+    """Atomic + fsynced single-array .npy write. Streams ``np.save``
+    straight into the tmp file — no in-memory serialization, so saving a
+    huge table (the mmap index store's hash arrays) costs no extra RAM."""
+    import numpy as np
+
+    tmp = path + ".tmp.npy"
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -1,0 +1,404 @@
+"""Fault tolerance: checkpoint/resume for coordinate descent, crash
+injection (truncated npz / deleted manifest -> fallback), guarded solves
+(damped retry, rollback, freeze), and the graceful-preemption handshake."""
+
+import dataclasses
+import json
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.game import (
+    CheckpointError,
+    CheckpointManager,
+    CheckpointSpec,
+    FixedEffectModel,
+    GracefulStop,
+    TrainingInterrupted,
+    run_coordinate_descent,
+)
+from photon_ml_tpu.optim import GuardSpec
+
+
+# ---------------------------------------------------------------------------
+# toy coordinates: real Coordinate protocol, no optimizer work — so the
+# checkpoint/guard machinery is exercised without compile cost
+# ---------------------------------------------------------------------------
+
+
+class _ToyCoordinate:
+    """Deterministic coordinate: every update adds 1 to both coefficients.
+
+    ``mode``: "ok" always converges; "nan_until_damped" produces NaNs until
+    the guard applies extra L2; "nan" always produces NaNs.
+    """
+
+    def __init__(self, name, mode="ok", n_rows=6):
+        self.name = name
+        self.mode = mode
+        self.n_rows = n_rows
+        self.extra_l2 = 0.0
+        self.updates = 0
+
+    def initialize_model(self):
+        return FixedEffectModel(
+            coefficients=jnp.zeros((2,), jnp.float32), shard_name="f"
+        )
+
+    def update_model(self, model, residual_scores):
+        self.updates += 1
+        if self.mode == "nan" or (
+            self.mode == "nan_until_damped" and not self.extra_l2
+        ):
+            return dataclasses.replace(
+                model, coefficients=jnp.full((2,), jnp.nan, jnp.float32)
+            )
+        return dataclasses.replace(
+            model, coefficients=model.coefficients + 1.0
+        )
+
+    def score(self, model):
+        return jnp.broadcast_to(
+            model.coefficients[0], (self.n_rows,)
+        ).astype(jnp.float32)
+
+
+def _run(coords, tmp_path=None, num_iterations=2, guard=None,
+         should_stop=None, **spec_kw):
+    checkpoint = None
+    if tmp_path is not None:
+        checkpoint = CheckpointManager(
+            CheckpointSpec(directory=str(tmp_path), **spec_kw)
+        )
+    return run_coordinate_descent(
+        coords,
+        task="logistic",
+        num_iterations=num_iterations,
+        guard=guard,
+        checkpoint=checkpoint,
+        should_stop=should_stop,
+    )
+
+
+def _coef(result, name):
+    return np.asarray(result.model.models[name].coefficients)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_saves_per_step_and_resume_skips_completed(tmp_path):
+    coords = {"a": _ToyCoordinate("a"), "b": _ToyCoordinate("b")}
+    reference = _run({"a": _ToyCoordinate("a"), "b": _ToyCoordinate("b")})
+
+    stops = iter([False, False, True, True, True])
+    with pytest.raises(TrainingInterrupted) as ei:
+        _run(coords, tmp_path, should_stop=lambda: next(stops))
+    # stopped after the 3rd step (global step 2); checkpoints 0..2 on disk
+    assert ei.value.step == 2
+    assert ei.value.checkpoint_path == str(tmp_path / "step-00000002")
+    assert sorted(os.listdir(tmp_path)) == [
+        "step-00000000", "step-00000001", "step-00000002"
+    ]
+
+    resumed_coords = {"a": _ToyCoordinate("a"), "b": _ToyCoordinate("b")}
+    result = _run(resumed_coords, tmp_path)
+    # only the single remaining step ran; models match the uninterrupted fit
+    assert resumed_coords["a"].updates == 0
+    assert resumed_coords["b"].updates == 1
+    np.testing.assert_array_equal(_coef(result, "a"), _coef(reference, "a"))
+    np.testing.assert_array_equal(_coef(result, "b"), _coef(reference, "b"))
+    # the resumed history contains the restored steps plus the new one
+    assert len(result.history) == 4
+
+
+def test_resume_false_clears_stale_checkpoints(tmp_path):
+    coords = {"a": _ToyCoordinate("a")}
+    _run(coords, tmp_path, num_iterations=3, keep_last=10)
+    fresh = {"a": _ToyCoordinate("a")}
+    _run(fresh, tmp_path, num_iterations=1, resume=False, keep_last=10)
+    assert fresh["a"].updates == 1  # trained from scratch
+    # the stale run's higher-numbered steps are gone: only this run's
+    # checkpoint remains, so a LATER --resume continues the right fit
+    assert sorted(os.listdir(tmp_path)) == ["step-00000000"]
+
+
+def test_frozen_coordinates_survive_resume(tmp_path):
+    """A coordinate frozen before a preemption stays frozen after resume —
+    the restart must not re-burn retries on a proven-divergent block."""
+    guard = GuardSpec(max_retries=1, freeze_after=1)
+    coords = {"bad": _ToyCoordinate("bad", mode="nan"),
+              "ok": _ToyCoordinate("ok")}
+    stops = iter([False, False, True, True])
+    with pytest.raises(TrainingInterrupted):
+        _run(coords, tmp_path, num_iterations=3, guard=guard,
+             should_stop=lambda: next(stops))
+    assert coords["bad"].updates == 2  # 1 attempt + 1 retry, then frozen
+
+    resumed = {"bad": _ToyCoordinate("bad", mode="nan"),
+               "ok": _ToyCoordinate("ok")}
+    result = _run(resumed, tmp_path, num_iterations=3, guard=guard)
+    assert resumed["bad"].updates == 0  # frozen state restored
+    np.testing.assert_array_equal(_coef(result, "ok"), [3.0, 3.0])
+
+
+def test_restore_falls_back_past_corrupt_checkpoints(tmp_path):
+    telemetry.reset()
+    try:
+        coords = {"a": _ToyCoordinate("a")}
+        _run(coords, tmp_path, num_iterations=3, keep_last=10)
+        spec = CheckpointSpec(directory=str(tmp_path), keep_last=10)
+
+        # newest checkpoint: truncate the coefficient npz mid-file
+        npz = (tmp_path / "step-00000002" / "model" / "fixed-effect" / "a"
+               / "coefficients.npz")
+        npz.write_bytes(npz.read_bytes()[:20])
+        state = CheckpointManager(spec).restore()
+        assert state.step == 1
+
+        # next: delete the manifest (simulates a crash before the rename)
+        (tmp_path / "step-00000001" / "manifest.json").unlink()
+        state = CheckpointManager(spec).restore()
+        assert state.step == 0
+        assert telemetry.snapshot()["counters"]["checkpoint.corrupt"] >= 2
+
+        # all corrupt -> fresh start (restore returns None)
+        with open(tmp_path / "step-00000000" / "manifest.json", "w") as f:
+            f.write("{ not json")
+        assert CheckpointManager(spec).restore() is None
+    finally:
+        telemetry.reset()
+
+
+def test_restore_rejects_mismatched_coordinates(tmp_path):
+    _run({"a": _ToyCoordinate("a")}, tmp_path, num_iterations=1)
+    with pytest.raises(CheckpointError, match="coordinates"):
+        _run({"other": _ToyCoordinate("other")}, tmp_path, num_iterations=1)
+
+
+def test_retention_keeps_last_k_and_cleans_tmp(tmp_path):
+    (tmp_path / ".tmp-step-00000099").mkdir()
+    _run({"a": _ToyCoordinate("a")}, tmp_path, num_iterations=4, keep_last=2)
+    assert sorted(os.listdir(tmp_path)) == ["step-00000002", "step-00000003"]
+
+
+def test_checkpoint_every_n_steps(tmp_path):
+    _run({"a": _ToyCoordinate("a")}, tmp_path, num_iterations=4, every=2,
+         keep_last=10)
+    assert sorted(os.listdir(tmp_path)) == ["step-00000001", "step-00000003"]
+
+
+def test_manifest_is_json_safe_and_names_step(tmp_path):
+    _run({"a": _ToyCoordinate("a")}, tmp_path, num_iterations=1)
+    with open(tmp_path / "step-00000000" / "manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["step"] == 0
+    assert manifest["coordinate_order"] == ["a"]
+    assert manifest["history"][0]["coordinate"] == "a"
+
+
+# ---------------------------------------------------------------------------
+# guarded solves
+# ---------------------------------------------------------------------------
+
+
+def test_guard_damped_retry_recovers(tmp_path):
+    telemetry.reset()
+    try:
+        coords = {"a": _ToyCoordinate("a", mode="nan_until_damped")}
+        result = _run(coords, num_iterations=1, guard=GuardSpec(max_retries=2))
+        np.testing.assert_array_equal(_coef(result, "a"), [1.0, 1.0])
+        counters = telemetry.snapshot()["counters"]
+        assert counters["solves.diverged"] == 1
+        assert counters["solves.retried"] == 1
+        assert "solves.rolled_back" not in counters
+        assert result.history[0]["solve_retries"] == 1
+    finally:
+        telemetry.reset()
+
+
+def test_guard_rollback_and_freeze(tmp_path):
+    telemetry.reset()
+    try:
+        coords = {
+            "bad": _ToyCoordinate("bad", mode="nan"),
+            "ok": _ToyCoordinate("ok"),
+        }
+        result = _run(
+            coords,
+            num_iterations=3,
+            guard=GuardSpec(max_retries=1, freeze_after=2),
+        )
+        # rolled back: the bad coordinate keeps its initial model, training
+        # completed, and the healthy coordinate trained every iteration
+        np.testing.assert_array_equal(_coef(result, "bad"), [0.0, 0.0])
+        np.testing.assert_array_equal(_coef(result, "ok"), [3.0, 3.0])
+        # frozen after 2 consecutive rollbacks -> no 3rd-iteration attempts
+        assert coords["bad"].updates == 2 * 2  # 2 rollbacks x (1 + 1 retry)
+        counters = telemetry.snapshot()["counters"]
+        assert counters["solves.rolled_back"] == 2
+        assert counters["solves.frozen"] == 1
+        assert result.history[0]["rolled_back"] is True
+    finally:
+        telemetry.reset()
+
+
+def test_guard_spec_validation():
+    with pytest.raises(ValueError):
+        GuardSpec(max_retries=-1)
+    with pytest.raises(ValueError):
+        GuardSpec(damping_factor=0.5)
+    assert GuardSpec().damping_for(0) == 0.0
+    assert GuardSpec(initial_damping=1.0, damping_factor=10.0).damping_for(2) \
+        == 10.0
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption
+# ---------------------------------------------------------------------------
+
+
+def test_graceful_stop_flag_on_sigterm():
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        stop = GracefulStop().install(signums=(signal.SIGTERM,))
+        assert not stop()
+        signal.raise_signal(signal.SIGTERM)
+        assert stop()
+        assert stop.signum == signal.SIGTERM
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_sigterm_mid_fit_writes_final_checkpoint(tmp_path):
+    """The acceptance path in-process: a stop request arriving mid-fit ends
+    the run with TrainingInterrupted AND a final checkpoint from which a
+    restart reproduces the uninterrupted fit exactly."""
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        stop = GracefulStop().install(signums=(signal.SIGTERM,))
+        coords = {"a": _ToyCoordinate("a"), "b": _ToyCoordinate("b")}
+        fired = []
+
+        def stop_after_first_step():
+            if not fired:
+                fired.append(True)
+                signal.raise_signal(signal.SIGTERM)
+            return stop()
+
+        with pytest.raises(TrainingInterrupted):
+            _run(coords, tmp_path, every=100,  # only the stop forces a save
+                 should_stop=stop_after_first_step)
+        assert sorted(os.listdir(tmp_path)) == ["step-00000000"]
+
+        reference = _run({"a": _ToyCoordinate("a"), "b": _ToyCoordinate("b")})
+        resumed = _run({"a": _ToyCoordinate("a"), "b": _ToyCoordinate("b")},
+                       tmp_path, every=100)
+        np.testing.assert_array_equal(_coef(resumed, "a"),
+                                      _coef(reference, "a"))
+        np.testing.assert_array_equal(_coef(resumed, "b"),
+                                      _coef(reference, "b"))
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a real GAME fit interrupted and resumed
+# ---------------------------------------------------------------------------
+
+
+def _toy_game(rng):
+    from photon_ml_tpu.game import (
+        FixedEffectConfig,
+        GameConfig,
+        RandomEffectConfig,
+        build_game_dataset,
+    )
+    from photon_ml_tpu.ops.sparse import SparseBatch
+
+    # shapes deliberately distinct from test_training's toy fits: sharing
+    # them would pre-warm the in-process jit cache and break that file's
+    # jit_compiles counter assertion
+    n = 130
+    X = rng.normal(size=(n, 6))
+    users = rng.integers(0, 4, n)
+    y = (rng.random(n) < 0.5).astype(float)
+    data = build_game_dataset(
+        response=y,
+        feature_shards={"f": SparseBatch.from_dense(X, y)},
+        id_columns={"u": users},
+    )
+    config = GameConfig(
+        task="logistic",
+        num_iterations=2,
+        coordinates={
+            "fixed": FixedEffectConfig(shard_name="f"),
+            "perUser": RandomEffectConfig(shard_name="f", id_name="u"),
+        },
+    )
+    return data, config
+
+
+def test_game_fit_interrupted_resume_reproduces_final_model(rng, tmp_path):
+    from photon_ml_tpu.game import GameEstimator
+
+    data, config = _toy_game(rng)
+    reference = GameEstimator(config).fit(data)
+
+    spec = CheckpointSpec(directory=str(tmp_path / "ckpt"))
+    stops = iter([False, True, True, True])
+    with pytest.raises(TrainingInterrupted):
+        GameEstimator(config).fit(
+            data, checkpoint_spec=spec, should_stop=lambda: next(stops)
+        )
+
+    resumed = GameEstimator(config).fit(data, checkpoint_spec=spec)
+    for name in ("fixed",):
+        np.testing.assert_allclose(
+            np.asarray(resumed.model.models[name].coefficients),
+            np.asarray(reference.model.models[name].coefficients),
+            rtol=1e-6, atol=1e-7,
+        )
+    for ref_b, res_b in zip(
+        reference.model.models["perUser"].buckets,
+        resumed.model.models["perUser"].buckets,
+    ):
+        np.testing.assert_allclose(
+            np.asarray(res_b.coefficients), np.asarray(ref_b.coefficients),
+            rtol=1e-6, atol=1e-7,
+        )
+
+
+def test_cli_checkpoint_and_guard_config_parsing():
+    from photon_ml_tpu.cli.train import (
+        _parse_checkpoint_spec,
+        _parse_guard_spec,
+    )
+
+    assert _parse_checkpoint_spec({}) is None
+    spec = _parse_checkpoint_spec(
+        {"checkpoint": {"dir": "/x", "every": 3, "resume": True}}
+    )
+    assert (spec.directory, spec.every, spec.resume) == ("/x", 3, True)
+    # resume defaults TRUE: a scheduler restart with identical argv must
+    # continue the preempted run, never wipe it
+    assert _parse_checkpoint_spec({"checkpoint": {"dir": "/x"}}).resume
+    assert not _parse_checkpoint_spec(
+        {"checkpoint": {"dir": "/x", "resume": False}}
+    ).resume
+    with pytest.raises(ValueError, match="unknown checkpoint"):
+        _parse_checkpoint_spec({"checkpoint": {"dir": "/x", "evry": 1}})
+    with pytest.raises(ValueError, match="'dir'"):
+        _parse_checkpoint_spec({"checkpoint": {"every": 2}})
+
+    assert _parse_guard_spec({}) == GuardSpec()  # guarded by default
+    assert _parse_guard_spec({"guard": False}) is None
+    assert _parse_guard_spec({"guard": {"max_retries": 5}}).max_retries == 5
+    with pytest.raises(ValueError, match="unknown guard"):
+        _parse_guard_spec({"guard": {"retries": 5}})

@@ -172,6 +172,29 @@ def test_validators(rng):
     validate(bad_label, "logistic_regression", mode=ValidationMode.DISABLED)
 
 
+def test_validators_collect_all_reports_every_failure(rng):
+    """collect_all=True aggregates EVERY failed check into one error — the
+    full damage report from one pass, not just the first failure."""
+    X = rng.normal(size=(20, 4))
+    X[3, 2] = np.nan  # non-finite features
+    y = rng.normal(size=20) * 5  # non-binary labels for a logistic task
+    weights = np.ones(20)
+    weights[5] = -1.0  # negative weight
+    batch = SparseBatch.from_dense(X, y, weights=weights)
+
+    # fail-fast mode still stops at the first check
+    with pytest.raises(DataValidationError, match="feature"):
+        validate(batch, "logistic_regression")
+
+    with pytest.raises(DataValidationError) as ei:
+        validate(batch, "logistic_regression", collect_all=True)
+    msg = str(ei.value)
+    assert "3 validation check(s) failed" in msg
+    assert "non-finite feature values" in msg
+    assert "negative weights" in msg
+    assert "binary task" in msg
+
+
 def test_summary_maxmin_unaffected_by_nnz_padding():
     """Regression (ADVICE r1-a): when n == n_pad, padding nnz entries alias
     the real last row; their value-0 must not leak into feature 0's max/min."""

@@ -184,6 +184,64 @@ print("FRESH-PROCESS-OK")
     assert "FRESH-PROCESS-OK" in out.stdout
 
 
+def test_load_errors_are_typed_and_name_the_path(tmp_path, rng):
+    """Crash injection: missing files, truncated npz containers, missing
+    npz keys, and unsupported format versions all surface as ModelLoadError
+    naming the offending path — not bare KeyError/BadZipFile."""
+    import json
+
+    from photon_ml_tpu.data.model_store import ModelLoadError
+
+    m = make_model("logistic", means=jnp.zeros(4))
+
+    # missing metadata file
+    with pytest.raises(ModelLoadError, match="missing metadata"):
+        load_glm(str(tmp_path / "nope"))
+
+    # truncated npz (simulates a crash mid-write of a non-atomic save)
+    save_glm(m, str(tmp_path / "trunc"))
+    npz = tmp_path / "trunc" / "coefficients.npz"
+    npz.write_bytes(npz.read_bytes()[:16])
+    with pytest.raises(ModelLoadError, match="coefficients.npz"):
+        load_glm(str(tmp_path / "trunc"))
+
+    # missing npz key
+    save_glm(m, str(tmp_path / "nokey"))
+    np.savez(tmp_path / "nokey" / "coefficients.npz", other=np.zeros(2))
+    with pytest.raises(ModelLoadError, match="missing array key 'means'"):
+        load_glm(str(tmp_path / "nokey"))
+
+    # unsupported format_version
+    save_glm(m, str(tmp_path / "vers"))
+    meta_path = tmp_path / "vers" / "model-metadata.json"
+    meta = json.loads(meta_path.read_text())
+    meta["format_version"] = 999
+    meta_path.write_text(json.dumps(meta))
+    with pytest.raises(ModelLoadError, match="format_version 999"):
+        load_glm(str(tmp_path / "vers"))
+
+    # corrupt metadata JSON
+    save_glm(m, str(tmp_path / "badjson"))
+    (tmp_path / "badjson" / "model-metadata.json").write_text("{ nope")
+    with pytest.raises(ModelLoadError, match="corrupt metadata"):
+        load_glm(str(tmp_path / "badjson"))
+
+    # ModelLoadError is a ValueError: existing callers keep working
+    assert issubclass(ModelLoadError, ValueError)
+
+
+def test_game_model_load_errors_typed(tmp_path, rng):
+    from photon_ml_tpu.data.model_store import ModelLoadError
+
+    gds, _ = _game_setup(rng)
+    model = _train_game_model(gds)
+    save_game_model(model, str(tmp_path / "game"))
+    npz = tmp_path / "game" / "random-effect" / "per-user" / "model.npz"
+    npz.write_bytes(npz.read_bytes()[:32])
+    with pytest.raises(ModelLoadError, match="model.npz"):
+        load_game_model(str(tmp_path / "game"))
+
+
 def test_wrong_model_type_errors(tmp_path, rng):
     m = make_model("logistic", means=jnp.zeros(3))
     save_glm(m, str(tmp_path / "m"))

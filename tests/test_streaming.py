@@ -310,6 +310,91 @@ def test_streaming_tracker_reports_per_entity_telemetry(rng):
     assert "iterations" in t.to_summary_string()
 
 
+def test_streaming_guard_rolls_back_nan_chunk(rng):
+    """A NaN-poisoned chunk rolls back (its table rows keep their pre-solve
+    coefficients) while healthy chunks train; the divergence is counted and
+    the run summary stays finite."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.optim.guard import GuardSpec
+
+    X, y = _chunked_entities(rng, n_ent=8, rows=6, k=3)
+    n_ent, rows, k = X.shape
+    Xbad = X[:4].astype(np.float32).copy()
+    Xbad[1, 2, 0] = np.nan
+
+    def chunk(x, yy):
+        return DenseBatch(
+            x=x.astype(np.float32), labels=yy.astype(np.float32),
+            offsets=np.zeros(yy.shape, np.float32),
+            weights=np.ones(yy.shape, np.float32),
+        )
+
+    telemetry.reset()
+    try:
+        table = ShardedCoefficientTable(n_ent, k)
+        trainer = StreamingRandomEffectTrainer(
+            "logistic", _CFG, guard=GuardSpec(max_retries=1)
+        )
+        stats = trainer.train(
+            table, [(0, chunk(Xbad, y[:4])), (4, chunk(X[4:], y[4:]))]
+        )
+        got = table.to_numpy()
+        np.testing.assert_array_equal(got[:4], 0.0)  # rolled back
+        assert np.any(np.abs(got[4:]) > 0)  # healthy chunk trained
+        assert np.isfinite(stats.total_final_value)
+        counters = telemetry.snapshot()["counters"]
+        assert counters["solves.rolled_back"] == 1
+        assert counters["solves.retried"] == 1
+    finally:
+        telemetry.reset()
+
+
+def test_streaming_feed_retry_survives_transient_failures(rng):
+    """host->device chunk feeding retries up to feed_retries times before
+    surfacing; a source that fails twice then succeeds still trains."""
+    from photon_ml_tpu import telemetry
+
+    X, y = _chunked_entities(rng, n_ent=4, rows=6, k=3)
+    n_ent, rows, k = X.shape
+    chunk = DenseBatch(
+        x=X.astype(np.float32), labels=y.astype(np.float32),
+        offsets=np.zeros((n_ent, rows), np.float32),
+        weights=np.ones((n_ent, rows), np.float32),
+    )
+    attempts = [0]
+
+    def flaky_source():
+        attempts[0] += 1
+        if attempts[0] < 3:
+            raise OSError("transient read failure")
+        return jax.tree.map(jnp.asarray, chunk)
+
+    telemetry.reset()
+    try:
+        table = ShardedCoefficientTable(n_ent, k)
+        trainer = StreamingRandomEffectTrainer(
+            "logistic", _CFG, feed_retries=2
+        )
+        stats = trainer.train(table, [(0, flaky_source)])
+        assert stats.total_entities == n_ent
+        assert telemetry.snapshot()["counters"]["streaming.feed_retries"] == 2
+        assert np.any(np.abs(table.to_numpy()) > 0)
+
+        # retries are bounded: a source that keeps failing surfaces
+        trainer2 = StreamingRandomEffectTrainer(
+            "logistic", _CFG, feed_retries=1
+        )
+
+        def always_fails():
+            raise OSError("dead source")
+
+        with pytest.raises(OSError, match="dead source"):
+            trainer2.train(ShardedCoefficientTable(n_ent, k),
+                           [(0, always_fails)])
+    finally:
+        telemetry.reset()
+
+
 def test_streaming_prefetch_arms_match(rng):
     """prefetch=True (one-chunk-ahead enqueue) and the synchronous control
     arm produce identical tables — the overlap is pure scheduling."""

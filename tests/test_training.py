@@ -320,6 +320,65 @@ def test_train_glm_emits_sweep_spans(rng):
         telemetry.reset()
 
 
+def test_game_fit_with_nan_coordinate_completes_via_guard(rng):
+    """ISSUE 2 acceptance: a fit with an injected NaN-producing coordinate
+    completes — the bad coordinate rolls back (then freezes) instead of
+    crashing the run, the divergence shows up in the telemetry snapshot,
+    and the healthy coordinate still trains."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.game import (
+        FixedEffectConfig,
+        GameConfig,
+        GameEstimator,
+        RandomEffectConfig,
+        build_game_dataset,
+    )
+    from photon_ml_tpu.optim import GuardSpec
+
+    n = 100
+    Xf = rng.normal(size=(n, 4))
+    Xg = rng.normal(size=(n, 4))
+    Xg[3, 2] = np.nan  # one poisoned feature value -> NaN objective
+    users = rng.integers(0, 3, n)
+    y = (rng.random(n) < 0.5).astype(float)
+    data = build_game_dataset(
+        response=y,
+        feature_shards={
+            "f": SparseBatch.from_dense(Xf, y),
+            "g": SparseBatch.from_dense(Xg, y),
+        },
+        id_columns={"u": users},
+    )
+    config = GameConfig(
+        task="logistic",
+        num_iterations=2,
+        coordinates={
+            "bad": FixedEffectConfig(shard_name="g"),
+            "perUser": RandomEffectConfig(shard_name="f", id_name="u"),
+        },
+    )
+    telemetry.reset()
+    try:
+        result = GameEstimator(config).fit(
+            data, guard=GuardSpec(max_retries=1)
+        )
+        counters = telemetry.snapshot()["counters"]
+        assert counters["solves.diverged"] >= 1
+        assert counters["solves.retried"] >= 1
+        assert counters["solves.rolled_back"] >= 1
+        w_bad = np.asarray(result.model.models["bad"].coefficients)
+        np.testing.assert_array_equal(w_bad, np.zeros_like(w_bad))
+        # NaN scores were sanitized out of the residual: the healthy
+        # coordinate trained to a finite non-zero model
+        w_user = np.asarray(
+            result.model.models["perUser"].buckets[0].coefficients
+        )
+        assert np.isfinite(w_user).all()
+        assert np.any(np.abs(w_user) > 0)
+    finally:
+        telemetry.reset()
+
+
 def test_variances_with_normalization_positive_and_scaled(rng):
     """The variance back-transform deviates from the reference deliberately:
     Var(c*X) = c^2 Var(X) — factor-squared scaling, no intercept shift term

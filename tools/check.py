@@ -19,6 +19,11 @@ Runs, in order:
          time.monotonic()/utils.timing.Timer) and bare
          `block_until_ready()` statements (a NO-OP sync through the
          tunnel — use telemetry.sync_fetch, the accounted fetch point)
+       - library-only non-atomic persistence (L008): `np.savez*` /
+         `json.dump`-to-final-path writes outside the blessed atomic
+         writers (utils/atomic.py and the model/checkpoint stores built on
+         it) — a crash mid-write must never leave a truncated file a later
+         load half-reads
   3. ruff + mypy, IF installed (configs live in pyproject.toml)
 
 Exit code 0 = clean. Any finding prints `path:line: code message` and the
@@ -65,12 +70,22 @@ def check_syntax(files: list[str]) -> list[str]:
     return errs
 
 
+# Files allowed to call np.savez/json.dump directly: the atomic-write
+# primitives and the persistence layers built immediately on top of them.
+L008_BLESSED = {
+    os.path.join("photon_ml_tpu", "utils", "atomic.py"),
+    os.path.join("photon_ml_tpu", "data", "model_store.py"),
+    os.path.join("photon_ml_tpu", "game", "checkpoint.py"),
+}
+
+
 class _Lint(ast.NodeVisitor):
     def __init__(self, path: str, tree: ast.Module, library: bool = False):
         self.path = path
         # library code (photon_ml_tpu/) additionally gets the fake-timing
         # rules L006/L007; benches and tests may time however they like
         self.library = library
+        self._l008_exempt = path in L008_BLESSED
         self.findings: list[str] = []
         self.imported: dict[str, int] = {}  # name -> lineno (module scope)
         self.used: set[str] = set()
@@ -152,6 +167,21 @@ class _Lint(ast.NodeVisitor):
             return True
         return isinstance(f, ast.Name) and f.id in self._time_aliases
 
+    def _is_non_atomic_persist_call(self, node: ast.Call) -> bool:
+        # `<anything>.savez(...)` / `<anything>.savez_compressed(...)` and
+        # `json.dump(...)` (json.dumps returns a string and is fine)
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in (
+            "savez", "savez_compressed",
+        ):
+            return True
+        return (
+            isinstance(f, ast.Attribute)
+            and f.attr == "dump"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "json"
+        )
+
     def visit_Call(self, node: ast.Call) -> None:
         if self.library and self._is_wall_clock_call(node):
             self._report(
@@ -159,6 +189,19 @@ class _Lint(ast.NodeVisitor):
                 "L006",
                 "time.time() in library code — wall-clock steps corrupt "
                 "phase durations; use time.monotonic() / utils.timing.Timer",
+            )
+        if (
+            self.library
+            and not self._l008_exempt
+            and self._is_non_atomic_persist_call(node)
+        ):
+            self._report(
+                node,
+                "L008",
+                "non-atomic persistence (np.savez/json.dump to a final "
+                "path) in library code — a crash mid-write leaves a "
+                "truncated file; route through utils.atomic / the "
+                "model_store//checkpoint writers",
             )
         self.generic_visit(node)
 
