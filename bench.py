@@ -16,6 +16,12 @@ Sub-benchmarks run as subprocesses (fresh jit caches, bounded memory); a
 failing sub-benchmark emits an {"metric": ..., "error": ...} line instead
 of killing the run. The reference publishes no numbers (BASELINE.json
 "published": {}), so vs_baseline is null throughout.
+
+PHOTON_BENCH_BUDGET_S caps the whole run's wall clock: once spent, the
+remaining sub-benchmarks are skipped but every expected metric still
+emits a valid JSON line with "truncated": true (no more silent rc=124 —
+the BENCH_r05 failure mode). With PHOTON_TRACE_OUT set, a run report
+(markdown + JSON baseline) is written beside the trace at the end.
 """
 
 from __future__ import annotations
@@ -132,52 +138,134 @@ def main():
     print(layout_line, flush=True)
 
 
-def run_sub_benchmarks():
+from bench_suite import SUITE_METRICS as _SUITE_METRICS
+
+#: Expected metric lines per sub-benchmark, so a budget-skipped script
+#: still emits one valid truncated line PER metric it would have printed.
+#: bench_suite's names come from its own module — one source of truth.
+_SCRIPT_METRICS = {
+    "bench_suite.py": _SUITE_METRICS,
+    "bench_game.py": ("glmix_fe_re_logistic_1Mx100Kusers_coeffs_per_sec",),
+    "bench_scale.py": ("game_1B_coeffs_trained_per_sec",),
+    "bench_ingest.py": ("avro_ingest_rows_per_sec",),
+    "bench_northstar.py": ("north_star_e2e",),
+}
+
+
+def run_sub_benchmarks(deadline=None):
     """Forward the JSON lines of every sub-benchmark (configs #2-#5 +
-    ingestion + the north-star e2e pipeline), each in its own process."""
+    ingestion + the north-star e2e pipeline), each in its own process.
+
+    ``deadline`` (monotonic seconds, from PHOTON_BENCH_BUDGET_S): scripts
+    that would start past it are skipped with truncated placeholder lines,
+    and a running script's timeout is capped at the remaining budget —
+    metrics it printed before the cap are forwarded, the rest truncated.
+    """
+    from bench_suite import truncated_line
+
     here = os.path.dirname(os.path.abspath(__file__))
     # north-star (20M-row full pipeline) runs last and longest; the
     # driver's BASELINE numbers come from the earlier lines either way
     for script in ("bench_suite.py", "bench_game.py", "bench_scale.py",
                    "bench_ingest.py", "bench_northstar.py"):
         path = os.path.join(here, script)
+        expected = _SCRIPT_METRICS.get(script, (script.replace(".py", ""),))
+        remaining = (
+            None if deadline is None else deadline - time.monotonic()
+        )
+        if remaining is not None and remaining <= 0:
+            for metric in expected:
+                print(truncated_line(metric), flush=True)
+            continue
+        timeout = 1500 if script != "bench_northstar.py" else 4500
+        if remaining is not None:
+            timeout = min(timeout, max(remaining, 1.0))
+        emitted = set()
         try:
             proc = subprocess.run(
                 [sys.executable, path],
                 capture_output=True,
                 text=True,
-                timeout=1500 if script != "bench_northstar.py" else 4500,
+                timeout=timeout,
                 cwd=here,
             )
-            emitted = False
             for line in proc.stdout.splitlines():
                 line = line.strip()
                 if line.startswith("{"):
                     print(line, flush=True)
-                    emitted = True
+                    emitted.add(_metric_of(line))
             if proc.returncode != 0 or not emitted:
                 raise RuntimeError(
                     f"rc={proc.returncode}: {proc.stderr[-400:]}"
                 )
         except (subprocess.SubprocessError, RuntimeError, OSError) as e:
             # a timed-out sub-benchmark may have emitted metrics already —
-            # forward them before the error line
+            # forward them before the error/truncated lines
             partial = getattr(e, "stdout", None) or ""
             if isinstance(partial, bytes):
                 partial = partial.decode(errors="replace")
             for line in partial.splitlines():
-                if line.strip().startswith("{"):
-                    print(line.strip(), flush=True)
-            print(
-                json.dumps(
-                    {"metric": script.replace(".py", ""), "value": None,
-                     "unit": None, "vs_baseline": None,
-                     "error": str(e)[-400:]}
-                ),
-                flush=True,
+                line = line.strip()
+                if line.startswith("{"):
+                    print(line, flush=True)
+                    emitted.add(_metric_of(line))
+            over_budget = (
+                deadline is not None and time.monotonic() >= deadline
             )
+            if over_budget:
+                # the budget, not the benchmark, ended this script: emit
+                # valid truncated lines for whatever it never printed
+                for metric in expected:
+                    if metric not in emitted:
+                        print(truncated_line(metric), flush=True)
+            else:
+                print(
+                    json.dumps(
+                        {"metric": script.replace(".py", ""), "value": None,
+                         "unit": None, "vs_baseline": None,
+                         "error": str(e)[-400:]}
+                    ),
+                    flush=True,
+                )
+
+
+def _metric_of(json_line: str):
+    try:
+        return json.loads(json_line).get("metric")
+    except json.JSONDecodeError:
+        return None
+
+
+def write_run_report():
+    """With PHOTON_TRACE_OUT set, render this process's telemetry as a run
+    report beside the trace (markdown + JSON compare baseline for the
+    bench_suite --gate / cli report --compare flows).
+
+    Sub-benchmarks inherit the same env var, and the last one to run
+    (bench_northstar.py, the e2e whose silence motivated this layer) owns
+    both the trace file and its report — never overwrite it with the
+    parent's glm-only telemetry; only fill in the report when no
+    sub-benchmark produced one."""
+    trace_out = os.environ.get("PHOTON_TRACE_OUT")
+    if not trace_out:
+        return
+    from photon_ml_tpu.telemetry.report import RunReport, report_path
+
+    md_path = report_path(trace_out)
+    if os.path.exists(md_path):
+        print(f"run report (from sub-benchmark): {md_path}", file=sys.stderr)
+        return
+    report = RunReport.from_live()
+    with open(md_path, "w", encoding="utf-8") as fh:
+        fh.write(report.to_markdown())
+    report.save_json(md_path[: -len(".md")] + ".json")
+    print(f"run report: {md_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":
+    from bench_suite import budget_deadline
+
+    _deadline = budget_deadline()
     main()
-    run_sub_benchmarks()
+    run_sub_benchmarks(deadline=_deadline)
+    write_run_report()

@@ -224,18 +224,24 @@ def _run(workdir):
     # either way, so "upload+compile dominated" phases are quantified
     telemetry.configure_from_env()
 
-    t0 = time.perf_counter()
-    train_summary = train_run(config)
-    train_s = time.perf_counter() - t0
+    # an hours-scale pipeline must never be silent (BENCH_r05 timed out
+    # with zero output): one progress line every 30s to stderr via the
+    # progress logger, with span path + rows/s + HBM (train_run's own
+    # heartbeat is redundant under ours — disabled to avoid double lines)
+    config["heartbeat"] = False
+    with telemetry.Heartbeat(interval=30.0):
+        t0 = time.perf_counter()
+        train_summary = train_run(config)
+        train_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    score_summary = score_run(
-        model_dir=os.path.join(model_out, "best"),
-        input_spec={**config["input"], "paths": [paths["val"]]},
-        output_path=os.path.join(workdir, "scores.avro"),
-        evaluators=("auc",),
-    )
-    score_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        score_summary = score_run(
+            model_dir=os.path.join(model_out, "best"),
+            input_spec={**config["input"], "paths": [paths["val"]]},
+            output_path=os.path.join(workdir, "scores.avro"),
+            evaluators=("auc",),
+        )
+        score_s = time.perf_counter() - t0
 
     import jax
 
@@ -280,6 +286,21 @@ def _run(workdir):
             default=float,
         )
     )
+
+    trace_out = os.environ.get("PHOTON_TRACE_OUT")
+    if trace_out:
+        # run report beside the bench JSON: the phase-time tree and
+        # fetch/compile accounting, readable without opening Perfetto
+        import sys
+
+        from photon_ml_tpu.telemetry.report import RunReport, report_path
+
+        report = RunReport.from_live()
+        md_path = report_path(trace_out)
+        with open(md_path, "w", encoding="utf-8") as fh:
+            fh.write(report.to_markdown())
+        report.save_json(md_path[: -len(".md")] + ".json")
+        print(f"run report: {md_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -10,14 +10,60 @@ and bench_game.py (#4). Prints ONE JSON line PER config.
 
 Timing recipe per PERF_NOTES.md: warm up with different arg values (the
 tunnel TPU result-caches identical calls), sync via scalar fetch.
+
+Budget: ``PHOTON_BENCH_BUDGET_S`` caps this process's wall clock. When the
+budget runs out mid-suite, the remaining configs are SKIPPED but still
+emit valid JSON — ``{"metric": ..., "value": null, "truncated": true}`` —
+so harness consumers see every expected metric instead of an rc=124 with
+partial output (the BENCH_r05 failure mode).
+
+Gate: ``--gate baseline.json`` compares this run's rows/s values against a
+baseline (a ``{metric: value}`` dict keyed by THESE suite metric names,
+or an earlier run's bench JSON lines) and exits 3 when any metric
+regressed more than ``--gate-threshold`` (default 20%) — the CI perf
+gate. A gate that compared nothing exits 2 — whether the baseline shares
+no metric names with the suite or the budget truncated every gateable
+metric — so a mis-wired or starved gate can never pass silently.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+GATE_EXIT_CODE = 3
+
+SUITE_METRICS = (
+    "linreg_tron_1Mx10K_rows_per_sec_per_chip",
+    "linreg_owlqn_elasticnet_1Mx10K_rows_per_sec_per_chip",
+    "poisson_offsets_box_1Mx10K_rows_per_sec_per_chip",
+)
+
+
+def budget_deadline(now: float | None = None):
+    """Monotonic deadline from PHOTON_BENCH_BUDGET_S, or None (no cap)."""
+    budget = os.environ.get("PHOTON_BENCH_BUDGET_S")
+    if not budget:
+        return None
+    return (time.monotonic() if now is None else now) + float(budget)
+
+
+def truncated_line(metric: str) -> str:
+    """The valid-JSON placeholder for a budget-skipped metric."""
+    return json.dumps(
+        {
+            "metric": metric,
+            "value": None,
+            "unit": None,
+            "vs_baseline": None,
+            "truncated": True,
+        }
+    )
 
 
 def _sparse_problem(rng, n_rows, n_features, nnz_per_row, kind):
@@ -64,7 +110,10 @@ def _run(solver, batch, w0, n_rows):
     }
 
 
-def main():
+def run_suite(deadline=None) -> dict[str, float | None]:
+    """Run the configs in order, emitting one JSON line each; configs past
+    the budget deadline emit truncated placeholders instead. Returns
+    {metric: rows_per_sec or None}."""
     import jax
     import jax.numpy as jnp
 
@@ -75,80 +124,205 @@ def main():
         LBFGSConfig,
         TRONConfig,
         glm_adapter,
+        lbfgs_solve,
         owlqn_solve,
         tron_solve,
     )
 
     rng = np.random.default_rng(0)
     n_rows, n_features, nnz_per_row = 1_000_000, 10_000, 20
-
-    # --- config #2: linear + TRON (L2), + OWLQN elastic-net companion ----
-    values, rows, cols, y, _ = _sparse_problem(
-        rng, n_rows, n_features, nnz_per_row, "linear"
-    )
-    batch = TiledBatch.from_coo(
-        values=values, rows=rows, cols=cols, labels=y, num_features=n_features
-    )
-    obj = make_objective("squared", l2_weight=1.0)
-    tron_cfg = TRONConfig(max_iterations=10, tolerance=0.0)
-
-    def tron_run(w0, b):
-        return tron_solve(glm_adapter(obj, b), w0, tron_cfg)
-
     w0 = jnp.zeros((n_features,), jnp.float32)
-    d = _run(jax.jit(tron_run), batch, w0, n_rows)
-    print(json.dumps({
-        "metric": "linreg_tron_1Mx10K_rows_per_sec_per_chip",
-        "value": d["rows_per_sec"], "unit": "rows/s", "vs_baseline": None,
-        "detail": d,
-    }))
+    results: dict[str, float | None] = {}
+    cache: dict[str, object] = {}
+
+    def linear_batch():
+        if "linear" not in cache:
+            values, rows, cols, y, _ = _sparse_problem(
+                rng, n_rows, n_features, nnz_per_row, "linear"
+            )
+            cache["linear"] = TiledBatch.from_coo(
+                values=values, rows=rows, cols=cols, labels=y,
+                num_features=n_features,
+            )
+        return cache["linear"]
+
+    # --- config #2: linear + TRON (L2) -----------------------------------
+    def run_tron():
+        obj = make_objective("squared", l2_weight=1.0)
+        tron_cfg = TRONConfig(max_iterations=10, tolerance=0.0)
+
+        def tron_run(w0, b):
+            return tron_solve(glm_adapter(obj, b), w0, tron_cfg)
+
+        return _run(jax.jit(tron_run), linear_batch(), w0, n_rows)
 
     # elastic-net half: OWLQN with l1=0.5, l2=0.5
-    obj_en = make_objective("squared", l2_weight=0.5)
-    lcfg = LBFGSConfig(max_iterations=20, tolerance=0.0)
+    def run_owlqn():
+        obj_en = make_objective("squared", l2_weight=0.5)
+        lcfg = LBFGSConfig(max_iterations=20, tolerance=0.0)
 
-    def owlqn_run(w0, b):
-        return owlqn_solve(glm_adapter(obj_en, b), w0, jnp.float32(0.5), lcfg)
+        def owlqn_run(w0, b):
+            return owlqn_solve(
+                glm_adapter(obj_en, b), w0, jnp.float32(0.5), lcfg
+            )
 
-    d = _run(jax.jit(owlqn_run), batch, w0, n_rows)
-    print(json.dumps({
-        "metric": "linreg_owlqn_elasticnet_1Mx10K_rows_per_sec_per_chip",
-        "value": d["rows_per_sec"], "unit": "rows/s", "vs_baseline": None,
-        "detail": d,
-    }))
+        return _run(jax.jit(owlqn_run), linear_batch(), w0, n_rows)
 
     # --- config #3: Poisson + offsets + box constraints ------------------
-    values, rows, cols, y, offsets = _sparse_problem(
-        rng, n_rows, n_features, nnz_per_row, "poisson"
-    )
-    batch = TiledBatch.from_coo(
-        values=values, rows=rows, cols=cols, labels=y,
-        offsets=offsets, num_features=n_features,
-    )
-    obj_p = make_objective("poisson", l2_weight=1.0)
-    lower = np.full(n_features, -0.5)
-    upper = np.full(n_features, 0.5)
-    constraints = BoxConstraints(
-        lower=jnp.asarray(lower, jnp.float32),
-        upper=jnp.asarray(upper, jnp.float32),
-    )
-
-    from photon_ml_tpu.optim import lbfgs_solve
-
-    def poisson_run(w0, b):
-        return lbfgs_solve(
-            glm_adapter(obj_p, b), w0,
-            LBFGSConfig(max_iterations=20, tolerance=0.0),
-            constraints=constraints,
+    def run_poisson():
+        values, rows, cols, y, offsets = _sparse_problem(
+            rng, n_rows, n_features, nnz_per_row, "poisson"
+        )
+        batch = TiledBatch.from_coo(
+            values=values, rows=rows, cols=cols, labels=y,
+            offsets=offsets, num_features=n_features,
+        )
+        obj_p = make_objective("poisson", l2_weight=1.0)
+        constraints = BoxConstraints(
+            lower=jnp.asarray(np.full(n_features, -0.5), jnp.float32),
+            upper=jnp.asarray(np.full(n_features, 0.5), jnp.float32),
         )
 
-    d = _run(jax.jit(poisson_run), batch, w0, n_rows)
-    print(json.dumps({
-        "metric": "poisson_offsets_box_1Mx10K_rows_per_sec_per_chip",
-        "value": d["rows_per_sec"], "unit": "rows/s", "vs_baseline": None,
-        "detail": d,
-    }))
+        def poisson_run(w0, b):
+            return lbfgs_solve(
+                glm_adapter(obj_p, b), w0,
+                LBFGSConfig(max_iterations=20, tolerance=0.0),
+                constraints=constraints,
+            )
+
+        return _run(jax.jit(poisson_run), batch, w0, n_rows)
+
+    steps = zip(SUITE_METRICS, (run_tron, run_owlqn, run_poisson))
+    truncated = False
+    for metric, step in steps:
+        if truncated or (
+            deadline is not None and time.monotonic() > deadline
+        ):
+            truncated = True  # budget spent: skip everything remaining
+            print(truncated_line(metric), flush=True)
+            results[metric] = None
+            continue
+        d = step()
+        results[metric] = d["rows_per_sec"]
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": d["rows_per_sec"],
+                    "unit": "rows/s",
+                    "vs_baseline": None,
+                    "detail": d,
+                }
+            ),
+            flush=True,
+        )
+    return results
+
+
+def load_gate_baseline(path: str) -> dict[str, float]:
+    """Baseline formats accepted: a bare ``{metric: value}`` dict, JSONL
+    of earlier bench output lines (``{"metric": ..., "value": ...}``), or
+    — for generality — any report-shaped JSON with ``key_metrics``
+    (run_gate errors if its names don't overlap the suite's)."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        if "key_metrics" in doc:
+            doc = doc["key_metrics"]
+        return {
+            k: float(v)
+            for k, v in doc.items()
+            if isinstance(v, (int, float))
+        }
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if (
+            isinstance(rec, dict)
+            and rec.get("metric")
+            and isinstance(rec.get("value"), (int, float))
+        ):
+            out[rec["metric"]] = float(rec["value"])
+    return out
+
+
+def run_gate(
+    results: dict[str, float | None], baseline: dict[str, float],
+    threshold: float,
+) -> int:
+    """Compare measured rows/s against the baseline (higher is better);
+    returns the process exit code. Truncated (None) metrics are not
+    gateable and are reported as skipped."""
+    from photon_ml_tpu.telemetry.report import compare_metrics
+
+    current = {k: v for k, v in results.items() if v is not None}
+    directions = {name: +1 for name in set(current) | set(baseline)}
+    deltas = compare_metrics(
+        current, baseline, threshold=threshold, directions=directions
+    )
+    for d in deltas:
+        status = "REGRESSED" if d.regressed else "ok"
+        print(
+            f"gate: {d.metric}: {d.current:.1f} vs baseline "
+            f"{d.baseline:.1f} ({d.change:+.1%}) {status}",
+            file=sys.stderr,
+        )
+    truncated_overlap = False
+    for name, value in results.items():
+        if value is None:
+            truncated_overlap = truncated_overlap or name in baseline
+            print(f"gate: {name}: truncated, not gated", file=sys.stderr)
+    if not deltas:
+        # a gate that compared NOTHING must not pass: neither a
+        # mismatched baseline (wrong metric names — a permanent false
+        # pass) nor a run whose every gateable metric was budget-
+        # truncated (a real regression would stay green)
+        reason = (
+            "every overlapping metric was budget-truncated; nothing "
+            "was compared"
+            if truncated_overlap
+            else "no comparable metrics between this run "
+            f"({sorted(results)}) and the baseline ({sorted(baseline)})"
+        )
+        print(f"gate: ERROR — {reason}", file=sys.stderr)
+        return 2
+    if any(d.regressed for d in deltas):
+        return GATE_EXIT_CODE
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--gate",
+        metavar="baseline.json",
+        help="compare rows/s against this baseline and exit nonzero on "
+        "a regression beyond --gate-threshold",
+    )
+    parser.add_argument(
+        "--gate-threshold",
+        type=float,
+        default=0.2,
+        help="fractional regression threshold for --gate (default 0.2)",
+    )
+    args = parser.parse_args(argv)
+    results = run_suite(deadline=budget_deadline())
+    if args.gate:
+        return run_gate(
+            results, load_gate_baseline(args.gate), args.gate_threshold
+        )
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

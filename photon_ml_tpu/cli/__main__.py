@@ -9,11 +9,12 @@ import sys
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m photon_ml_tpu.cli {train|score|glm|index} [options]")
+        print("usage: python -m photon_ml_tpu.cli {train|score|glm|index|report} [options]")
         print("  train --config <json> [--output-dir <dir>]   GAME training")
         print("  score --model-dir <dir> --config <json> [--output <avro>]")
         print("  glm   --config <json> [--output-dir <dir>]   staged legacy GLM")
         print("  index --input <avro...> --output <dir>       feature index build")
+        print("  report --trace <jsonl> [--telemetry <jsonl>] [--compare <json>]")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "train":
@@ -32,7 +33,14 @@ def main(argv=None) -> int:
         from photon_ml_tpu.cli.index import main as index_main
 
         return index_main(rest)
-    print(f"unknown command '{cmd}' (expected train|score|glm|index)", file=sys.stderr)
+    if cmd == "report":
+        from photon_ml_tpu.cli.report import main as report_main
+
+        return report_main(rest)
+    print(
+        f"unknown command '{cmd}' (expected train|score|glm|index|report)",
+        file=sys.stderr,
+    )
     return 2
 
 

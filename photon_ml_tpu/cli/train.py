@@ -218,12 +218,78 @@ def _parse_guard_spec(config: Mapping) -> Optional[GuardSpec]:
     return GuardSpec(**spec)
 
 
+def _parse_heartbeat(config: Mapping, telemetry_out: Optional[str]):
+    """Config key ``"heartbeat"``: true (default — a progress line every
+    ~30 s once a fit runs longer than that), false to disable, or
+    ``{"every": seconds, "out": jsonl_path}``. The JSONL sink defaults to
+    ``telemetry_out`` so heartbeat lines land next to the metrics
+    snapshot and the run report picks them up."""
+    spec = config.get("heartbeat", True)
+    # False / null / 0 all disable ({} means enabled with defaults)
+    if spec is None or spec is False or spec == 0:
+        return None
+    if isinstance(spec, (int, float)) and not isinstance(spec, bool):
+        spec = {"every": float(spec)}  # bare number = interval seconds
+    from photon_ml_tpu.telemetry.progress import DEFAULT_INTERVAL_S, Heartbeat
+
+    every = DEFAULT_INTERVAL_S
+    out = telemetry_out
+    if spec is not True:
+        spec = dict(spec)
+        unknown = set(spec) - {"every", "out"}
+        if unknown:
+            raise ValueError(
+                f"unknown heartbeat config keys: {sorted(unknown)}"
+            )
+        every = float(spec.get("every", every))
+        out = spec.get("out", out)
+        if every <= 0:
+            return None
+    return Heartbeat(interval=every, jsonl_path=out)
+
+
+def _maybe_write_report(
+    config: Mapping,
+    summary: dict,
+    trace_out: Optional[str],
+    telemetry_out: Optional[str],
+) -> None:
+    """Config key ``report_out`` (the ``--report-out`` flag): render the
+    run report (markdown + a sibling ``.json`` compare baseline) from this
+    run's sinks — or the live in-process telemetry when no sinks were
+    configured — and record both paths in the summary."""
+    report_out = config.get("report_out")
+    if not report_out:
+        return
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    ckpt_dir = (config.get("checkpoint") or {}).get("dir")
+    if trace_out or telemetry_out:
+        report = RunReport.load(
+            trace=trace_out, telemetry=telemetry_out, checkpoint_dir=ckpt_dir
+        )
+    else:
+        report = RunReport.from_live(checkpoint_dir=ckpt_dir)
+    with open(report_out, "w", encoding="utf-8") as fh:
+        fh.write(report.to_markdown())
+    json_path = (
+        report_out[: -len(".md")] + ".json"
+        if report_out.endswith(".md")
+        else report_out + ".json"
+    )
+    report.save_json(json_path)
+    summary["report"] = report_out
+    summary["report_json"] = json_path
+
+
 def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     """Execute the training pipeline; returns a JSON-safe summary.
 
     Config keys ``trace_out`` (span JSONL; a sibling ``.perfetto.json``
     Chrome trace is written at the end) and ``telemetry_out`` (metrics
     snapshot JSONL) — the ``--trace-out`` / ``--telemetry-out`` flags.
+    ``heartbeat`` (on by default) emits a progress line every ~30 s during
+    the fit; ``report_out`` renders the run report when training ends.
 
     Fault tolerance: the ``checkpoint`` config object persists coordinate-
     descent state per step and resumes from it; a SIGTERM/SIGINT during the
@@ -234,6 +300,7 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     game_config = parse_game_config(config)
     output_dir = output_dir or config.get("output_dir")
     trace_out = config.get("trace_out")
+    telemetry_out = config.get("telemetry_out")
     if trace_out:
         telemetry.configure(trace_out=trace_out)
     checkpoint_spec = _parse_checkpoint_spec(config)
@@ -262,7 +329,10 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
 
         for listener in load_listeners(config["event_listeners"]):
             estimator.events.register(listener)
+    heartbeat = _parse_heartbeat(config, telemetry_out)
     try:
+        if heartbeat is not None:
+            heartbeat.start()
         with timed("fit"):
             result = estimator.fit(
                 train_data,
@@ -283,14 +353,17 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
             "output_dir": output_dir,
             "num_rows": train_data.num_rows,
         }
-        telemetry_out = config.get("telemetry_out")
         if telemetry_out:
             summary["telemetry"] = telemetry.flush_metrics(telemetry_out)
         if trace_out:
             telemetry.export_chrome_trace(
                 trace_out, telemetry.perfetto_path(trace_out)
             )
+        _maybe_write_report(config, summary, trace_out, telemetry_out)
         return summary
+    finally:
+        if heartbeat is not None:
+            heartbeat.stop()
 
     if output_dir is not None and index_maps is not None:
         # persist the feature space next to the models so scoring reproduces
@@ -327,7 +400,6 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
             for entry in result.history
         ],
     }
-    telemetry_out = config.get("telemetry_out")
     if telemetry_out:
         summary["telemetry"] = telemetry.flush_metrics(telemetry_out)
     if trace_out:
@@ -335,6 +407,7 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
         telemetry.export_chrome_trace(
             trace_out, telemetry.perfetto_path(trace_out)
         )
+    _maybe_write_report(config, summary, trace_out, telemetry_out)
     return summary
 
 
@@ -353,6 +426,19 @@ def main(argv=None) -> int:
         "--telemetry-out",
         help="append the final metrics snapshot to this JSONL file; "
         "overrides config telemetry_out",
+    )
+    parser.add_argument(
+        "--report-out",
+        help="write the run report (markdown; + a sibling .json compare "
+        "baseline) here when training ends — the `cli report` rendering "
+        "of this run's trace/telemetry/checkpoints (config report_out)",
+    )
+    parser.add_argument(
+        "--heartbeat-every",
+        type=float,
+        help="seconds between live progress heartbeat lines (default 30, "
+        "so only fits longer than ~30 s emit any; 0 disables; config key "
+        "heartbeat)",
     )
     parser.add_argument(
         "--checkpoint-dir",
@@ -383,6 +469,16 @@ def main(argv=None) -> int:
         config["trace_out"] = args.trace_out
     if args.telemetry_out:
         config["telemetry_out"] = args.telemetry_out
+    if args.report_out:
+        config["report_out"] = args.report_out
+    if args.heartbeat_every is not None:
+        if args.heartbeat_every <= 0:
+            config["heartbeat"] = False
+        else:
+            hb = config.get("heartbeat")
+            hb = dict(hb) if isinstance(hb, dict) else {}
+            hb["every"] = args.heartbeat_every
+            config["heartbeat"] = hb
     if args.checkpoint_dir or args.checkpoint_every is not None or args.resume:
         ckpt = dict(config.get("checkpoint") or {})
         if args.checkpoint_dir:

@@ -171,6 +171,11 @@ class CheckpointManager:
             fsync_dir(self.spec.directory)
         telemetry.counter("checkpoint.saves").inc()
         telemetry.gauge("checkpoint.last_step").set(state.step)
+        # tracer-timebase save stamp: the heartbeat reports checkpoint AGE
+        # (now - this) so a wedged saver is visible before the run dies
+        telemetry.gauge("checkpoint.last_save_ts").set(
+            telemetry.trace.TRACER.now()
+        )
         self._apply_retention()
         return final
 

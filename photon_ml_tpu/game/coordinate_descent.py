@@ -20,10 +20,12 @@ import dataclasses
 import logging
 from typing import Mapping, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import memory as telemetry_memory
 from photon_ml_tpu.evaluation import EVALUATORS, better_than, sharded_auc, sharded_precision_at_k
 from photon_ml_tpu.evaluation.evaluators import parse_evaluator
 from photon_ml_tpu.game.checkpoint import (
@@ -96,6 +98,44 @@ def _evaluate(model: GameModel, spec: ValidationSpec) -> dict[str, float]:
                 )
             )
     return out
+
+
+def _num_coefficients(model) -> int:
+    """Coefficient count of a coordinate model — shape metadata only, no
+    device transfer. Feeds the ``progress.coeffs`` counter the heartbeat
+    and run report turn into coeffs/s."""
+    if model is None:
+        return 0
+    coeffs = getattr(model, "coefficients", None)
+    if coeffs is not None:
+        return int(getattr(coeffs, "size", 0))
+    buckets = getattr(model, "buckets", None)
+    if buckets is not None:
+        return sum(_num_coefficients(b) for b in buckets)
+    models = getattr(model, "models", None)
+    if isinstance(models, Mapping):
+        return sum(_num_coefficients(m) for m in models.values())
+    return sum(
+        int(getattr(leaf, "size", 0)) for leaf in jax.tree.leaves(model)
+    )
+
+
+def _record_step_progress(coord, model, name: str, seconds: float) -> None:
+    """Publish per-step progress + memory telemetry: the rows/coeffs
+    counters (heartbeat rate sources), the rows/s / coeffs/s gauges (run
+    report key metrics), and the per-coordinate HBM phase peak."""
+    rows = int(getattr(getattr(coord, "data", None), "num_rows", 0) or 0)
+    coeffs = _num_coefficients(model)
+    if rows:
+        telemetry.counter("progress.rows").inc(rows)
+    if coeffs:
+        telemetry.counter("progress.coeffs").inc(coeffs)
+    if seconds > 0:
+        if rows:
+            telemetry.gauge("progress.rows_per_sec").set(rows / seconds)
+        if coeffs:
+            telemetry.gauge("progress.coeffs_per_sec").set(coeffs / seconds)
+    telemetry_memory.record_phase_memory(f"coordinate:{name}")
 
 
 def _guarded_update(coord, model, residual, guard: GuardSpec, name: str):
@@ -285,6 +325,9 @@ def run_coordinate_descent(
                             metrics, entry["seconds"],
                         )
                     sp.set_attr(seconds=round(entry["seconds"], 6))
+                    _record_step_progress(
+                        coord, models[name], name, entry["seconds"]
+                    )
                 history.append(entry)
                 if on_step is not None:
                     on_step(entry)

@@ -18,6 +18,8 @@ from typing import Mapping, Optional, Sequence
 
 from jax.sharding import Mesh
 
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import memory as telemetry_memory
 from photon_ml_tpu.data.normalization import (
     NormalizationContext,
     NormalizationType,
@@ -152,6 +154,32 @@ class GridFitEntry:
     result: GameFitResult
 
 
+def _record_table_estimate(name: str, red, dim=None) -> None:
+    """Publish the predicted HBM residency of one random-effect
+    coordinate's coefficient table (``memory.table_bytes.<name>`` gauge)
+    and pre-check headroom BEFORE the solve allocates it — the warning
+    lands in the log and run report instead of an XLA OOM mid-fit.
+
+    ``dim``: per-entity coefficient dim for projected/factored tables
+    (projected_dim / latent_dim); None = the index-map layout, whose table
+    is the per-bucket [entities, local_features] stacks."""
+    if dim is not None:
+        table_bytes = telemetry_memory.estimate_table_bytes(
+            red.num_entities, dim
+        )
+    else:
+        table_bytes = sum(
+            telemetry_memory.estimate_table_bytes(
+                b.num_entities, b.num_local_features
+            )
+            for b in red.buckets
+        )
+    telemetry.gauge(f"memory.table_bytes.{name}").set(table_bytes)
+    telemetry_memory.check_headroom(
+        table_bytes, label=f"coordinate:{name} coefficient table"
+    )
+
+
 class GameEstimator:
     """Builds datasets + coordinates from a GameConfig and trains via CD."""
 
@@ -263,6 +291,10 @@ class GameEstimator:
                 )
             elif isinstance(c, RandomEffectConfig):
                 red = self._re_dataset(data, c)
+                _record_table_estimate(
+                    name, red, dim=c.projected_dim
+                    if c.projector == "random" else None,
+                )
                 if c.projector == "random":
                     # fixed Gaussian projection: per-entity solves in the
                     # shared projected space (RandomEffectCoordinateIn
@@ -292,6 +324,7 @@ class GameEstimator:
                     )
             elif isinstance(c, FactoredRandomEffectConfig):
                 red = self._re_dataset(data, c)
+                _record_table_estimate(name, red, dim=c.latent_dim)
                 coords[name] = FactoredRandomEffectCoordinate(
                     name=name,
                     data=data,
@@ -355,7 +388,6 @@ class GameEstimator:
         (cli/game/training/Driver.scala:262-312): ``<output_dir>/final`` and
         ``<output_dir>/best`` model directories.
         """
-        from photon_ml_tpu import telemetry
         from photon_ml_tpu.game.checkpoint import CheckpointManager
         from photon_ml_tpu.utils.events import (
             OptimizationLogEvent,
@@ -374,6 +406,7 @@ class GameEstimator:
         ):
             with telemetry.span("build_coordinates"):
                 coordinates = self._build_coordinates(data, mesh)
+            telemetry_memory.record_phase_memory("build_coordinates")
             validation = None
             if validation_data is not None:
                 if not self.config.evaluators:
@@ -406,6 +439,7 @@ class GameEstimator:
                 ),
                 should_stop=should_stop,
             )
+            telemetry_memory.record_phase_memory("fit")
         self.events.send(
             TrainingFinishEvent(
                 best_metric=result.best_metric,
@@ -462,7 +496,6 @@ class GameEstimator:
             raise ValueError(f"grid names unknown coordinates: {sorted(unknown)}")
         import itertools
 
-        from photon_ml_tpu import telemetry
         from photon_ml_tpu.evaluation import better_than
         from photon_ml_tpu.utils.events import (
             OptimizationLogEvent,

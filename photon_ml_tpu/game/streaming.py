@@ -41,6 +41,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import memory as telemetry_memory
 from photon_ml_tpu.ops.dense import DenseBatch
 from photon_ml_tpu.ops.objective import make_objective
 from photon_ml_tpu.optim.factory import OptimizerConfig
@@ -315,7 +316,18 @@ class StreamingRandomEffectTrainer:
     def _feed(self, source) -> DenseBatch:
         """_prepare with bounded retry: transient host->device feed failures
         (generator I/O, tunnel hiccups) re-attempt up to ``feed_retries``
-        times before surfacing; programming errors raise immediately."""
+        times before surfacing; programming errors raise immediately.
+
+        Host-supplied chunks get a pre-upload HBM headroom check: the
+        chunk's leaf bytes are known before device_put, so a chunk
+        predicted to exceed free HBM warns (log + counter) instead of
+        OOMing the run (no-op on statless backends)."""
+        if not callable(source):
+            predicted = telemetry_memory.estimate_batch_bytes(source)
+            if predicted:
+                telemetry_memory.check_headroom(
+                    predicted, label="streaming chunk upload"
+                )
         last_err: Optional[Exception] = None
         for attempt in range(self._feed_retries + 1):
             if attempt:
@@ -398,6 +410,13 @@ class StreamingRandomEffectTrainer:
                 table.write_chunk(start, res.w)
         telemetry.counter("streaming_chunks").inc()
         telemetry.counter("streaming_entities").inc(int(size))
+        # heartbeat rate sources: streamed example-rows and the chunk's
+        # slice of the coefficient table count as processed work
+        telemetry.counter("progress.rows").inc(
+            int(np.prod(batch.labels.shape))
+        )
+        telemetry.counter("progress.coeffs").inc(int(size) * table.dim)
+        telemetry_memory.record_phase_memory("streaming_chunk")
         if var is not None and not rolled_back:
             if variance_table is None:
                 raise ValueError(

@@ -37,6 +37,12 @@ def make_mesh(
             f"mesh {dict(axis_sizes)} needs {total} devices, have {len(devices)}"
         )
     arr = np.asarray(devices).reshape(sizes)
+    # baseline per-device HBM gauges at mesh build (no-op on statless
+    # backends): the run report's memory section starts from what the
+    # fleet already held before training allocated anything
+    from photon_ml_tpu.telemetry import memory as telemetry_memory
+
+    telemetry_memory.record_device_memory(devices)
     return Mesh(arr, names)
 
 

@@ -1,7 +1,7 @@
-"""Structured telemetry: tracing spans, a metrics registry, and
-device/transfer accounting for the whole training stack.
+"""Structured telemetry: tracing spans, a metrics registry, device/memory
+accounting, live progress heartbeats, and run reports.
 
-Three layers (ISSUE: you can't optimize what you can't measure):
+Five layers (ISSUE 1 gave emission; ISSUE 3 the interpretation):
 
 - :mod:`photon_ml_tpu.telemetry.trace` — ``span(name, **attrs)`` opens a
   node of a thread-safe hierarchical span tree with a JSONL sink and a
@@ -13,15 +13,23 @@ Three layers (ISSUE: you can't optimize what you can't measure):
 - :mod:`photon_ml_tpu.telemetry.device` — ``sync_fetch()``, the one
   sanctioned device->host fetch point (fetches / bytes / blocking
   seconds), plus per-compile counters via ``jax.monitoring``.
+- :mod:`photon_ml_tpu.telemetry.memory` — HBM accounting over
+  ``device.memory_stats()``: per-phase peak gauges, table-size estimates,
+  and a headroom check that warns BEFORE a predicted allocation OOMs.
+- :mod:`photon_ml_tpu.telemetry.progress` / ``.report`` — a heartbeat
+  daemon that keeps long fits audible, and :class:`RunReport`, which
+  merges trace + metrics + checkpoint manifests into one markdown/JSON
+  report with a regression ``compare()`` (the ``cli report`` perf gate).
 
 Typical use::
 
     from photon_ml_tpu import telemetry
 
     telemetry.configure(trace_out="run.trace.jsonl")
-    with telemetry.span("fit", task="logistic"):
-        ...
-        value = float(telemetry.sync_fetch(result.value, label="loss"))
+    with telemetry.Heartbeat(interval=30, jsonl_path="run.metrics.jsonl"):
+        with telemetry.span("fit", task="logistic"):
+            ...
+            value = float(telemetry.sync_fetch(result.value, label="loss"))
     telemetry.flush_metrics("run.metrics.jsonl")
     telemetry.export_chrome_trace("run.trace.jsonl", "run.perfetto.json")
 
@@ -35,7 +43,7 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from photon_ml_tpu.telemetry import metrics, trace  # noqa: F401
+from photon_ml_tpu.telemetry import memory, metrics, trace  # noqa: F401
 from photon_ml_tpu.telemetry.device import (  # noqa: F401
     install_compile_hooks,
     sync_fetch,
@@ -47,7 +55,9 @@ from photon_ml_tpu.telemetry.metrics import (  # noqa: F401
     snapshot,
 )
 from photon_ml_tpu.telemetry.metrics import flush_jsonl as flush_metrics  # noqa: F401
+from photon_ml_tpu.telemetry.progress import Heartbeat  # noqa: F401
 from photon_ml_tpu.telemetry.trace import (  # noqa: F401
+    active_span_path,
     add_event,
     current_span,
     export_chrome_trace,
@@ -61,6 +71,7 @@ __all__ = [
     "span",
     "current_span",
     "add_event",
+    "active_span_path",
     "finished_spans",
     "counter",
     "gauge",
@@ -72,10 +83,17 @@ __all__ = [
     "to_chrome_trace",
     "export_chrome_trace",
     "perfetto_path",
+    "Heartbeat",
+    "memory",
     "configure",
     "configure_from_env",
     "reset",
 ]
+
+# configure_from_env side effects, remembered so reset() can undo them —
+# without this, test ordering decides whether a leaked atexit flush or
+# env-pointed sink survives into later tests (ISSUE 3 satellite).
+_env_state: dict[str, object] = {"atexit_flush": None}
 
 
 def configure(
@@ -89,21 +107,38 @@ def configure(
 def configure_from_env() -> None:
     """Honor ``PHOTON_TRACE_OUT`` / ``PHOTON_TELEMETRY_OUT`` env vars: the
     span sink opens immediately; the metrics snapshot flushes at process
-    exit. Lets benchmarks and ad-hoc scripts opt in without new flags."""
+    exit. Lets benchmarks and ad-hoc scripts opt in without new flags.
+    ``reset()`` fully undoes both (including the atexit hook)."""
     trace_out = os.environ.get("PHOTON_TRACE_OUT")
     if trace_out:
         configure(trace_out=trace_out)
     metrics_out = os.environ.get("PHOTON_TELEMETRY_OUT")
     if metrics_out:
         import atexit
+        import functools
 
-        atexit.register(flush_metrics, metrics_out)
+        old = _env_state["atexit_flush"]
+        if old is not None:
+            atexit.unregister(old)
+        flush = functools.partial(flush_metrics, metrics_out)
+        atexit.register(flush)
+        _env_state["atexit_flush"] = flush
 
 
 def reset() -> None:
-    """Clear spans and metrics and close the trace sink (test isolation)."""
+    """Restore telemetry to import-time defaults (test isolation): clear
+    spans and metrics, close the trace sink, restore the default buffer
+    limit, drop any injected memory-stats provider, and unregister the
+    ``configure_from_env`` atexit flush."""
     trace.reset()
     metrics.reset()
+    memory.reset()
+    flush = _env_state["atexit_flush"]
+    if flush is not None:
+        import atexit
+
+        atexit.unregister(flush)
+        _env_state["atexit_flush"] = None
 
 
 install_compile_hooks()

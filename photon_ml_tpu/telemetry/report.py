@@ -1,0 +1,673 @@
+"""Run reports: turn telemetry exhaust into answers.
+
+PR 1 gave the system raw emission (span JSONL, metrics snapshots, device
+accounting) and PR 2 durable state (checkpoint manifests) — but reading a
+run still meant loading a trace into Perfetto by hand. :class:`RunReport`
+merges the three exhaust streams into one document:
+
+- **phase-time breakdown**: the aggregated ``fit > cd_iteration >
+  coordinate:<name>`` span tree with per-phase count/total/self time;
+- **top-k costs** and **fetch/recompile accounting** (the tunnel tax and
+  silent-recompile counters, summarized instead of eyeballed);
+- **per-coordinate convergence and guard history** from the newest
+  checkpoint manifest (retries, rollbacks, frozen coordinates, metrics);
+- **heartbeat liveness** (count + last line) from the progress sink;
+- ``key_metrics()`` — the scalar summary a CI perf gate compares runs by.
+
+``compare(baseline)`` flags key-metric regressions beyond a threshold;
+``python -m photon_ml_tpu.cli report --compare baseline.json
+--fail-on-regress`` exits nonzero on any, so every future perf PR is
+measurable against the last good run.
+
+This module only READS artifacts (plus the live in-process registry via
+:meth:`RunReport.from_live`); it never touches a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import os
+import re
+from typing import Any, Mapping, Optional, Sequence
+
+__all__ = [
+    "RunReport",
+    "MetricDelta",
+    "PhaseNode",
+    "compare_metrics",
+    "KEY_METRIC_DIRECTIONS",
+    "REPORT_FORMAT_VERSION",
+    "report_path",
+]
+
+REPORT_FORMAT_VERSION = 1
+
+#: Key metrics and their goodness direction: +1 higher-is-better,
+#: -1 lower-is-better. Only metrics named here participate in compare().
+KEY_METRIC_DIRECTIONS: dict[str, int] = {
+    "rows_per_sec": +1,
+    "coeffs_per_sec": +1,
+    "fit_seconds": -1,
+    "jit_compiles": -1,
+    "jit_compile_seconds": -1,
+    "device_fetches": -1,
+    "device_fetch_seconds": -1,
+    "dropped_spans": -1,
+}
+
+_STEP_MANIFEST_RE = re.compile(r"^step-(\d{8})$")
+
+
+@dataclasses.dataclass
+class MetricDelta:
+    """One key metric compared against a baseline value."""
+
+    metric: str
+    current: float
+    baseline: float
+    change: float  # signed fraction: (current - baseline) / baseline
+    regressed: bool
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def compare_metrics(
+    current: Mapping[str, float],
+    baseline: Mapping[str, float],
+    threshold: float = 0.2,
+    directions: Optional[Mapping[str, int]] = None,
+) -> list[MetricDelta]:
+    """Compare two key-metric dicts; a metric is *regressed* when it moved
+    against its goodness direction by more than ``threshold`` (fractional,
+    default 20%). Metrics missing from either side, or with a zero
+    baseline (no ratio exists), are skipped. Shared by the run-report
+    compare and the bench_suite ``--gate``."""
+    directions = KEY_METRIC_DIRECTIONS if directions is None else directions
+    out: list[MetricDelta] = []
+    for name in sorted(set(current) & set(baseline)):
+        direction = directions.get(name)
+        if direction is None:
+            continue
+        cur, base = float(current[name]), float(baseline[name])
+        if base == 0:
+            continue
+        change = (cur - base) / abs(base)
+        regressed = (direction > 0 and change < -threshold) or (
+            direction < 0 and change > threshold
+        )
+        out.append(
+            MetricDelta(
+                metric=name,
+                current=cur,
+                baseline=base,
+                change=change,
+                regressed=regressed,
+            )
+        )
+    return out
+
+
+@dataclasses.dataclass
+class PhaseNode:
+    """One aggregated node of the phase-time tree (all spans sharing the
+    same name-path merged: count, total wall time, and self time)."""
+
+    name: str
+    count: int = 0
+    total_s: float = 0.0
+    children: dict[str, "PhaseNode"] = dataclasses.field(default_factory=dict)
+
+    @property
+    def self_s(self) -> float:
+        return max(
+            self.total_s - sum(c.total_s for c in self.children.values()), 0.0
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "count": self.count,
+            "total_s": round(self.total_s, 6),
+            "self_s": round(self.self_s, 6),
+            "children": [
+                c.to_dict()
+                for c in sorted(
+                    self.children.values(), key=lambda c: -c.total_s
+                )
+            ],
+        }
+
+
+def build_phase_tree(spans: Sequence[Mapping[str, Any]]) -> PhaseNode:
+    """Aggregate span records (``Span.to_dict()`` / trace JSONL lines)
+    into a name-path tree under a synthetic root. Spans whose parents fell
+    out of a bounded buffer root at their earliest surviving ancestor."""
+    by_id = {s.get("id"): s for s in spans if s.get("id") is not None}
+    root = PhaseNode(name="")
+    for s in spans:
+        names: list[str] = []
+        cur: Optional[Mapping[str, Any]] = s
+        seen: set[Any] = set()
+        while cur is not None and cur.get("id") not in seen:
+            seen.add(cur.get("id"))
+            names.append(str(cur.get("name", "?")))
+            parent = cur.get("parent")
+            cur = by_id.get(parent) if parent is not None else None
+        node = root
+        for name in reversed(names):
+            node = node.children.setdefault(name, PhaseNode(name=name))
+        node.count += 1
+        node.total_s += float(s.get("dur") or 0.0)
+    return root
+
+
+def report_path(trace_out: str) -> str:
+    """Sibling ``.report.md`` path for a trace/telemetry JSONL path."""
+    base = trace_out[:-6] if trace_out.endswith(".jsonl") else trace_out
+    return base + ".report.md"
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    records = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a crashed run leaves a truncated last line
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def _load_checkpoint_manifests(directory: str) -> list[dict]:
+    """Every readable ``step-*/manifest.json`` under ``directory``, oldest
+    first. Reads only — no dependency on the checkpoint module (reports
+    must load anywhere, including hosts without the training stack)."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not _STEP_MANIFEST_RE.match(name):
+            continue
+        path = os.path.join(directory, name, "manifest.json")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError):
+            continue  # partial/corrupt checkpoints are the restore path's job
+        if isinstance(manifest, dict):
+            out.append(manifest)
+    return out
+
+
+@dataclasses.dataclass
+class RunReport:
+    """One run's merged telemetry: spans + metrics snapshot + heartbeats +
+    checkpoint manifests, with markdown/JSON rendering and compare()."""
+
+    spans: list[dict] = dataclasses.field(default_factory=list)
+    snapshot: dict = dataclasses.field(default_factory=dict)
+    heartbeats: list[dict] = dataclasses.field(default_factory=list)
+    manifests: list[dict] = dataclasses.field(default_factory=list)
+    sources: dict = dataclasses.field(default_factory=dict)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def load(
+        cls,
+        trace: Optional[str] = None,
+        telemetry: Optional[str] = None,
+        checkpoint_dir: Optional[str] = None,
+    ) -> "RunReport":
+        """Build from on-disk artifacts: a span JSONL (``--trace-out``), a
+        telemetry JSONL (``--telemetry-out``; its last ``metrics`` line is
+        the snapshot, its ``heartbeat`` lines the liveness record), and a
+        checkpoint directory's manifests."""
+        spans: list[dict] = []
+        snapshot: dict = {}
+        heartbeats: list[dict] = []
+        manifests: list[dict] = []
+        if trace:
+            spans = [
+                r for r in _read_jsonl(trace) if r.get("type") == "span"
+            ]
+        if telemetry:
+            for rec in _read_jsonl(telemetry):
+                if rec.get("type") == "metrics":
+                    snapshot = rec.get("snapshot") or {}
+                elif rec.get("type") == "heartbeat":
+                    heartbeats.append(rec)
+        if checkpoint_dir:
+            manifests = _load_checkpoint_manifests(checkpoint_dir)
+        return cls(
+            spans=spans,
+            snapshot=snapshot,
+            heartbeats=heartbeats,
+            manifests=manifests,
+            sources={
+                "trace": trace,
+                "telemetry": telemetry,
+                "checkpoint_dir": checkpoint_dir,
+            },
+        )
+
+    @classmethod
+    def from_live(
+        cls, checkpoint_dir: Optional[str] = None
+    ) -> "RunReport":
+        """Build from THIS process's live registries (the train driver's
+        ``--report-out`` path needs no re-parse of its own sinks)."""
+        from photon_ml_tpu.telemetry import metrics, trace
+
+        return cls(
+            spans=[s.to_dict() for s in trace.finished_spans()],
+            snapshot=metrics.snapshot(),
+            manifests=(
+                _load_checkpoint_manifests(checkpoint_dir)
+                if checkpoint_dir
+                else []
+            ),
+            sources={"live": True, "checkpoint_dir": checkpoint_dir},
+        )
+
+    # -- derived views -------------------------------------------------------
+
+    def phase_tree(self) -> PhaseNode:
+        return build_phase_tree(self.spans)
+
+    def top_spans(self, k: int = 10) -> list[dict]:
+        """Top-k span NAMES by total wall time (count + total), the
+        flame-chart hotspots without opening Perfetto."""
+        agg: dict[str, list[float]] = {}
+        for s in self.spans:
+            entry = agg.setdefault(str(s.get("name", "?")), [0, 0.0])
+            entry[0] += 1
+            entry[1] += float(s.get("dur") or 0.0)
+        ranked = sorted(agg.items(), key=lambda kv: -kv[1][1])[:k]
+        return [
+            {"name": name, "count": int(c), "total_s": round(t, 6)}
+            for name, (c, t) in ranked
+        ]
+
+    def key_metrics(self) -> dict[str, float]:
+        """The scalar summary compare() gates on."""
+        counters = self.snapshot.get("counters", {})
+        gauges = self.snapshot.get("gauges", {})
+        out: dict[str, float] = {}
+        # OUTERMOST fit spans only: the train driver's timed("fit") phase
+        # wraps the estimator's own fit span — summing both would double
+        # the wall time
+        by_id = {
+            s.get("id"): s for s in self.spans if s.get("id") is not None
+        }
+
+        def _has_fit_ancestor(s) -> bool:
+            seen: set[Any] = set()
+            parent = s.get("parent")
+            while parent is not None and parent not in seen:
+                seen.add(parent)
+                p = by_id.get(parent)
+                if p is None:
+                    return False
+                if p.get("name") == "fit":
+                    return True
+                parent = p.get("parent")
+            return False
+
+        fit_s = sum(
+            float(s.get("dur") or 0.0)
+            for s in self.spans
+            if s.get("name") == "fit" and not _has_fit_ancestor(s)
+        )
+        if fit_s:
+            out["fit_seconds"] = round(fit_s, 6)
+        for key, gauge_name in (
+            ("rows_per_sec", "progress.rows_per_sec"),
+            ("coeffs_per_sec", "progress.coeffs_per_sec"),
+        ):
+            value = gauges.get(gauge_name)
+            if value is not None:
+                out[key] = float(value)
+        for name in (
+            "jit_compiles",
+            "jit_compile_seconds",
+            "device_fetches",
+            "device_fetch_seconds",
+        ):
+            if name in counters:
+                out[name] = float(counters[name])
+        dropped = counters.get("trace.dropped_spans")
+        if dropped:
+            out["dropped_spans"] = float(dropped)
+        return out
+
+    def coordinate_summary(self) -> list[dict]:
+        """Per-coordinate convergence + guard history from the NEWEST
+        checkpoint manifest (steps, seconds, retries, rollbacks, frozen
+        status, last validation metrics)."""
+        if not self.manifests:
+            return []
+        manifest = self.manifests[-1]
+        frozen = set(manifest.get("frozen") or ())
+        rollback_counts = manifest.get("consecutive_rollbacks") or {}
+        agg: dict[str, dict[str, Any]] = {}
+        for entry in manifest.get("history") or ():
+            name = entry.get("coordinate")
+            if name is None:
+                continue
+            c = agg.setdefault(
+                name,
+                {
+                    "coordinate": name,
+                    "steps": 0,
+                    "seconds": 0.0,
+                    "solve_retries": 0,
+                    "rollbacks": 0,
+                    "last_metrics": None,
+                },
+            )
+            c["steps"] += 1
+            c["seconds"] += float(entry.get("seconds") or 0.0)
+            c["solve_retries"] += int(entry.get("solve_retries") or 0)
+            c["rollbacks"] += 1 if entry.get("rolled_back") else 0
+            if entry.get("metrics") is not None:
+                c["last_metrics"] = entry["metrics"]
+        for name, c in agg.items():
+            c["seconds"] = round(c["seconds"], 6)
+            c["frozen"] = name in frozen
+            c["consecutive_rollbacks"] = int(rollback_counts.get(name, 0))
+        return sorted(agg.values(), key=lambda c: c["coordinate"])
+
+    # -- compare -------------------------------------------------------------
+
+    def compare(
+        self,
+        baseline: Mapping[str, Any],
+        threshold: float = 0.2,
+    ) -> list[MetricDelta]:
+        """Compare against a baseline: either a full report JSON document
+        (``to_json()`` output — its ``key_metrics`` field is used) or a
+        bare ``{metric: value}`` dict."""
+        base = baseline.get("key_metrics", baseline)
+        return compare_metrics(
+            self.key_metrics(), base, threshold=threshold
+        )
+
+    # -- rendering -----------------------------------------------------------
+
+    def to_json(self) -> dict[str, Any]:
+        counters = self.snapshot.get("counters", {})
+        doc: dict[str, Any] = {
+            "type": "run_report",
+            "format_version": REPORT_FORMAT_VERSION,
+            "generated": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(),
+            "sources": self.sources,
+            "key_metrics": self.key_metrics(),
+            "phases": self.phase_tree().to_dict()["children"],
+            "top_spans": self.top_spans(),
+            "coordinates": self.coordinate_summary(),
+            "counters": counters,
+            "gauges": self.snapshot.get("gauges", {}),
+            "histograms": self.snapshot.get("histograms", {}),
+            "heartbeats": {
+                "count": len(self.heartbeats),
+                "last": self.heartbeats[-1] if self.heartbeats else None,
+            },
+        }
+        if self.manifests:
+            doc["checkpoint"] = {
+                "steps": [int(m.get("step", -1)) for m in self.manifests],
+                "last_step": int(self.manifests[-1].get("step", -1)),
+                "best_metric": self.manifests[-1].get("best_metric"),
+            }
+        return doc
+
+    def save_json(self, path: str) -> dict[str, Any]:
+        from photon_ml_tpu.utils.atomic import atomic_write_json
+
+        doc = self.to_json()
+        atomic_write_json(path, doc, indent=2, sort_keys=True, default=str)
+        return doc
+
+    def to_markdown(
+        self, deltas: Optional[Sequence[MetricDelta]] = None
+    ) -> str:
+        lines: list[str] = ["# Run report", ""]
+        src = ", ".join(
+            f"{k}=`{v}`" for k, v in self.sources.items() if v
+        )
+        if src:
+            lines += [f"_Sources: {src}_", ""]
+
+        metrics_now = self.key_metrics()
+        if metrics_now:
+            lines += ["## Key metrics", "", "| metric | value |", "|---|---|"]
+            for name, value in sorted(metrics_now.items()):
+                lines.append(f"| `{name}` | {_fmt(value)} |")
+            lines.append("")
+
+        tree = self.phase_tree()
+        if tree.children:
+            run_total = sum(c.total_s for c in tree.children.values())
+            lines += ["## Phase time breakdown", ""]
+            _render_tree(tree, 0, run_total, lines)
+            lines.append("")
+
+        top = self.top_spans()
+        if top:
+            lines += [
+                "## Top spans by total time",
+                "",
+                "| span | count | total s |",
+                "|---|---|---|",
+            ]
+            for t in top:
+                lines.append(
+                    f"| `{t['name']}` | {t['count']} | {t['total_s']:.3f} |"
+                )
+            lines.append("")
+
+        lines += self._accounting_markdown()
+        lines += self._memory_markdown()
+        lines += self._coordinates_markdown()
+        lines += self._heartbeat_markdown()
+
+        dropped = self.snapshot.get("counters", {}).get("trace.dropped_spans")
+        if dropped:
+            lines += [
+                f"> **Warning**: {int(dropped)} span(s) were dropped from "
+                "the bounded trace buffer — phase totals undercount; raise "
+                "`telemetry.configure(buffer_limit=...)`.",
+                "",
+            ]
+
+        if deltas is not None:
+            lines += _compare_markdown(deltas)
+        return "\n".join(lines).rstrip() + "\n"
+
+    def _accounting_markdown(self) -> list[str]:
+        c = self.snapshot.get("counters", {})
+        h = self.snapshot.get("histograms", {})
+        rows = []
+        for name in (
+            "device_fetches",
+            "device_fetch_bytes",
+            "device_fetch_seconds",
+            "jit_compiles",
+            "jit_compile_seconds",
+        ):
+            if name in c:
+                extra = ""
+                hist = h.get(name) if name.endswith("seconds") else None
+                if hist and hist.get("count"):
+                    extra = (
+                        f"p50 {_fmt(hist.get('p50'))}, "
+                        f"p95 {_fmt(hist.get('p95'))}"
+                    )
+                rows.append((name, c[name], extra))
+        if not rows:
+            return []
+        out = [
+            "## Fetch / compile accounting",
+            "",
+            "| counter | total | distribution |",
+            "|---|---|---|",
+        ]
+        for name, value, extra in rows:
+            out.append(f"| `{name}` | {_fmt(value)} | {extra} |")
+        out.append("")
+        return out
+
+    def _memory_markdown(self) -> list[str]:
+        g = self.snapshot.get("gauges", {})
+        phase_peaks = {
+            name[len("memory.phase."):-len(".peak_bytes")]: value
+            for name, value in g.items()
+            if name.startswith("memory.phase.")
+            and name.endswith(".peak_bytes")
+            and value is not None
+        }
+        headroom = self.snapshot.get("counters", {}).get(
+            "memory.headroom_warnings"
+        )
+        if not phase_peaks and not headroom and "memory.bytes_in_use" not in g:
+            return []
+        out = ["## HBM / memory", ""]
+        if "memory.bytes_in_use" in g:
+            out.append(
+                f"- in use: {_fmt_bytes(g['memory.bytes_in_use'])}"
+                + (
+                    f" of {_fmt_bytes(g['memory.bytes_limit'])}"
+                    if g.get("memory.bytes_limit") is not None
+                    else ""
+                )
+            )
+        if headroom:
+            out.append(
+                f"- **{int(headroom)} headroom warning(s)** — predicted "
+                "allocations exceeded free HBM (`memory.headroom_warnings`)"
+            )
+        if phase_peaks:
+            out += ["", "| phase | peak bytes |", "|---|---|"]
+            for phase, value in sorted(
+                phase_peaks.items(), key=lambda kv: -(kv[1] or 0)
+            ):
+                out.append(f"| `{phase}` | {_fmt_bytes(value)} |")
+        out.append("")
+        return out
+
+    def _coordinates_markdown(self) -> list[str]:
+        coords = self.coordinate_summary()
+        if not coords:
+            return []
+        out = [
+            "## Coordinates (from newest checkpoint)",
+            "",
+            "| coordinate | steps | seconds | retries | rollbacks "
+            "| frozen | last metrics |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for c in coords:
+            metrics_str = (
+                json.dumps(c["last_metrics"], default=str)
+                if c["last_metrics"]
+                else ""
+            )
+            out.append(
+                f"| `{c['coordinate']}` | {c['steps']} | "
+                f"{c['seconds']:.3f} | {c['solve_retries']} | "
+                f"{c['rollbacks']} | {'yes' if c['frozen'] else ''} | "
+                f"{metrics_str} |"
+            )
+        out.append("")
+        return out
+
+    def _heartbeat_markdown(self) -> list[str]:
+        if not self.heartbeats:
+            return []
+        last = self.heartbeats[-1]
+        return [
+            "## Heartbeats",
+            "",
+            f"- {len(self.heartbeats)} beat(s); last at uptime "
+            f"{last.get('uptime_s', '?')}s in span "
+            f"`{last.get('span') or '(idle)'}` — "
+            f"{_fmt(last.get('rows_per_s'))} rows/s, "
+            f"{_fmt(last.get('coeffs_per_s'))} coeffs/s",
+            "",
+        ]
+
+
+def _render_tree(
+    node: PhaseNode, depth: int, run_total: float, lines: list[str]
+) -> None:
+    for child in sorted(node.children.values(), key=lambda c: -c.total_s):
+        pct = 100.0 * child.total_s / run_total if run_total else 0.0
+        lines.append(
+            f"{'  ' * depth}- `{child.name}` — n={child.count}, "
+            f"total {child.total_s:.3f}s, self {child.self_s:.3f}s "
+            f"({pct:.1f}%)"
+        )
+        _render_tree(child, depth + 1, run_total, lines)
+
+
+def _compare_markdown(deltas: Sequence[MetricDelta]) -> list[str]:
+    out = [
+        "## Comparison vs baseline",
+        "",
+        "| metric | current | baseline | change | status |",
+        "|---|---|---|---|---|",
+    ]
+    for d in deltas:
+        status = "**REGRESSED**" if d.regressed else "ok"
+        out.append(
+            f"| `{d.metric}` | {_fmt(d.current)} | {_fmt(d.baseline)} | "
+            f"{d.change:+.1%} | {status} |"
+        )
+    regressed = [d.metric for d in deltas if d.regressed]
+    out.append("")
+    if regressed:
+        out.append(
+            f"**{len(regressed)} regression(s)**: "
+            + ", ".join(f"`{m}`" for m in regressed)
+        )
+    else:
+        out.append("No regressions beyond threshold.")
+    out.append("")
+    return out
+
+
+def _fmt(value: Any) -> str:
+    if value is None:
+        return ""
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return str(value)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return f"{f:.4g}"
+
+
+def _fmt_bytes(value: Any) -> str:
+    try:
+        b = float(value)
+    except (TypeError, ValueError):
+        return str(value)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(b) < 1024 or unit == "TiB":
+            return f"{b:.1f} {unit}" if unit != "B" else f"{int(b)} B"
+        b /= 1024
+    return f"{b:.1f} TiB"

@@ -43,6 +43,7 @@ __all__ = [
     "span",
     "current_span",
     "add_event",
+    "active_span_path",
     "configure",
     "reset",
     "finished_spans",
@@ -50,6 +51,8 @@ __all__ = [
     "export_chrome_trace",
     "perfetto_path",
 ]
+
+DEFAULT_BUFFER_LIMIT = 50_000
 
 
 class Span:
@@ -108,7 +111,7 @@ class Tracer:
     are stringified.
     """
 
-    def __init__(self, buffer_limit: int = 50_000):
+    def __init__(self, buffer_limit: int = DEFAULT_BUFFER_LIMIT):
         self._local = threading.local()
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
@@ -117,7 +120,9 @@ class Tracer:
         # every thread's open-span stack, so reset() can clear them ALL
         # (threading.local is only visible from its own thread)
         self._all_stacks: list[list[Span]] = []
+        self._default_buffer_limit = buffer_limit
         self._buffer_limit = buffer_limit
+        self.dropped_spans = 0
         self._sink_path: Optional[str] = None
         self._sink_fh = None
         self._wall_anchor: Optional[str] = None
@@ -161,14 +166,17 @@ class Tracer:
         self._sink_path = None
 
     def reset(self) -> None:
-        """Drop all finished spans, close the sink, and clear EVERY
-        thread's open-span stack (test isolation; a span left open on a
-        worker thread must not parent post-reset spans)."""
+        """Drop all finished spans, close the sink, clear EVERY thread's
+        open-span stack (test isolation; a span left open on a worker
+        thread must not parent post-reset spans), and restore the
+        constructor-default buffer limit and drop accounting."""
         with self._lock:
             self._finished.clear()
             self._close_sink_locked()
             for stack in self._all_stacks:
                 stack.clear()
+            self._buffer_limit = self._default_buffer_limit
+            self.dropped_spans = 0
 
     # -- span lifecycle ------------------------------------------------------
 
@@ -183,6 +191,24 @@ class Tracer:
     def current(self) -> Optional[Span]:
         stack = self._stack()
         return stack[-1] if stack else None
+
+    def open_spans(self) -> list[Span]:
+        """The deepest currently-open span path ACROSS threads, outermost
+        first — the stack whose innermost span started most recently wins.
+        Safe to call from a monitor thread (the heartbeat): stacks are
+        copied under the GIL; a span closing mid-copy at worst drops one
+        path element."""
+        with self._lock:
+            stacks = [list(s) for s in self._all_stacks]
+        stacks = [s for s in stacks if s]
+        if not stacks:
+            return []
+        return max(stacks, key=lambda s: s[-1].ts)
+
+    def active_span_path(self, sep: str = " > ") -> str:
+        """``"fit > cd_iteration > coordinate:fixed"`` for the deepest
+        open span path, or ``""`` when nothing is open."""
+        return sep.join(s.name for s in self.open_spans())
 
     def now(self) -> float:
         """Seconds on the tracer's monotonic timebase."""
@@ -220,10 +246,13 @@ class Tracer:
             cur.add_event(name, ts=self.now(), **attrs)
 
     def _finish(self, s: Span) -> None:
+        dropped = 0
         with self._lock:
             self._finished.append(s)
             if len(self._finished) > self._buffer_limit:
-                del self._finished[: len(self._finished) - self._buffer_limit]
+                dropped = len(self._finished) - self._buffer_limit
+                del self._finished[:dropped]
+                self.dropped_spans += dropped
             if self._sink_fh is not None:
                 try:
                     self._sink_fh.write(
@@ -232,6 +261,13 @@ class Tracer:
                     self._sink_fh.flush()
                 except (OSError, ValueError):
                     self._close_sink_locked()  # never fail training
+        if dropped:
+            # buffer overflow was silent data loss — surface it in the
+            # metrics snapshot and the run report (local import: metrics
+            # must stay importable without trace)
+            from photon_ml_tpu.telemetry import metrics
+
+            metrics.counter("trace.dropped_spans").inc(dropped)
 
     # -- inspection ----------------------------------------------------------
 
@@ -249,6 +285,7 @@ TRACER = Tracer()
 span = TRACER.span
 current_span = TRACER.current
 add_event = TRACER.add_event
+active_span_path = TRACER.active_span_path
 configure = TRACER.configure
 reset = TRACER.reset
 finished_spans = TRACER.finished_spans

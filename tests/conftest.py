@@ -57,3 +57,15 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    """Telemetry is process-global (spans, counters, sinks, env-configured
+    atexit flushes); without a guard, test ORDER decides whether one
+    test's sink or stats provider leaks into the next. Reset after every
+    test — telemetry.reset() restores full import-time defaults."""
+    yield
+    from photon_ml_tpu import telemetry
+
+    telemetry.reset()

@@ -1,0 +1,576 @@
+"""ISSUE 3 (observability interpretation layer): HBM accounting, the
+progress heartbeat, run reports, the `cli report` perf gate, and the
+end-to-end acceptance path (fit -> report -> compare)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import memory
+from photon_ml_tpu.telemetry.progress import Heartbeat
+from photon_ml_tpu.telemetry.report import (
+    MetricDelta,
+    RunReport,
+    build_phase_tree,
+    compare_metrics,
+    report_path,
+)
+
+
+@pytest.fixture
+def fake_hbm():
+    """Deterministic 16 GB device with 10 GB in use (CPU has no stats)."""
+    memory.set_stats_provider(
+        lambda: {"bytes_in_use": 10 * 2**30, "bytes_limit": 16 * 2**30}
+    )
+    yield
+    memory.set_stats_provider(None)
+
+
+# -- memory accounting --------------------------------------------------------
+
+
+def test_hbm_stats_none_on_statless_backend():
+    # the CPU test mesh publishes no memory stats: probes return None and
+    # the headroom check reports "unknown", never a false warning
+    assert memory.hbm_stats() is None
+    assert memory.check_headroom(2**40, label="huge") is None
+    assert memory.record_phase_memory("fit") is None
+    assert (
+        "memory.headroom_warnings"
+        not in telemetry.snapshot()["counters"]
+    )
+
+
+def test_check_headroom_warns_before_predicted_oom(fake_hbm, caplog):
+    import logging
+
+    # 16*0.92 - 10 = ~4.7 GB free
+    assert memory.check_headroom(2**30, label="small") is True
+    with caplog.at_level(
+        logging.WARNING, logger="photon_ml_tpu.telemetry.memory"
+    ):
+        assert memory.check_headroom(8 * 2**30, label="re chunk") is False
+    assert any("re chunk" in r.message for r in caplog.records)
+    snap = telemetry.snapshot()
+    assert snap["counters"]["memory.headroom_warnings"] == 1
+    assert snap["gauges"]["memory.free_bytes"] > 0
+
+
+def test_record_phase_memory_tracks_peaks(fake_hbm):
+    in_use = memory.record_phase_memory("coordinate:fixed")
+    assert in_use == 10 * 2**30
+    memory.set_stats_provider(
+        lambda: {"bytes_in_use": 12 * 2**30, "bytes_limit": 16 * 2**30}
+    )
+    memory.record_phase_memory("coordinate:fixed")
+    memory.set_stats_provider(
+        lambda: {"bytes_in_use": 6 * 2**30, "bytes_limit": 16 * 2**30}
+    )
+    memory.record_phase_memory("coordinate:fixed")
+    g = telemetry.snapshot()["gauges"]
+    # last sample wins the in_use gauge; the peak holds the max
+    assert g["memory.phase.coordinate:fixed.bytes_in_use"] == 6 * 2**30
+    assert g["memory.phase.coordinate:fixed.peak_bytes"] == 12 * 2**30
+
+
+def test_estimate_table_and_batch_bytes():
+    assert memory.estimate_table_bytes(1000, 50) == 1000 * 50 * 4
+    assert memory.estimate_table_bytes(10, 3, itemsize=8) == 240
+    from photon_ml_tpu.ops.dense import DenseBatch
+
+    b = DenseBatch(
+        x=np.zeros((4, 3), np.float32),
+        labels=np.zeros(4, np.float32),
+        offsets=np.zeros(4, np.float32),
+        weights=np.zeros(4, np.float32),
+    )
+    assert memory.estimate_batch_bytes(b) == (4 * 3 + 3 * 4) * 4
+
+
+# -- heartbeat ----------------------------------------------------------------
+
+
+def test_heartbeat_beat_contents(fake_hbm, tmp_path):
+    out = tmp_path / "hb.jsonl"
+    hb = Heartbeat(interval=60, jsonl_path=str(out))
+    telemetry.counter("progress.rows").inc(5000)
+    telemetry.counter("progress.coeffs").inc(300)
+    telemetry.gauge("checkpoint.last_save_ts").set(
+        telemetry.trace.TRACER.now()
+    )
+    telemetry.gauge("checkpoint.last_step").set(7)
+    with telemetry.span("fit"):
+        with telemetry.span("coordinate:perUser"):
+            line = hb.beat()
+    assert line["type"] == "heartbeat"
+    assert line["span"] == "fit > coordinate:perUser"
+    assert line["rows_per_s"] > 0 and line["coeffs_per_s"] > 0
+    assert line["rows_total"] == 5000
+    assert line["hbm_bytes_in_use"] == 10 * 2**30
+    assert line["checkpoint_age_s"] >= 0
+    assert line["checkpoint_last_step"] == 7
+    # rates persist as gauges for the final snapshot / run report
+    g = telemetry.snapshot()["gauges"]
+    assert g["progress.rows_per_sec"] > 0
+    # the sink got the same line; deltas reset so a second beat reads 0
+    (rec,) = [json.loads(x) for x in out.read_text().splitlines()]
+    assert rec["seq"] == 1
+    line2 = hb.beat()
+    assert line2["rows_per_s"] == 0.0 and line2["seq"] == 2
+
+
+def test_heartbeat_daemon_thread_emits_and_stops(tmp_path):
+    out = tmp_path / "hb.jsonl"
+    hb = Heartbeat(interval=0.02, jsonl_path=str(out))
+    with hb:
+        deadline = time.monotonic() + 5.0
+        while not out.exists() and time.monotonic() < deadline:
+            time.sleep(0.005)
+    assert out.exists(), "daemon thread never beat"
+    n_at_stop = len(out.read_text().splitlines())
+    assert n_at_stop >= 1
+    time.sleep(0.1)  # stopped: no further beats
+    assert len(out.read_text().splitlines()) == n_at_stop
+    assert hb._thread is None
+
+
+def test_heartbeat_rejects_bad_interval():
+    with pytest.raises(ValueError, match="interval"):
+        Heartbeat(interval=0)
+
+
+# -- report building ----------------------------------------------------------
+
+
+def _span(id, parent, name, ts, dur, thread="MainThread"):
+    return {
+        "type": "span", "id": id, "parent": parent, "name": name,
+        "ts": ts, "dur": dur, "thread": thread, "attrs": {}, "events": [],
+    }
+
+
+SPANS = [
+    _span(1, None, "fit", 0.0, 10.0),
+    _span(2, 1, "cd_iteration", 0.5, 4.0),
+    _span(3, 2, "coordinate:fixed", 0.5, 2.5),
+    _span(4, 2, "coordinate:perUser", 3.0, 1.5),
+    _span(5, 1, "cd_iteration", 5.0, 4.5),
+    _span(6, 5, "coordinate:fixed", 5.0, 2.0),
+    _span(7, 5, "coordinate:perUser", 7.0, 2.5),
+]
+
+
+def test_build_phase_tree_aggregates_by_path():
+    root = build_phase_tree(SPANS)
+    fit = root.children["fit"]
+    assert fit.count == 1 and fit.total_s == 10.0
+    cd = fit.children["cd_iteration"]
+    assert cd.count == 2 and cd.total_s == pytest.approx(8.5)
+    assert cd.children["coordinate:fixed"].total_s == pytest.approx(4.5)
+    assert cd.children["coordinate:perUser"].total_s == pytest.approx(4.0)
+    # self time subtracts children at each level
+    assert fit.self_s == pytest.approx(1.5)
+    assert cd.self_s == pytest.approx(0.0)
+
+
+def test_build_phase_tree_orphan_parent_roots_at_survivor():
+    # span 9's parent 8 was dropped from a bounded buffer
+    spans = SPANS + [_span(9, 8, "leaked", 9.0, 0.5)]
+    root = build_phase_tree(spans)
+    assert root.children["leaked"].count == 1  # rooted, not lost
+
+
+def test_compare_metrics_directions_and_threshold():
+    deltas = compare_metrics(
+        {"rows_per_sec": 80.0, "jit_compiles": 30.0, "fit_seconds": 95.0},
+        {"rows_per_sec": 100.0, "jit_compiles": 20.0, "fit_seconds": 100.0},
+        threshold=0.2,
+    )
+    by = {d.metric: d for d in deltas}
+    # -20% rows/s is AT the threshold, not beyond: ok
+    assert not by["rows_per_sec"].regressed
+    # +50% compiles (lower-is-better): regression
+    assert by["jit_compiles"].regressed
+    assert not by["fit_seconds"].regressed  # 5% faster = improvement
+    # zero baselines and unknown metrics are skipped
+    assert compare_metrics({"x": 1.0}, {"x": 0.0}) == []
+    assert compare_metrics({"mystery": 1.0}, {"mystery": 2.0}) == []
+
+
+def test_run_report_load_merge_and_markdown(tmp_path):
+    trace = tmp_path / "run.trace.jsonl"
+    with open(trace, "w") as fh:
+        fh.write(json.dumps({"type": "trace_header"}) + "\n")
+        for s in SPANS:
+            fh.write(json.dumps(s) + "\n")
+        fh.write("{truncated last line")
+    tele = tmp_path / "run.metrics.jsonl"
+    snapshot = {
+        "counters": {
+            "jit_compiles": 12,
+            "jit_compile_seconds": 3.5,
+            "device_fetches": 40,
+            "device_fetch_seconds": 4.2,
+            "trace.dropped_spans": 2,
+            "memory.headroom_warnings": 1,
+        },
+        "gauges": {
+            "progress.rows_per_sec": 5e5,
+            "progress.coeffs_per_sec": 1e4,
+            "memory.bytes_in_use": 10 * 2**30,
+            "memory.bytes_limit": 16 * 2**30,
+            "memory.phase.coordinate:fixed.peak_bytes": 11 * 2**30,
+        },
+        "histograms": {
+            "device_fetch_seconds": {"count": 40, "p50": 0.1, "p95": 0.2}
+        },
+    }
+    with open(tele, "w") as fh:
+        fh.write(
+            json.dumps({"type": "heartbeat", "seq": 1, "uptime_s": 30.0,
+                        "span": "fit", "rows_per_s": 4e5}) + "\n"
+        )
+        fh.write(
+            json.dumps({"type": "metrics", "snapshot": snapshot}) + "\n"
+        )
+    ckpt = tmp_path / "ckpt" / "step-00000003"
+    ckpt.mkdir(parents=True)
+    (ckpt / "manifest.json").write_text(json.dumps({
+        "format_version": 1, "step": 3, "best_metric": 0.71,
+        "frozen": ["perUser"],
+        "consecutive_rollbacks": {"perUser": 2},
+        "history": [
+            {"iteration": 0, "coordinate": "fixed", "seconds": 2.5,
+             "metrics": {"auc": 0.7}},
+            {"iteration": 0, "coordinate": "perUser", "seconds": 1.5,
+             "solve_retries": 2, "rolled_back": True},
+            {"iteration": 1, "coordinate": "fixed", "seconds": 2.0,
+             "metrics": {"auc": 0.71}},
+        ],
+    }))
+
+    report = RunReport.load(
+        trace=str(trace), telemetry=str(tele),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    km = report.key_metrics()
+    assert km["fit_seconds"] == 10.0
+    assert km["rows_per_sec"] == 5e5
+    assert km["jit_compiles"] == 12
+    assert km["dropped_spans"] == 2
+
+    coords = report.coordinate_summary()
+    by = {c["coordinate"]: c for c in coords}
+    assert by["fixed"]["steps"] == 2
+    assert by["fixed"]["last_metrics"] == {"auc": 0.71}
+    assert by["perUser"]["rollbacks"] == 1
+    assert by["perUser"]["solve_retries"] == 2
+    assert by["perUser"]["frozen"] is True
+
+    md = report.to_markdown()
+    # the full phase-time tree, nested
+    assert "- `fit` — n=1" in md
+    assert "  - `cd_iteration` — n=2" in md
+    assert "    - `coordinate:fixed` — n=2" in md
+    assert "    - `coordinate:perUser` — n=2" in md
+    # accounting, memory, coordinates, heartbeats, drop warning
+    assert "`jit_compiles` | 12" in md
+    assert "headroom warning" in md
+    assert "`coordinate:fixed` | 11.0 GiB" in md
+    assert "1 beat(s)" in md
+    assert "2 span(s) were dropped" in md
+
+    # round-trip: the saved JSON is a usable compare baseline
+    doc = report.save_json(str(tmp_path / "report.json"))
+    assert doc["key_metrics"] == km
+    deltas = report.compare(
+        json.load(open(tmp_path / "report.json")), threshold=0.2
+    )
+    assert deltas and not any(d.regressed for d in deltas)
+    # doctored baseline (2x the rows/s): current run has regressed
+    doctored = dict(doc, key_metrics=dict(km, rows_per_sec=km["rows_per_sec"] * 2))
+    regressed = [d for d in report.compare(doctored) if d.regressed]
+    assert [d.metric for d in regressed] == ["rows_per_sec"]
+    md2 = report.to_markdown(deltas=report.compare(doctored))
+    assert "**REGRESSED**" in md2
+
+
+def test_report_path_sibling():
+    assert report_path("x/run.trace.jsonl") == "x/run.trace.report.md"
+    assert report_path("run") == "run.report.md"
+
+
+def test_metric_delta_is_json_safe():
+    d = MetricDelta("m", 1.0, 2.0, -0.5, True)
+    json.dumps(d.to_dict())
+
+
+# -- bench budget / gate ------------------------------------------------------
+
+
+def test_bench_suite_budget_emits_truncated_lines(capsys, monkeypatch):
+    import bench_suite
+
+    monkeypatch.setenv("PHOTON_BENCH_BUDGET_S", "0")
+    deadline = bench_suite.budget_deadline()
+    assert deadline is not None
+    # budget already spent: EVERY metric line still appears, truncated
+    results = bench_suite.run_suite(deadline=time.monotonic() - 1.0)
+    lines = [
+        json.loads(x)
+        for x in capsys.readouterr().out.splitlines()
+        if x.startswith("{")
+    ]
+    assert [x["metric"] for x in lines] == list(bench_suite.SUITE_METRICS)
+    assert all(x["truncated"] is True and x["value"] is None for x in lines)
+    assert all(v is None for v in results.values())
+    monkeypatch.delenv("PHOTON_BENCH_BUDGET_S")
+    assert bench_suite.budget_deadline() is None
+
+
+def test_bench_suite_gate(tmp_path, capsys):
+    import bench_suite
+
+    results = {
+        "linreg_tron_1Mx10K_rows_per_sec_per_chip": 50_000.0,
+        "poisson_offsets_box_1Mx10K_rows_per_sec_per_chip": None,  # truncated
+    }
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        {"linreg_tron_1Mx10K_rows_per_sec_per_chip": 100_000.0}
+    ))
+    rc = bench_suite.run_gate(
+        results, bench_suite.load_gate_baseline(str(baseline)), 0.2
+    )
+    assert rc == bench_suite.GATE_EXIT_CODE
+    err = capsys.readouterr().err
+    assert "REGRESSED" in err and "truncated, not gated" in err
+    # within threshold: passes
+    rc = bench_suite.run_gate(
+        results, {"linreg_tron_1Mx10K_rows_per_sec_per_chip": 55_000.0}, 0.2
+    )
+    assert rc == 0
+    # a baseline sharing NO metric names (e.g. a run-report key_metrics
+    # doc) must ERROR, not silently pass the gate
+    rc = bench_suite.run_gate(
+        results, {"rows_per_sec": 1.0, "fit_seconds": 2.0}, 0.2
+    )
+    assert rc == 2
+    assert "no comparable metrics" in capsys.readouterr().err
+    # an all-truncated run compared NOTHING: the gate must not pass —
+    # a starved budget would otherwise keep a real regression green
+    rc = bench_suite.run_gate(
+        {"linreg_tron_1Mx10K_rows_per_sec_per_chip": None},
+        {"linreg_tron_1Mx10K_rows_per_sec_per_chip": 100.0},
+        0.2,
+    )
+    assert rc == 2
+    assert "budget-truncated" in capsys.readouterr().err
+
+
+def test_bench_suite_gate_baseline_formats(tmp_path):
+    import bench_suite
+
+    # JSONL of bench output lines
+    p = tmp_path / "lines.jsonl"
+    p.write_text(
+        json.dumps({"metric": "a", "value": 2.0, "unit": "rows/s"}) + "\n"
+        + json.dumps({"metric": "bad", "value": None, "truncated": True})
+        + "\nnot json\n"
+    )
+    assert bench_suite.load_gate_baseline(str(p)) == {"a": 2.0}
+    # run-report JSON with key_metrics
+    p2 = tmp_path / "report.json"
+    p2.write_text(json.dumps({"key_metrics": {"b": 3.0, "note": "x"}}))
+    assert bench_suite.load_gate_baseline(str(p2)) == {"b": 3.0}
+
+
+def test_bench_budget_skips_all_sub_benchmarks(capsys):
+    import bench
+
+    # deadline in the past: every sub-benchmark is skipped WITHOUT
+    # launching a subprocess, yet every expected metric line appears
+    bench.run_sub_benchmarks(deadline=time.monotonic() - 1.0)
+    lines = [
+        json.loads(x)
+        for x in capsys.readouterr().out.splitlines()
+        if x.startswith("{")
+    ]
+    expected = [
+        m for ms in bench._SCRIPT_METRICS.values() for m in ms
+    ]
+    assert [x["metric"] for x in lines] == expected
+    assert all(x["truncated"] is True for x in lines)
+
+
+# -- train CLI wiring ---------------------------------------------------------
+
+
+def test_train_parse_heartbeat_variants():
+    from photon_ml_tpu.cli.train import _parse_heartbeat
+
+    hb = _parse_heartbeat({}, None)  # on by default
+    assert hb is not None and hb.interval == 30.0 and hb.jsonl_path is None
+    # every documented "off" spelling disables without crashing
+    assert _parse_heartbeat({"heartbeat": False}, None) is None
+    assert _parse_heartbeat({"heartbeat": 0}, None) is None
+    assert _parse_heartbeat({"heartbeat": None}, None) is None
+    # {} means enabled with defaults; a bare number is the interval
+    assert _parse_heartbeat({"heartbeat": {}}, None).interval == 30.0
+    assert _parse_heartbeat({"heartbeat": 10}, None).interval == 10.0
+    hb = _parse_heartbeat(
+        {"heartbeat": {"every": 5, "out": "hb.jsonl"}}, "m.jsonl"
+    )
+    assert hb.interval == 5.0 and hb.jsonl_path == "hb.jsonl"
+    # sink defaults to telemetry_out so the report finds the beats
+    hb = _parse_heartbeat({"heartbeat": {"every": 5}}, "m.jsonl")
+    assert hb.jsonl_path == "m.jsonl"
+    assert _parse_heartbeat({"heartbeat": {"every": 0}}, None) is None
+    with pytest.raises(ValueError, match="unknown heartbeat"):
+        _parse_heartbeat({"heartbeat": {"interval": 5}}, None)
+
+
+def test_train_maybe_write_report_from_live(tmp_path):
+    from photon_ml_tpu.cli.train import _maybe_write_report
+
+    summary = {}
+    _maybe_write_report({}, summary, None, None)  # no report_out: no-op
+    assert summary == {}
+    with telemetry.span("fit"):
+        pass
+    report_out = tmp_path / "run.report.md"
+    _maybe_write_report(
+        {"report_out": str(report_out)}, summary, None, None
+    )
+    assert summary["report"] == str(report_out)
+    assert "- `fit`" in report_out.read_text()
+    doc = json.loads((tmp_path / "run.report.json").read_text())
+    assert doc["type"] == "run_report"
+
+
+# -- e2e acceptance -----------------------------------------------------------
+
+
+def test_e2e_fit_report_compare(tmp_path):
+    """ISSUE 3 acceptance: a small GameEstimator.fit with trace+telemetry
+    sinks -> `cli report` produces a markdown report with the full
+    phase-time tree; heartbeat lines were emitted; `cli report --compare
+    --fail-on-regress` exits nonzero against a doctored baseline showing
+    a >20% rows/s regression and 0 against the undoctored one."""
+    from photon_ml_tpu.cli.report import main as report_main
+    from photon_ml_tpu.game.checkpoint import CheckpointSpec
+    from photon_ml_tpu.game.estimator import (
+        FixedEffectConfig,
+        GameConfig,
+        GameEstimator,
+        RandomEffectConfig,
+    )
+    from photon_ml_tpu.optim.factory import OptimizerConfig
+    from photon_ml_tpu.testing import generate_game_dataset
+
+    data, _ = generate_game_dataset(
+        task="logistic", n_users=6, rows_per_user=10, fe_dim=4, re_dim=2
+    )
+    trace_out = tmp_path / "run.trace.jsonl"
+    tele_out = tmp_path / "run.metrics.jsonl"
+    ckpt_dir = tmp_path / "ckpt"
+    telemetry.reset()
+    telemetry.configure(trace_out=str(trace_out))
+    opt = OptimizerConfig(max_iterations=5)
+    estimator = GameEstimator(GameConfig(
+        task="logistic",
+        coordinates={
+            "fixed": FixedEffectConfig(shard_name="global", optimizer=opt),
+            "perUser": RandomEffectConfig(
+                shard_name="user", id_name="userId", optimizer=opt
+            ),
+        },
+        num_iterations=2,
+    ))
+    # a sub-second-interval heartbeat so even this tiny fit beats
+    with Heartbeat(interval=0.05, jsonl_path=str(tele_out)):
+        estimator.fit(
+            data,
+            checkpoint_spec=CheckpointSpec(directory=str(ckpt_dir)),
+        )
+    telemetry.flush_metrics(str(tele_out))
+
+    # heartbeat lines WERE emitted during the fit
+    hb_lines = [
+        json.loads(x)
+        for x in tele_out.read_text().splitlines()
+        if json.loads(x).get("type") == "heartbeat"
+    ]
+    assert hb_lines, "no heartbeat lines during the fit"
+    assert any(x["rows_total"] > 0 for x in hb_lines)
+
+    # the snapshot carries the report's rate + progress metrics
+    snap = telemetry.snapshot()
+    assert snap["gauges"]["progress.rows_per_sec"] > 0
+    assert snap["counters"]["progress.rows"] == 6 * 10 * 2 * 2  # rows*coords*iters
+    telemetry.reset()
+
+    md_path = tmp_path / "report.md"
+    json_path = tmp_path / "report.json"
+    rc = report_main([
+        "--trace", str(trace_out),
+        "--telemetry", str(tele_out),
+        "--checkpoint-dir", str(ckpt_dir),
+        "--out", str(md_path),
+        "--json", str(json_path),
+    ])
+    assert rc == 0
+    md = md_path.read_text()
+    # the full phase-time tree
+    assert "- `fit` — n=1" in md
+    assert "  - `cd_iteration` — n=2" in md
+    assert "    - `coordinate:fixed` — n=2" in md
+    assert "    - `coordinate:perUser` — n=2" in md
+    assert "`build_coordinates`" in md
+    # convergence history from the checkpoint manifests
+    assert "## Coordinates" in md and "`perUser` | 2" in md
+    assert "## Heartbeats" in md
+
+    # undoctored baseline: exit 0
+    rc = report_main([
+        "--trace", str(trace_out), "--telemetry", str(tele_out),
+        "--out", str(tmp_path / "cmp.md"),
+        "--compare", str(json_path), "--fail-on-regress",
+    ])
+    assert rc == 0
+    # doctored baseline: rows/s 2x better than measured -> >20% regression
+    doc = json.loads(json_path.read_text())
+    assert doc["key_metrics"]["rows_per_sec"] > 0
+    doc["key_metrics"]["rows_per_sec"] *= 2.0
+    doctored = tmp_path / "doctored.json"
+    doctored.write_text(json.dumps(doc))
+    rc = report_main([
+        "--trace", str(trace_out), "--telemetry", str(tele_out),
+        "--out", str(tmp_path / "cmp2.md"),
+        "--compare", str(doctored), "--fail-on-regress",
+    ])
+    assert rc == 3
+    assert "**REGRESSED**" in (tmp_path / "cmp2.md").read_text()
+
+
+def test_cli_report_requires_a_source():
+    from photon_ml_tpu.cli.report import main as report_main
+
+    with pytest.raises(SystemExit) as exc:
+        report_main([])
+    assert exc.value.code == 2
+
+
+def test_cli_report_bad_baseline(tmp_path):
+    from photon_ml_tpu.cli.report import main as report_main
+
+    trace = tmp_path / "t.jsonl"
+    trace.write_text("")
+    rc = report_main(
+        ["--trace", str(trace), "--compare", str(tmp_path / "missing.json")]
+    )
+    assert rc == 1

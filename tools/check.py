@@ -24,6 +24,10 @@ Runs, in order:
          writers (utils/atomic.py and the model/checkpoint stores built on
          it) — a crash mid-write must never leave a truncated file a later
          load half-reads
+       - library-only bare `print()` (L009): stdout belongs to drivers;
+         library code routes output through loggers/telemetry so fits are
+         greppable and machine-readable. CLI modules (photon_ml_tpu/cli/)
+         are exempt — stdout IS their interface.
   3. ruff + mypy, IF installed (configs live in pyproject.toml)
 
 Exit code 0 = clean. Any finding prints `path:line: code message` and the
@@ -86,6 +90,10 @@ class _Lint(ast.NodeVisitor):
         # rules L006/L007; benches and tests may time however they like
         self.library = library
         self._l008_exempt = path in L008_BLESSED
+        # CLI modules own stdout: bare print() is their user interface
+        self._l009_exempt = path.startswith(
+            os.path.join("photon_ml_tpu", "cli") + os.sep
+        )
         self.findings: list[str] = []
         self.imported: dict[str, int] = {}  # name -> lineno (module scope)
         self.used: set[str] = set()
@@ -202,6 +210,18 @@ class _Lint(ast.NodeVisitor):
                 "path) in library code — a crash mid-write leaves a "
                 "truncated file; route through utils.atomic / the "
                 "model_store//checkpoint writers",
+            )
+        if (
+            self.library
+            and not self._l009_exempt
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            self._report(
+                node,
+                "L009",
+                "bare print() in library code — stdout belongs to CLI "
+                "drivers; route output through logging or telemetry",
             )
         self.generic_visit(node)
 
