@@ -148,6 +148,8 @@ _SCRIPT_METRICS = {
     "bench_game.py": ("glmix_fe_re_logistic_1Mx100Kusers_coeffs_per_sec",),
     "bench_scale.py": ("game_1B_coeffs_trained_per_sec",),
     "bench_ingest.py": ("avro_ingest_rows_per_sec",),
+    "bench_serving.py": ("serving_p50_ms", "serving_p99_ms",
+                         "serving_rows_per_sec"),
     "bench_northstar.py": ("north_star_e2e",),
 }
 
@@ -167,7 +169,8 @@ def run_sub_benchmarks(deadline=None):
     # north-star (20M-row full pipeline) runs last and longest; the
     # driver's BASELINE numbers come from the earlier lines either way
     for script in ("bench_suite.py", "bench_game.py", "bench_scale.py",
-                   "bench_ingest.py", "bench_northstar.py"):
+                   "bench_ingest.py", "bench_serving.py",
+                   "bench_northstar.py"):
         path = os.path.join(here, script)
         expected = _SCRIPT_METRICS.get(script, (script.replace(".py", ""),))
         remaining = (

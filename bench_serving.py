@@ -1,0 +1,212 @@
+"""Online-serving benchmark: steady-state latency + throughput at fixed
+offered load.
+
+Builds a synthetic GLMix model (FE 2K features + 20K-entity RE with K=16
+local dims), compiles it into a ScoringEngine, warms every batch-size
+bucket, then drives the MicroBatcher from closed-loop client threads for
+a fixed measurement window. Emits BENCH-style JSON lines:
+
+  serving_p50_ms / serving_p99_ms   steady-state request latency
+  serving_rows_per_sec              scored rows per second
+
+Latency is measured at the client (submit -> future resolved), so it
+includes queue + padding + device time. ``PHOTON_BENCH_BUDGET_S`` caps
+wall clock: an exhausted budget emits ``"truncated": true`` placeholder
+lines per metric (bench_suite convention). The jit-compile counter is
+asserted flat across the measurement window — a recompile in steady state
+is a bug, not a slow run.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+SERVING_METRICS = (
+    "serving_p50_ms",
+    "serving_p99_ms",
+    "serving_rows_per_sec",
+)
+
+N_FEATURES = 2_000
+N_ENTITIES = 20_000
+LOCAL_DIM = 16
+ROW_NNZ = 24
+MAX_BATCH = 64
+N_CLIENTS = 8
+MEASURE_S = 10.0
+
+
+def build_model():
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.models import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectBucketModel,
+        RandomEffectModel,
+    )
+
+    rng = np.random.default_rng(0)
+    fe = FixedEffectModel(
+        coefficients=jnp.asarray(
+            rng.normal(size=N_FEATURES) * 0.1, jnp.float32
+        ),
+        shard_name="global",
+    )
+    n_buckets = 4
+    entity_bucket = (np.arange(N_ENTITIES) % n_buckets).astype(np.int64)
+    entity_pos = np.zeros(N_ENTITIES, np.int64)
+    buckets = []
+    for b in range(n_buckets):
+        codes_b = np.nonzero(entity_bucket == b)[0]
+        entity_pos[codes_b] = np.arange(len(codes_b))
+        # each entity's local space: LOCAL_DIM sorted global feature ids
+        proj = np.sort(
+            rng.choice(N_FEATURES, size=(len(codes_b), LOCAL_DIM),
+                       replace=True),
+            axis=1,
+        ).astype(np.int32)
+        buckets.append(
+            RandomEffectBucketModel(
+                coefficients=jnp.asarray(
+                    rng.normal(size=(len(codes_b), LOCAL_DIM)) * 0.1,
+                    jnp.float32,
+                ),
+                projection=jnp.asarray(proj),
+                entity_codes=jnp.asarray(codes_b, jnp.int32),
+            )
+        )
+    re = RandomEffectModel(
+        id_name="memberId",
+        shard_name="global",
+        buckets=tuple(buckets),
+        entity_bucket=entity_bucket,
+        entity_pos=entity_pos,
+        vocab=np.arange(N_ENTITIES),
+    )
+    return GameModel(task="logistic", models={"fixed": fe, "member": re})
+
+
+def make_rows(rng, count):
+    rows = []
+    for _ in range(count):
+        cols = np.sort(
+            rng.choice(N_FEATURES, size=ROW_NNZ, replace=False)
+        )
+        vals = rng.normal(size=ROW_NNZ)
+        rows.append(
+            {
+                "features": {
+                    "global": [
+                        [int(c), float(v)] for c, v in zip(cols, vals)
+                    ]
+                },
+                "ids": {"memberId": int(rng.integers(0, N_ENTITIES))},
+            }
+        )
+    return rows
+
+
+def main() -> int:
+    from bench_suite import budget_deadline, truncated_line
+
+    deadline = budget_deadline()
+    if deadline is not None and deadline - time.monotonic() < 30:
+        for metric in SERVING_METRICS:
+            print(truncated_line(metric), flush=True)
+        return 0
+
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.serving import MicroBatcher, Overloaded, ScoringEngine
+
+    telemetry.configure_from_env()
+    rng = np.random.default_rng(1)
+    engine = ScoringEngine(
+        build_model(), max_batch=MAX_BATCH, max_row_nnz=ROW_NNZ + 8,
+        version="bench",
+    )
+    engine.warmup()
+    batcher = MicroBatcher(
+        lambda rows: (engine.score_rows(rows), engine.version),
+        max_batch=MAX_BATCH,
+        max_delay_ms=2.0,
+        queue_depth=4096,
+    ).start()
+
+    # pre-generated request pool so client threads do no numpy in-loop
+    pool = [make_rows(rng, 4) for _ in range(256)]
+    measure_s = MEASURE_S
+    if deadline is not None:
+        measure_s = min(measure_s, max(deadline - time.monotonic() - 10, 2.0))
+
+    latencies: list[float] = []
+    rows_done = [0]
+    lock = threading.Lock()
+    stop_at = time.monotonic() + measure_s
+    compiles_before = telemetry.snapshot()["counters"].get("jit_compiles", 0)
+
+    def client(seed: int) -> None:
+        local_rng = np.random.default_rng(seed)
+        while time.monotonic() < stop_at:
+            rows = pool[int(local_rng.integers(0, len(pool)))]
+            t0 = time.monotonic()
+            try:
+                fut = batcher.submit(rows)
+                fut.result(timeout=30)
+            except Overloaded:
+                continue
+            dt = (time.monotonic() - t0) * 1000.0
+            with lock:
+                latencies.append(dt)
+                rows_done[0] += len(rows)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(N_CLIENTS)
+    ]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=measure_s + 60)
+    elapsed = time.monotonic() - t_start
+    batcher.stop()
+    compiles_after = telemetry.snapshot()["counters"].get("jit_compiles", 0)
+
+    lat = np.sort(np.asarray(latencies))
+    detail = {
+        "requests": len(latencies),
+        "clients": N_CLIENTS,
+        "max_batch": MAX_BATCH,
+        "seconds": round(elapsed, 2),
+        "steady_state_compiles": compiles_after - compiles_before,
+    }
+    for metric, value in (
+        ("serving_p50_ms",
+         round(float(lat[int(0.50 * (len(lat) - 1))]), 3) if len(lat) else None),
+        ("serving_p99_ms",
+         round(float(lat[int(0.99 * (len(lat) - 1))]), 3) if len(lat) else None),
+        ("serving_rows_per_sec",
+         round(rows_done[0] / elapsed, 1) if elapsed > 0 else None),
+    ):
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": value,
+                    "unit": "ms" if metric.endswith("_ms") else "rows/s",
+                    "vs_baseline": None,
+                    "detail": detail,
+                }
+            ),
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,4 +1,4 @@
-"""CLI dispatcher: python -m photon_ml_tpu.cli {train|score} ...
+"""CLI dispatcher: python -m photon_ml_tpu.cli {train|score|serve} ...
 
 Reference analog: the photon-client spark-submit mains
 (cli/game/training/Driver.scala:327, cli/game/scoring/Driver.scala:255)."""
@@ -9,9 +9,10 @@ import sys
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m photon_ml_tpu.cli {train|score|glm|index|report} [options]")
+        print("usage: python -m photon_ml_tpu.cli {train|score|serve|glm|index|report} [options]")
         print("  train --config <json> [--output-dir <dir>]   GAME training")
         print("  score --model-dir <dir> --config <json> [--output <avro>]")
+        print("  serve --registry-dir <dir> | --model-dir <dir>  online scoring server")
         print("  glm   --config <json> [--output-dir <dir>]   staged legacy GLM")
         print("  index --input <avro...> --output <dir>       feature index build")
         print("  report --trace <jsonl> [--telemetry <jsonl>] [--compare <json>]")
@@ -25,6 +26,10 @@ def main(argv=None) -> int:
         from photon_ml_tpu.cli.score import main as score_main
 
         return score_main(rest)
+    if cmd == "serve":
+        from photon_ml_tpu.cli.serve import main as serve_main
+
+        return serve_main(rest)
     if cmd == "glm":
         from photon_ml_tpu.cli.glm import main as glm_main
 
@@ -38,7 +43,7 @@ def main(argv=None) -> int:
 
         return report_main(rest)
     print(
-        f"unknown command '{cmd}' (expected train|score|glm|index|report)",
+        f"unknown command '{cmd}' (expected train|score|serve|glm|index|report)",
         file=sys.stderr,
     )
     return 2

@@ -28,27 +28,39 @@ def run(
     output_path: Optional[str] = None,
     evaluators: Sequence[str] = (),
     model_id: str = "",
+    allow_index_rebuild: bool = False,
 ) -> dict:
     import os
 
-    from photon_ml_tpu.data.index_map import IndexMap
-    from photon_ml_tpu.data.model_store import load_game_model
+    from photon_ml_tpu.data.model_store import (
+        ModelLoadError,
+        load_feature_index_maps,
+        load_game_model,
+    )
     from photon_ml_tpu.evaluation import EVALUATORS
 
     # reuse the TRAINING feature space saved next to the model, so feature
     # ids line up with the stored coefficients (prepareFeatureMaps analog)
-    index_maps = None
+    index_maps = load_feature_index_maps(model_dir)
     idx_dir = os.path.join(model_dir, "feature-indexes")
-    if os.path.isdir(idx_dir):
-        index_maps = {
-            shard: IndexMap.load(os.path.join(idx_dir, shard))
-            for shard in sorted(os.listdir(idx_dir))
-        }
-    else:
+    if index_maps is None and not allow_index_rebuild:
+        # rebuilding the feature space from SCORING data silently misaligns
+        # feature ids with the stored coefficients — hard error unless the
+        # caller explicitly accepts the risk (the serving registry refuses
+        # such model dirs outright, with no override)
+        raise ModelLoadError(
+            idx_dir,
+            "missing feature-indexes/ — feature ids rebuilt from scoring "
+            "data may not match the stored coefficients and scores would "
+            "be silently wrong; pass --allow-index-rebuild to accept that "
+            "risk",
+        )
+    elif index_maps is None:
         logger.warning(
             "%s has no feature-indexes/: index maps will be rebuilt by "
             "scanning the SCORING data — feature ids may not match the "
-            "stored coefficients and scores may be silently wrong",
+            "stored coefficients and scores may be silently wrong "
+            "(--allow-index-rebuild)",
             model_dir,
         )
 
@@ -110,6 +122,13 @@ def main(argv=None) -> int:
     parser.add_argument("--output", help="ScoringResultAvro output path")
     parser.add_argument("--evaluators", nargs="*", default=[])
     parser.add_argument("--model-id", default="")
+    parser.add_argument(
+        "--allow-index-rebuild",
+        action="store_true",
+        help="score a model dir with no feature-indexes/ by rebuilding the "
+        "feature space from the scoring data (scores may be silently wrong "
+        "if the spaces differ)",
+    )
     args = parser.parse_args(argv)
 
     setup_logging()
@@ -122,6 +141,7 @@ def main(argv=None) -> int:
         output_path=args.output,
         evaluators=args.evaluators,
         model_id=args.model_id,
+        allow_index_rebuild=args.allow_index_rebuild,
     )
     print(json.dumps(summary, default=float))
     return 0

@@ -1,0 +1,138 @@
+"""GAME online scoring server driver.
+
+Long-lived, low-latency counterpart of the batch ``cli score`` driver:
+
+    python -m photon_ml_tpu.cli serve --registry-dir out/registry \\
+        --port 8080 --max-batch 64 --max-delay-ms 5 --queue-depth 256
+
+    python -m photon_ml_tpu.cli serve --model-dir out/model/best --stdio
+
+``--registry-dir`` watches a versioned models directory and hot-swaps to
+the newest valid version (see serving/registry.py for the layout);
+``--model-dir`` pins one saved model (still requiring its
+``feature-indexes/``). ``--stdio`` swaps the HTTP front end for a JSONL
+stdin/stdout loop so pipelines and CI can drive the service without
+sockets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+from photon_ml_tpu.utils import logger, setup_logging
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli serve", description=__doc__.splitlines()[0]
+    )
+    src = parser.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model-dir", help="serve one saved GAME model dir")
+    src.add_argument(
+        "--registry-dir",
+        help="watch a versioned models directory and hot-swap to the "
+        "newest valid version",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument(
+        "--max-batch", type=int, default=64,
+        help="largest padded device batch (compiled buckets are powers of "
+        "two up to this)",
+    )
+    parser.add_argument(
+        "--max-delay-ms", type=float, default=5.0,
+        help="micro-batching deadline: how long a request may wait for "
+        "co-riders",
+    )
+    parser.add_argument(
+        "--queue-depth", type=int, default=256,
+        help="admission control: pending-row cap before requests are shed "
+        "with 503",
+    )
+    parser.add_argument(
+        "--max-row-nnz", type=int, default=128,
+        help="per-shard feature cap per request row",
+    )
+    parser.add_argument(
+        "--poll-interval", type=float, default=2.0,
+        help="registry watch interval in seconds",
+    )
+    parser.add_argument(
+        "--stdio", action="store_true",
+        help="serve a JSONL request/response loop on stdin/stdout instead "
+        "of HTTP",
+    )
+    args = parser.parse_args(argv)
+
+    setup_logging()
+    from photon_ml_tpu.serving import (
+        ModelRegistry,
+        ScoringEngine,
+        ScoringServer,
+        ScoringService,
+        serve_stdio,
+    )
+
+    registry = None
+    if args.model_dir:
+        source = ScoringEngine.load(
+            args.model_dir,
+            max_batch=args.max_batch,
+            max_row_nnz=args.max_row_nnz,
+        ).warmup()
+    else:
+        registry = ModelRegistry(
+            args.registry_dir,
+            max_batch=args.max_batch,
+            max_row_nnz=args.max_row_nnz,
+            poll_interval=args.poll_interval,
+        )
+        registry.start()
+        source = registry
+
+    try:
+        if args.stdio:
+            return serve_stdio(source, sys.stdin, sys.stdout)
+        service = ScoringService(
+            source,
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            queue_depth=args.queue_depth,
+        )
+        server = ScoringServer(service, host=args.host, port=args.port)
+        server.start()
+        stop = threading.Event()
+
+        def _on_signal(signum, frame):
+            logger.info("received signal %d: shutting down", signum)
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+        print(
+            json.dumps(
+                {
+                    "serving": {
+                        "host": args.host,
+                        "port": server.port,
+                        "model_version": service.health().get("model_version"),
+                    }
+                }
+            ),
+            flush=True,
+        )
+        stop.wait()
+        server.stop()
+        return 0
+    finally:
+        if registry is not None:
+            registry.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

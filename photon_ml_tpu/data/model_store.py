@@ -389,6 +389,23 @@ def load_game_model_metadata(path: str) -> dict:
         return json.load(f)
 
 
+def load_feature_index_maps(model_dir: str) -> Optional[dict]:
+    """Per-shard IndexMaps persisted under ``<model_dir>/feature-indexes/``
+    (the training feature space pinned next to the coefficients), or None
+    when the directory is absent. Shared by the batch scoring driver and
+    the serving engine so both resolve names through the SAME maps the
+    model was trained with."""
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    idx_dir = os.path.join(model_dir, "feature-indexes")
+    if not os.path.isdir(idx_dir):
+        return None
+    return {
+        shard: IndexMap.load(os.path.join(idx_dir, shard))
+        for shard in sorted(os.listdir(idx_dir))
+    }
+
+
 def score_game_dataset(model_dir: str, data: GameDataset) -> np.ndarray:
     """Load a saved GAME model and score a dataset (scoring driver analog).
 

@@ -17,10 +17,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.ops.losses import get_loss
 
 Array = jax.Array
+
+#: nnz processed per device dispatch in RandomEffectModel.score — module
+#: level (not a local) so tests can shrink it to exercise the chunk
+#: boundary without 8M-nnz fixtures.
+SCORE_CHUNK = 8_000_000
 
 
 def map_vocab_codes(vocab: np.ndarray, values: np.ndarray) -> np.ndarray:
@@ -90,8 +96,52 @@ class RandomEffectModel:
 
     def _codes_for(self, data: GameDataset) -> np.ndarray:
         """Map a dataset's entity VALUES to training codes (-1 if unseen)."""
+        return self._grouping_for(data)[0]
+
+    def _grouping_for(
+        self, data: GameDataset
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(codes, row_bucket, row_pos) host arrays for ``data`` — the
+        O(n log V) vocabulary join and bucket/position placement.
+
+        Memoized per (model, dataset): repeated scoring of the same
+        dataset (validation every CD iteration, the serving registry's
+        parity checks) must not redo the host-side numpy work. The cache
+        lives on the dataset (like ``device_shard``) keyed by id column,
+        and is validated by TABLE IDENTITY — a different model object with
+        its own vocab/placement recomputes instead of reusing stale
+        arrays. Hits/misses are ``scoring.code_cache.{hits,misses}``.
+        """
+        cache = data.__dict__.setdefault("_re_group_cache", {})
+        # keyed by (id column, vocab identity) so two coordinates sharing
+        # an id column keep separate entries instead of thrashing one; the
+        # entry pins the vocab object, so its id() cannot be recycled
+        key = (self.id_name, id(self.vocab))
+        entry = cache.get(key)
+        if (
+            entry is not None
+            and entry["vocab"] is self.vocab
+            and entry["entity_bucket"] is self.entity_bucket
+            and entry["entity_pos"] is self.entity_pos
+        ):
+            telemetry.counter("scoring.code_cache.hits").inc()
+            return entry["codes"], entry["row_bucket"], entry["row_pos"]
+        telemetry.counter("scoring.code_cache.misses").inc()
         idc = data.id_columns[self.id_name]
-        return map_vocab_codes(self.vocab, idc.vocab[idc.codes])
+        codes = map_vocab_codes(self.vocab, idc.vocab[idc.codes])
+        known = codes >= 0
+        safe_codes = np.where(known, codes, 0)
+        row_bucket = np.where(known, self.entity_bucket[safe_codes], -1)
+        row_pos = np.where(known, self.entity_pos[safe_codes], -1)
+        cache[key] = {
+            "vocab": self.vocab,
+            "entity_bucket": self.entity_bucket,
+            "entity_pos": self.entity_pos,
+            "codes": codes,
+            "row_bucket": row_bucket,
+            "row_pos": row_pos,
+        }
+        return codes, row_bucket, row_pos
 
     def to_summary_string(self) -> str:
         n_models = int(np.sum(self.entity_bucket >= 0))
@@ -116,12 +166,8 @@ class RandomEffectModel:
             raise KeyError(f"scoring data lacks id column '{self.id_name}'")
         batch = data.shard(self.shard_name)
         n = data.num_rows
-        codes = self._codes_for(data)  # host [n], -1 for unseen entities
-
-        known = codes >= 0
-        safe_codes = np.where(known, codes, 0)
-        row_bucket = np.where(known, self.entity_bucket[safe_codes], -1)
-        row_pos = np.where(known, self.entity_pos[safe_codes], -1)
+        # host [n] arrays, -1 for unseen entities; memoized per dataset
+        _codes, row_bucket, row_pos = self._grouping_for(data)
 
         vals = np.asarray(batch.values)
         rows = np.asarray(batch.rows)
@@ -131,7 +177,6 @@ class RandomEffectModel:
         # nnz are processed in bounded chunks: the per-nnz [*, K] / [K, *]
         # gathers otherwise materialize O(total_nnz x 128)-padded fusion
         # outputs (a 20M-row shard measured a 51 GB allocation attempt)
-        CHUNK = 8_000_000
         scores = jnp.zeros((batch.num_rows,), dtype=batch.dtype)
         for b_idx, bm in enumerate(self.buckets):
             sel = live & (row_bucket[np.minimum(rows, n - 1)] == b_idx)
@@ -139,8 +184,8 @@ class RandomEffectModel:
                 continue
             sel_idx = np.nonzero(sel)[0]
             K = bm.projection.shape[1]
-            for lo in range(0, len(sel_idx), CHUNK):
-                part = sel_idx[lo:lo + CHUNK]
+            for lo in range(0, len(sel_idx), SCORE_CHUNK):
+                part = sel_idx[lo:lo + SCORE_CHUNK]
                 v = jnp.asarray(vals[part], batch.dtype)
                 r = jnp.asarray(rows[part], jnp.int32)
                 g = jnp.asarray(cols[part], jnp.int32)

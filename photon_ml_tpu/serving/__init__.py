@@ -1,0 +1,55 @@
+"""Online serving: device-resident scoring engine, micro-batching, and a
+hot-swappable model registry.
+
+The batch ``cli score`` driver re-reads data, rebuilds index maps, and
+re-uploads host numpy on every invocation; GLMix-style models exist to be
+served online per member/item (Zhang et al., KDD 2016), and adaptive
+micro-batching with latency deadlines is how accelerator-backed prediction
+services get throughput (Crankshaw et al., Clipper, NSDI 2017). This
+package is the long-lived answer:
+
+- :mod:`photon_ml_tpu.serving.engine` — :class:`ScoringEngine` compiles a
+  trained :class:`GameModel` ONCE into device-resident form (coefficient
+  tables + entity indices uploaded to HBM at load, after a telemetry
+  headroom check) and serves jit-compiled score functions keyed by padded
+  batch-size bucket, all warmed at startup so steady state never
+  recompiles. Unseen entities fall back to fixed-effect-only scores.
+- :mod:`photon_ml_tpu.serving.batcher` — :class:`MicroBatcher` coalesces
+  concurrent requests into padded batches under a ``max_delay_ms``
+  deadline, with queue-depth admission control (:class:`Overloaded`).
+- :mod:`photon_ml_tpu.serving.registry` — :class:`ModelRegistry` watches a
+  versioned models directory (manifest-written-last, same certification
+  idea as ``game/checkpoint.py``), hot-swaps to the newest valid version
+  in the background, and skips past corrupt/partial versions.
+- :mod:`photon_ml_tpu.serving.server` — stdlib HTTP endpoints
+  (``POST /v1/score``, ``GET /healthz``, ``GET /metricsz``) plus a stdio
+  JSONL mode so tests and CI can drive the service without sockets.
+
+Wired to the CLI as ``python -m photon_ml_tpu.cli serve``.
+"""
+
+from photon_ml_tpu.serving.batcher import MicroBatcher, Overloaded  # noqa: F401
+from photon_ml_tpu.serving.engine import BadRequest, ScoringEngine  # noqa: F401
+from photon_ml_tpu.serving.registry import (  # noqa: F401
+    ModelRegistry,
+    publish_version,
+    scan_versions,
+)
+from photon_ml_tpu.serving.server import (  # noqa: F401
+    ScoringServer,
+    ScoringService,
+    serve_stdio,
+)
+
+__all__ = [
+    "ScoringEngine",
+    "BadRequest",
+    "MicroBatcher",
+    "Overloaded",
+    "ModelRegistry",
+    "publish_version",
+    "scan_versions",
+    "ScoringService",
+    "ScoringServer",
+    "serve_stdio",
+]

@@ -1,0 +1,482 @@
+"""Device-resident scoring engine: compile the model once, serve forever.
+
+The batch scorer (``RandomEffectModel.score``) redoes host-side bucket
+grouping and re-uploads coefficient tables on every call — fine for one
+pass over a dataset, fatal for a request path. :class:`ScoringEngine`
+instead:
+
+- uploads the model ONCE at load: fixed-effect weight vectors plus
+  per-coordinate random-effect coefficient tables and projections go to
+  HBM (after :func:`telemetry.memory.check_headroom` predicts the upload
+  fits), and the entity-id -> (bucket, position) lookup stays host-side;
+- serves requests through ONE jit-compiled score function whose traces
+  are keyed by padded batch-size bucket (powers of two up to
+  ``max_batch``); :meth:`warmup` executes every bucket at startup so
+  steady state never recompiles. The compiled function is shared via an
+  ``lru_cache`` keyed by model STRUCTURE, so hot-swapping to a same-shaped
+  model version reuses the existing executable outright;
+- scores entities unseen at training time as fixed-effect-only
+  (the random-effect contribution is 0), matching
+  ``RandomEffectModel.score``'s unseen-entity semantics exactly.
+
+This module is a serving HOT PATH: tools/check.py lint L010 rejects
+device->host syncs here (``jax.device_get``, ``float()`` on arrays,
+``np.asarray`` on jax arrays) — the one sanctioned fetch is
+``telemetry.sync_fetch``.
+
+Request row schema (JSON-safe)::
+
+    {"features": {"<shard>": [[col, value], ...]},   # training feature ids
+     "ids": {"<id_name>": "<entity value>"},
+     "offset": 0.0}
+
+Features may instead be named — ``[name, term, value]`` or
+``{"name": ..., "term": ..., "value": ...}`` — and are then resolved
+through the model's persisted ``feature-indexes/`` maps (unknown names
+score 0 and count ``serving.unknown_features``, the index-map default
+semantics of training).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.data.index_map import feature_key
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.ops.losses import get_loss
+
+
+class BadRequest(ValueError):
+    """A score request is malformed (unknown shard schema, feature count
+    over ``max_row_nnz``, unresolvable named feature without an index
+    map). Servers map this to HTTP 400, never 500."""
+
+
+def bucket_sizes_for(max_batch: int) -> tuple[int, ...]:
+    """Padded batch-size buckets: powers of two up to (and always
+    including) ``max_batch`` — each bucket is one compiled trace."""
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1")
+    sizes = []
+    b = 1
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch)
+    return tuple(sizes)
+
+
+@functools.lru_cache(maxsize=32)  # bounded: a long-lived server swapping
+# structurally different versions must not accumulate executables forever
+def _compiled_score_fn(link: str, coords: tuple):
+    """One jitted score function per model STRUCTURE.
+
+    ``coords`` is a static spec per coordinate: ``("fixed", shard_idx)``
+    or ``("re", shard_idx, num_buckets)``. Table VALUES arrive as traced
+    arguments, so two model versions with the same structure (the common
+    hot-swap case: retrained coefficients, same entities/features) share
+    one executable and swap with ZERO recompiles. Batch size and table
+    shapes are read off the traced arguments — each padded bucket size is
+    its own trace inside the one jit cache.
+    """
+    re_slots = {}
+    for ci, spec in enumerate(coords):
+        if spec[0] == "re":
+            re_slots[ci] = len(re_slots)
+
+    def fn(offsets, shards, re_inputs, tables):
+        batch = offsets.shape[0]
+        total = jnp.zeros((batch,), jnp.float32)
+        for ci, spec in enumerate(coords):
+            values, rows, cols = shards[spec[1]]
+            if spec[0] == "fixed":
+                contrib = values * jnp.take(tables[ci], cols, fill_value=0)
+            else:
+                row_bucket, row_pos = re_inputs[re_slots[ci]]
+                bkt_n = row_bucket[rows]  # padded rows -> row batch-1: -1
+                pos_n = row_pos[rows]
+                contrib = jnp.zeros_like(values)
+                for b_idx, (proj, coef) in enumerate(tables[ci]):
+                    num_entities, local_dim = proj.shape
+                    p = jnp.clip(pos_n, 0, num_entities - 1)
+                    if local_dim <= 64:
+                        # transposed compare-scan (the K<=64 kernel of
+                        # RandomEffectModel.score): each column matches at
+                        # most one projection slot, so the masked sum IS
+                        # the coefficient lookup
+                        w_n = jnp.sum(
+                            jnp.where(
+                                proj.T[:, p] == cols[None, :],
+                                coef.T[:, p],
+                                0.0,
+                            ),
+                            axis=0,
+                        )
+                    else:
+                        proj_rows = proj[p]
+                        k = jax.vmap(jnp.searchsorted)(proj_rows, cols)
+                        k = jnp.minimum(k, local_dim - 1)
+                        hit = (
+                            jnp.take_along_axis(
+                                proj_rows, k[:, None], axis=1
+                            )[:, 0]
+                            == cols
+                        )
+                        w_n = jnp.where(
+                            hit,
+                            jnp.take_along_axis(
+                                coef[p], k[:, None], axis=1
+                            )[:, 0],
+                            0.0,
+                        )
+                    contrib = contrib + jnp.where(
+                        bkt_n == b_idx, values * w_n, 0.0
+                    )
+            total = total + jax.ops.segment_sum(
+                contrib, rows, num_segments=batch, indices_are_sorted=True
+            )
+        scores = total + offsets
+        if link == "logistic":
+            return jax.nn.sigmoid(scores)
+        if link == "poisson":
+            return jnp.exp(scores)
+        return scores
+
+    return jax.jit(fn)
+
+
+class ScoringEngine:
+    """A :class:`GameModel` compiled into long-lived, device-resident
+    scoring form. Immutable after construction — the registry hot-swaps
+    by replacing the engine reference while in-flight requests finish on
+    the old one."""
+
+    def __init__(
+        self,
+        model: GameModel,
+        index_maps: Optional[Mapping] = None,
+        max_batch: int = 64,
+        max_row_nnz: int = 128,
+        version: str = "unversioned",
+    ):
+        if max_row_nnz < 1:
+            raise ValueError("max_row_nnz must be >= 1")
+        self.model = model
+        self.version = version
+        self.max_batch = int(max_batch)
+        self.max_row_nnz = int(max_row_nnz)
+        self.task = model.task
+        self.bucket_sizes = bucket_sizes_for(self.max_batch)
+        self.warm = False
+        self._link = get_loss(model.task).name
+        self._index_maps = dict(index_maps or {})
+
+        shard_names: list[str] = []
+        shard_dims: dict[str, Optional[int]] = {}
+        coords: list[tuple] = []
+        host_tables: list = []
+        re_hosts: list[tuple] = []
+        predicted_bytes = 0
+        for name, sub in model.models.items():
+            if isinstance(sub, FixedEffectModel):
+                si = self._shard_slot(shard_names, sub.shard_name)
+                shard_dims[sub.shard_name] = int(sub.coefficients.shape[0])
+                coords.append(("fixed", si))
+                host_tables.append(sub.coefficients)
+                predicted_bytes += telemetry.memory.estimate_table_bytes(
+                    1, sub.coefficients.shape[0]
+                )
+            elif isinstance(sub, RandomEffectModel):
+                si = self._shard_slot(shard_names, sub.shard_name)
+                coords.append(("re", si, len(sub.buckets)))
+                host_tables.append(
+                    tuple(
+                        (bm.projection, bm.coefficients) for bm in sub.buckets
+                    )
+                )
+                for bm in sub.buckets:
+                    num_e, local_k = bm.coefficients.shape
+                    # coefficients + int32 projection, both 4-byte
+                    predicted_bytes += 2 * telemetry.memory.estimate_table_bytes(
+                        num_e, local_k
+                    )
+                re_hosts.append(
+                    (
+                        sub.id_name,
+                        {str(v): i for i, v in enumerate(sub.vocab.tolist())},
+                        np.array(sub.entity_bucket, dtype=np.int32),
+                        np.array(sub.entity_pos, dtype=np.int32),
+                    )
+                )
+            else:
+                raise TypeError(
+                    f"coordinate '{name}': online serving supports fixed and "
+                    f"random effects, not {type(sub).__name__}"
+                )
+        if not coords:
+            raise ValueError("GAME model has no sub-models")
+        self._shard_names = tuple(shard_names)
+        self._coords = tuple(coords)
+        self._re_hosts = tuple(re_hosts)
+        # per-shard feature-space bound for request validation: an
+        # out-of-range id would be silently dropped by the clamped device
+        # gathers (the silent-wrong-scores hazard). FE coefficients give
+        # the exact dim; an index map gives it for RE-only shards; None
+        # (no FE, no map) leaves that shard unchecked.
+        self._shard_dims = tuple(
+            shard_dims.get(s)
+            if shard_dims.get(s) is not None
+            else (len(self._index_maps[s]) if s in self._index_maps else None)
+            for s in self._shard_names
+        )
+
+        # predict the upload BEFORE it happens: a model too big for free
+        # HBM should warn at load, not OOM the first request
+        telemetry.memory.check_headroom(
+            predicted_bytes, label=f"serving model {version}"
+        )
+        uploaded = []
+        for t in host_tables:
+            if isinstance(t, tuple):
+                uploaded.append(
+                    tuple(
+                        (
+                            jnp.asarray(proj, jnp.int32),
+                            jnp.asarray(coef, jnp.float32),
+                        )
+                        for proj, coef in t
+                    )
+                )
+            else:
+                uploaded.append(jnp.asarray(t, jnp.float32))
+        self._tables = tuple(uploaded)
+        self._fn = _compiled_score_fn(self._link, self._coords)
+        telemetry.gauge("serving.model_bytes").set(predicted_bytes)
+
+    @staticmethod
+    def _shard_slot(shard_names: list[str], name: str) -> int:
+        if name not in shard_names:
+            shard_names.append(name)
+        return shard_names.index(name)
+
+    # -- loading -------------------------------------------------------------
+
+    @classmethod
+    def load(
+        cls,
+        model_dir: str,
+        max_batch: int = 64,
+        max_row_nnz: int = 128,
+        version: Optional[str] = None,
+        require_feature_indexes: bool = True,
+    ) -> "ScoringEngine":
+        """Build an engine from a saved model directory.
+
+        ``feature-indexes/`` is REQUIRED by default: without the training
+        feature space pinned next to the coefficients, named features
+        cannot be resolved and integer ids cannot be trusted — the
+        silent-wrong-scores hazard the batch driver only warned about.
+        """
+        from photon_ml_tpu.data.model_store import (
+            ModelLoadError,
+            load_feature_index_maps,
+            load_game_model,
+        )
+
+        index_maps = load_feature_index_maps(model_dir)
+        if index_maps is None and require_feature_indexes:
+            raise ModelLoadError(
+                os.path.join(model_dir, "feature-indexes"),
+                "missing feature-indexes/ — the serving feature space "
+                "cannot be pinned to the stored coefficients, so scores "
+                "would be silently wrong",
+            )
+        model = load_game_model(model_dir)
+        return cls(
+            model,
+            index_maps=index_maps,
+            max_batch=max_batch,
+            max_row_nnz=max_row_nnz,
+            version=version or os.path.basename(os.path.normpath(model_dir)),
+        )
+
+    # -- request assembly ----------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        return self.max_batch
+
+    def _resolve_feature(self, shard: str, feat):
+        """-> (col, value) in the training feature space, or None for a
+        named feature the training index never saw (scores 0, like the
+        index-map default at training time)."""
+        if isinstance(feat, Mapping):
+            name, term, value = (
+                feat.get("name"),
+                feat.get("term", ""),
+                feat.get("value"),
+            )
+            if name is None or value is None:
+                raise BadRequest(
+                    f"named feature on shard '{shard}' needs 'name' and "
+                    f"'value' keys"
+                )
+        elif isinstance(feat, (list, tuple)) and len(feat) == 2:
+            col, value = feat
+            if isinstance(col, str):
+                name, term = col, ""
+            else:
+                return int(col), value
+        elif isinstance(feat, (list, tuple)) and len(feat) == 3:
+            name, term, value = feat
+        else:
+            raise BadRequest(
+                f"feature on shard '{shard}' must be [col, value], "
+                f"[name, term, value], or a name/term/value object"
+            )
+        imap = self._index_maps.get(shard)
+        if imap is None:
+            raise BadRequest(
+                f"named feature on shard '{shard}' but the model has no "
+                f"feature index for it — send [col, value] pairs instead"
+            )
+        col = imap.get(feature_key(str(name), str(term or "")), -1)
+        if col < 0:
+            telemetry.counter("serving.unknown_features").inc()
+            return None
+        return int(col), value
+
+    def _assemble(self, rows_batch: Sequence[Mapping], batch: int):
+        """Pad ``rows_batch`` into the fixed-shape device inputs of one
+        batch-size bucket (host numpy; uploaded at the jit boundary)."""
+        per_shard = [([], [], []) for _ in self._shard_names]
+        offsets = np.zeros((batch,), np.float32)
+        for i, row in enumerate(rows_batch):
+            if not isinstance(row, Mapping):
+                raise BadRequest(f"row {i} must be an object")
+            try:
+                offsets[i] = row.get("offset") or 0.0
+            except (TypeError, ValueError):
+                raise BadRequest(
+                    f"row {i}: 'offset' must be a number"
+                ) from None
+            feats = row.get("features") or {}
+            if not isinstance(feats, Mapping):
+                raise BadRequest(f"row {i}: 'features' must be an object")
+            unknown = set(feats) - set(self._shard_names)
+            if unknown:
+                # silently dropping a typo'd shard name would score
+                # fixed-effect-of-nothing — the silent-wrong-scores hazard
+                raise BadRequest(
+                    f"row {i}: unknown feature shard(s) {sorted(unknown)}; "
+                    f"model has {sorted(self._shard_names)}"
+                )
+            for s_idx, s_name in enumerate(self._shard_names):
+                flist = feats.get(s_name) or ()
+                if len(flist) > self.max_row_nnz:
+                    raise BadRequest(
+                        f"row {i}: {len(flist)} features on shard "
+                        f"'{s_name}' exceeds max_row_nnz={self.max_row_nnz}"
+                    )
+                vals, rws, cls = per_shard[s_idx]
+                dim = self._shard_dims[s_idx]
+                for feat in flist:
+                    resolved = self._resolve_feature(s_name, feat)
+                    if resolved is None:
+                        continue
+                    col = resolved[0]
+                    if col < 0 or (dim is not None and col >= dim):
+                        raise BadRequest(
+                            f"row {i}: feature id {col} is outside shard "
+                            f"'{s_name}' (features: "
+                            f"{dim if dim is not None else 'unknown'})"
+                        )
+                    vals.append(resolved[1])
+                    rws.append(i)
+                    cls.append(col)
+        shards = []
+        nnz_pad = batch * self.max_row_nnz
+        for vals, rws, cls in per_shard:
+            v = np.zeros((nnz_pad,), np.float32)
+            try:
+                v[: len(vals)] = vals
+            except (TypeError, ValueError):
+                raise BadRequest("feature values must be numbers") from None
+            # padding points at the LAST row (keeps rows non-decreasing
+            # for indices_are_sorted, same convention as SparseBatch)
+            r = np.full((nnz_pad,), batch - 1, np.int32)
+            r[: len(rws)] = rws
+            c = np.zeros((nnz_pad,), np.int32)
+            c[: len(cls)] = cls
+            shards.append((v, r, c))
+        re_inputs = []
+        for id_name, lookup, entity_bucket, entity_pos in self._re_hosts:
+            bkt = np.full((batch,), -1, np.int32)
+            pos = np.full((batch,), -1, np.int32)
+            for i, row in enumerate(rows_batch):
+                ids = row.get("ids") or {}
+                value = ids.get(id_name)
+                if value is None:
+                    continue
+                code = lookup.get(str(value), -1)
+                if code < 0:
+                    # unseen entity: fixed-effect-only fallback (scores 0
+                    # from this coordinate, RandomEffectModel semantics)
+                    telemetry.counter("serving.unseen_entities").inc()
+                    continue
+                bkt[i] = entity_bucket[code]
+                pos[i] = entity_pos[code]
+            re_inputs.append((bkt, pos))
+        return offsets, tuple(shards), tuple(re_inputs)
+
+    # -- scoring -------------------------------------------------------------
+
+    def score_rows(self, rows: Sequence[Mapping]) -> np.ndarray:
+        """Mean predictions (post-link, offset included — the
+        ``GameModel.predict_mean`` contract) for ``rows``; chunks
+        internally when a request exceeds ``max_batch``."""
+        if not rows:
+            return np.zeros((0,), np.float32)
+        parts = []
+        for lo in range(0, len(rows), self.max_batch):
+            chunk = rows[lo : lo + self.max_batch]
+            t0 = time.monotonic()
+            batch = self._bucket_for(len(chunk))
+            inputs = self._assemble(chunk, batch)
+            preds = self._fn(*inputs, self._tables)
+            host = telemetry.sync_fetch(preds, label="serving.scores")
+            dt_ms = (time.monotonic() - t0) * 1000.0
+            telemetry.histogram("serving.device_ms").observe(dt_ms)
+            telemetry.counter("serving.scored_rows").inc(len(chunk))
+            parts.append(host[: len(chunk)])
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    def warmup(self) -> "ScoringEngine":
+        """Execute every batch-size bucket once so all traces compile at
+        load time — after this, steady-state serving never recompiles
+        (asserted via the flat ``jit_compiles`` counter in tests)."""
+        with telemetry.span(
+            "serving:warmup", version=self.version,
+            buckets=len(self.bucket_sizes),
+        ):
+            for b in self.bucket_sizes:
+                inputs = self._assemble((), b)
+                telemetry.sync_fetch(
+                    self._fn(*inputs, self._tables), label="serving.warmup"
+                )
+        self.warm = True
+        return self

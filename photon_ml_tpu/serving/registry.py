@@ -1,0 +1,238 @@
+"""Hot-swappable model registry: watch a versioned models directory, swap
+to the newest valid version in the background, never downgrade, never
+serve a partial write.
+
+Layout (one directory per published version)::
+
+    <registry_dir>/
+      v-00000001/
+        model-metadata.json         written LAST (completeness certificate)
+        feature-indexes/<shard>/    REQUIRED: the pinned training feature
+                                    space (versions without it are refused
+                                    outright — the silent-wrong-scores
+                                    hazard of rebuilding indices at serve
+                                    time)
+        fixed-effect/... random-effect/...
+      v-00000002/
+      .tmp-v-00000003/              in-flight publish (ignored by scans)
+
+Atomicity follows ``game/checkpoint.py``: :func:`publish_version`
+assembles a ``.tmp-v-*`` sibling (index maps first, then the model store
+save, whose metadata lands last) and ``os.rename``s it into place, so a
+scanner never observes a partial version. :meth:`ModelRegistry.refresh`
+walks versions NEWEST-first, skips corrupt/partial/unloadable ones with a
+warning + ``serving.skipped_versions`` counter (exactly the checkpoint
+restore fallback), builds + warms the engine OFF the request path, and
+only then swaps the engine reference — in-flight requests finish on the
+old engine, which the swap never mutates.
+
+Telemetry: ``serving.model_swaps`` counter, ``serving.model_version``
+gauge, ``serving.skipped_versions`` counter.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import shutil
+import threading
+from typing import Mapping, Optional
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.serving.engine import ScoringEngine
+from photon_ml_tpu.utils.atomic import fsync_dir
+
+logger = logging.getLogger("photon_ml_tpu.serving.registry")
+
+_VERSION_RE = re.compile(r"^v-(\d{8})$")
+_METADATA_FILE = "model-metadata.json"
+
+
+def version_dirname(version: int) -> str:
+    return f"v-{version:08d}"
+
+
+def scan_versions(directory: str) -> list[tuple[int, str]]:
+    """(version, path) for every published version, oldest first; tmp
+    dirs and foreign names are ignored."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    out = []
+    for name in names:
+        m = _VERSION_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def publish_version(
+    directory: str,
+    model,
+    index_maps: Mapping,
+    version: Optional[int] = None,
+    extra_metadata: Optional[dict] = None,
+) -> str:
+    """Atomically publish ``model`` as the next registry version.
+
+    ``index_maps`` (shard name -> IndexMap or sequence of feature names)
+    is REQUIRED: the registry refuses versions without a pinned feature
+    space. The version directory is assembled in a ``.tmp-v-*`` sibling
+    and renamed into place — watchers see the complete version or nothing.
+    """
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.data.model_store import save_game_model
+
+    if not index_maps:
+        raise ValueError(
+            "index_maps is required: a served version must pin the training "
+            "feature space next to its coefficients"
+        )
+    os.makedirs(directory, exist_ok=True)
+    if version is None:
+        existing = scan_versions(directory)
+        version = existing[-1][0] + 1 if existing else 1
+    final = os.path.join(directory, version_dirname(version))
+    if os.path.exists(final):
+        raise FileExistsError(f"version already published: {final}")
+    tmp = os.path.join(directory, ".tmp-" + version_dirname(version))
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    for shard, imap in index_maps.items():
+        if not isinstance(imap, IndexMap):
+            imap = IndexMap(list(imap))
+        imap.save(os.path.join(tmp, "feature-indexes", shard))
+    # model-metadata.json lands last inside tmp (save_game_model order)
+    save_game_model(model, tmp, extra_metadata=extra_metadata)
+    os.rename(tmp, final)
+    fsync_dir(directory)
+    return final
+
+
+class ModelRegistry:
+    """Background-refreshed source of the current :class:`ScoringEngine`."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_batch: int = 64,
+        max_row_nnz: int = 128,
+        poll_interval: float = 2.0,
+        warm: bool = True,
+    ):
+        self.directory = directory
+        self.max_batch = max_batch
+        self.max_row_nnz = max_row_nnz
+        self.poll_interval = poll_interval
+        self.warm = warm
+        self._engine: Optional[ScoringEngine] = None
+        self._version = -1
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # (path -> mtime) of versions that failed to load: a persistently
+        # corrupt newest version is skipped silently on later polls instead
+        # of re-reading/re-warning every interval; retried when it changes
+        self._skipped: dict[str, float] = {}
+
+    @property
+    def engine(self) -> ScoringEngine:
+        with self._lock:
+            if self._engine is None:
+                raise RuntimeError(
+                    f"no valid model version loaded from {self.directory}"
+                )
+            return self._engine
+
+    @property
+    def current_version(self) -> Optional[str]:
+        with self._lock:
+            return self._engine.version if self._engine is not None else None
+
+    # -- refresh -------------------------------------------------------------
+
+    def refresh(self) -> bool:
+        """Load the newest valid version newer than the current one.
+
+        Walks newest-first and falls back past corrupt/partial/unloadable
+        versions (missing metadata or feature-indexes, truncated npz,
+        unsupported sub-model types) — the checkpoint-restore fallback.
+        Returns True when a swap happened."""
+        with self._lock:
+            current = self._version
+        for version, path in reversed(scan_versions(self.directory)):
+            if version <= current:
+                return False
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                mtime = -1.0
+            if self._skipped.get(path) == mtime:
+                continue  # known-bad and unchanged since the last attempt
+            try:
+                engine = ScoringEngine.load(
+                    path,
+                    max_batch=self.max_batch,
+                    max_row_nnz=self.max_row_nnz,
+                    version=version_dirname(version),
+                )
+                if self.warm:
+                    engine.warmup()
+            except (ValueError, OSError, TypeError, KeyError) as e:
+                # ModelLoadError is a ValueError; OSError covers a
+                # half-deleted directory; TypeError an unservable model
+                self._skipped[path] = mtime
+                telemetry.counter("serving.skipped_versions").inc()
+                logger.warning("skipping unusable model version %s: %s",
+                               path, e)
+                continue
+            self._skipped.pop(path, None)
+            with self._lock:
+                if version <= self._version:  # raced with another refresh
+                    return False
+                old = self._engine
+                self._engine = engine
+                self._version = version
+            telemetry.counter("serving.model_swaps").inc()
+            telemetry.gauge("serving.model_version").set(version)
+            logger.info(
+                "serving model version %s%s", engine.version,
+                f" (replacing {old.version})" if old is not None else "",
+            )
+            return True
+        return False
+
+    # -- background watcher --------------------------------------------------
+
+    def start(self) -> "ModelRegistry":
+        """Load the newest valid version NOW (raising if none exists) and
+        start the background poll thread."""
+        self.refresh()
+        with self._lock:
+            if self._engine is None:
+                raise RuntimeError(
+                    f"no valid model version under {self.directory}"
+                )
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._poll_loop, name="model-registry", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.refresh()
+            except Exception:  # noqa: BLE001 — the watcher must survive
+                logger.exception("model registry refresh failed")

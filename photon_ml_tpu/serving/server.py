@@ -1,0 +1,237 @@
+"""Serving front ends: a stdlib threading HTTP server and a stdio JSONL
+mode (so tests and tier-1 CI drive the full request schema without
+sockets).
+
+Endpoints:
+
+- ``POST /v1/score`` — body ``{"rows": [<row>, ...]}`` (see
+  :mod:`photon_ml_tpu.serving.engine` for the row schema); responds
+  ``{"scores": [...], "model_version": "v-..."}``. Requests flow through
+  the :class:`MicroBatcher`, so concurrent callers share device batches.
+  Overload -> 503 ``{"error": "overloaded"}``; malformed rows -> 400.
+- ``GET /healthz`` — ``{"status", "model_version", "warm", "buckets"}``.
+- ``GET /metricsz`` — the full telemetry ``snapshot()``.
+
+The stdio mode reads one JSON object per stdin line (``{"rows": [...]}``
+scores; ``{"op": "health"}`` / ``{"op": "metrics"}`` introspect) and
+writes one JSON response line to stdout; it scores directly on the engine
+(no batcher threads) so a driver loop is deterministic.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Mapping, Optional
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.serving.batcher import MicroBatcher, Overloaded
+from photon_ml_tpu.serving.engine import BadRequest, ScoringEngine
+
+logger = logging.getLogger("photon_ml_tpu.serving.server")
+
+
+def _engine_of(source) -> ScoringEngine:
+    """Accept a bare engine or anything with an ``.engine`` property
+    (the ModelRegistry), so one front end serves both static and
+    hot-swapped deployments."""
+    return source.engine if hasattr(source, "engine") else source
+
+
+class ScoringService:
+    """Engine-or-registry + micro-batcher glue shared by HTTP and stdio.
+
+    The batcher's scorer resolves the CURRENT engine at dispatch time, so
+    a registry swap takes effect on the next batch while the batch already
+    in flight finishes on the engine reference it grabbed."""
+
+    def __init__(
+        self,
+        source,
+        max_batch: int = 64,
+        max_delay_ms: float = 5.0,
+        queue_depth: int = 256,
+        request_timeout_s: float = 30.0,
+    ):
+        self._source = source
+        self.request_timeout_s = request_timeout_s
+        self._batcher = MicroBatcher(
+            self._score,
+            max_batch=max_batch,
+            max_delay_ms=max_delay_ms,
+            queue_depth=queue_depth,
+        )
+
+    def _score(self, rows):
+        engine = _engine_of(self._source)
+        return engine.score_rows(rows), engine.version
+
+    def start(self) -> "ScoringService":
+        self._batcher.start()
+        return self
+
+    def stop(self) -> None:
+        self._batcher.stop()
+
+    def score_request(self, payload: Mapping) -> dict:
+        rows = payload.get("rows") if isinstance(payload, Mapping) else None
+        if not isinstance(rows, list):
+            raise BadRequest('request body must be {"rows": [...]}')
+        future = self._batcher.submit(rows)
+        try:
+            result = future.result(timeout=self.request_timeout_s)
+        except FutureTimeout:
+            # nobody will read this result: cancel so the dispatcher drops
+            # the unit instead of scoring dead work under overload
+            future.cancel()
+            raise
+        return {
+            "scores": [round(float(s), 8) for s in result["scores"]],
+            "model_version": result["model_version"],
+        }
+
+    def health(self) -> dict:
+        try:
+            engine = _engine_of(self._source)
+        except RuntimeError as e:
+            return {"status": "loading", "model_version": None,
+                    "warm": False, "detail": str(e)}
+        return {
+            "status": "serving",
+            "model_version": engine.version,
+            "warm": engine.warm,
+            "buckets": list(engine.bucket_sizes),
+            "task": engine.task,
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "photon-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: requests go to telemetry
+        logger.debug(fmt, *args)
+
+    def _reply(self, code: int, obj) -> None:
+        body = json.dumps(obj, default=float).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        service: ScoringService = self.server.service  # type: ignore[attr-defined]
+        if self.path == "/healthz":
+            self._reply(200, service.health())
+        elif self.path == "/metricsz":
+            self._reply(200, telemetry.snapshot())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        service: ScoringService = self.server.service  # type: ignore[attr-defined]
+        if self.path != "/v1/score":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except ValueError:
+            self._reply(400, {"error": "bad_request",
+                              "detail": "body is not valid JSON"})
+            return
+        try:
+            self._reply(200, service.score_request(payload))
+        except Overloaded as e:
+            self._reply(503, {"error": "overloaded", "detail": str(e)})
+        except BadRequest as e:
+            self._reply(400, {"error": "bad_request", "detail": str(e)})
+        except FutureTimeout:
+            self._reply(504, {"error": "timeout"})
+        except Exception as e:  # noqa: BLE001 — a request must not kill the server
+            logger.exception("score request failed")
+            self._reply(500, {"error": "internal", "detail": str(e)})
+
+
+class ScoringServer:
+    """``ThreadingHTTPServer`` wrapper owning the service lifecycle."""
+
+    def __init__(self, service: ScoringService, host: str = "127.0.0.1",
+                 port: int = 8080):
+        self.service = service
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.service = service  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "ScoringServer":
+        self.service.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="scoring-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.service.stop()
+
+
+def serve_stdio(source, inp, out) -> int:
+    """JSONL request/response loop over text streams (no sockets, no
+    batcher threads — deterministic for CI drivers). Returns 0 at EOF."""
+    for line in inp:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            request = json.loads(line)
+        except ValueError as e:
+            out.write(json.dumps({"error": f"bad JSON: {e}"}) + "\n")
+            out.flush()
+            continue
+        try:
+            op = request.get("op") if isinstance(request, Mapping) else None
+            if op == "health":
+                engine = _engine_of(source)
+                response = {
+                    "status": "serving",
+                    "model_version": engine.version,
+                    "warm": engine.warm,
+                    "buckets": list(engine.bucket_sizes),
+                }
+            elif op == "metrics":
+                response = telemetry.snapshot()
+            else:
+                rows = (
+                    request.get("rows")
+                    if isinstance(request, Mapping) else None
+                )
+                if not isinstance(rows, list):
+                    raise BadRequest(
+                        'each line must be {"rows": [...]} or {"op": ...}'
+                    )
+                engine = _engine_of(source)
+                telemetry.counter("serving.requests").inc()
+                scores = engine.score_rows(rows)
+                response = {
+                    "scores": [round(float(s), 8) for s in scores],
+                    "model_version": engine.version,
+                }
+        except (BadRequest, ValueError, RuntimeError) as e:
+            response = {"error": str(e)}
+        out.write(json.dumps(response) + "\n")
+        out.flush()
+    return 0

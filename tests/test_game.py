@@ -392,3 +392,94 @@ def test_re_box_constraints_respected_and_match_reference(rng):
     proj = np.asarray(bm.projection[pos])
     w_game = np.asarray(bm.coefficients[pos])[np.searchsorted(proj, support)]
     np.testing.assert_allclose(w_game, np.asarray(ref.w), rtol=2e-2, atol=2e-2)
+
+
+def _trained_re_model(rng, n=250, n_users=7):
+    """(dataset, model, Xu) for the RE scoring-kernel tests below."""
+    gds, _Xg, Xu, _users, _wg, _wu = _glmix_data(rng, n=n, n_users=n_users)
+    red = build_random_effect_dataset(gds, "userId", "user")
+    coord = RandomEffectCoordinate("per-user", gds, red, "logistic", _CFG)
+    model = coord.update_model(coord.initialize_model(), None)
+    return gds, model, Xu
+
+
+def _pad_local_dim(model, num_global, new_k):
+    """The same RE model with every bucket's local dim padded to ``new_k``
+    (sentinel projections, zero coefficients) — semantically identical,
+    but scored through the K>64 searchsorted kernel when new_k > 64."""
+    import dataclasses
+
+    buckets = []
+    for bm in model.buckets:
+        num_e, k = bm.projection.shape
+        proj = np.full((num_e, new_k), num_global, np.int32)
+        proj[:, :k] = np.asarray(bm.projection)
+        coef = np.zeros((num_e, new_k), np.float32)
+        coef[:, :k] = np.asarray(bm.coefficients)
+        buckets.append(
+            dataclasses.replace(
+                bm,
+                projection=jnp.asarray(proj),
+                coefficients=jnp.asarray(coef),
+                variances=None,
+            )
+        )
+    return dataclasses.replace(model, buckets=tuple(buckets))
+
+
+def test_re_score_kernel_parity_compare_scan_vs_searchsorted(rng):
+    """K<=64 (transposed compare-scan) and K>64 (vmapped searchsorted)
+    paths must agree on the same data: pad the projection past the kernel
+    switchover with sentinels and assert identical scores."""
+    gds, model, Xu = _trained_re_model(rng)
+    small_k = np.asarray(model.score(gds))[: gds.num_rows]
+    assert model.buckets[0].projection.shape[1] <= 64  # compare-scan path
+    padded = _pad_local_dim(model, num_global=Xu.shape[1], new_k=65)
+    assert padded.buckets[0].projection.shape[1] > 64  # searchsorted path
+    large_k = np.asarray(padded.score(gds))[: gds.num_rows]
+    np.testing.assert_allclose(small_k, large_k, rtol=1e-6, atol=1e-6)
+
+
+def test_re_score_chunk_boundary(rng, monkeypatch):
+    """Scores must not depend on the nnz chunking: shrink SCORE_CHUNK so
+    every bucket crosses the boundary several times and compare against
+    the unchunked result."""
+    from photon_ml_tpu.game import models as models_mod
+
+    gds, model, _Xu = _trained_re_model(rng)
+    unchunked = np.asarray(model.score(gds))[: gds.num_rows]
+    nnz = int(np.sum(np.asarray(gds.shard("user").values) != 0))
+    assert nnz > 7  # the patched chunk really splits the work
+    monkeypatch.setattr(models_mod, "SCORE_CHUNK", 7)
+    chunked = np.asarray(model.score(gds))[: gds.num_rows]
+    np.testing.assert_allclose(chunked, unchunked, rtol=1e-6, atol=1e-6)
+
+
+def test_re_grouping_memoized_per_model_and_dataset(rng):
+    """Repeated scoring of one dataset must not redo the host-side
+    vocabulary join / bucket grouping (validation every CD iteration);
+    a DIFFERENT model on the same dataset must not reuse stale arrays."""
+    from photon_ml_tpu import telemetry
+
+    gds, model, Xu = _trained_re_model(rng)
+    counters = lambda: telemetry.snapshot()["counters"]  # noqa: E731
+    model.score(gds)
+    assert counters().get("scoring.code_cache.misses", 0) == 1
+    first = model._codes_for(gds)
+    second = model._codes_for(gds)
+    assert first is second  # cached object, not a recomputed copy
+    model.score(gds)
+    assert counters().get("scoring.code_cache.misses", 0) == 1
+    assert counters().get("scoring.code_cache.hits", 0) >= 3
+    # a different model (its own vocab/placement identities) recomputes
+    other = _pad_local_dim(model, num_global=Xu.shape[1], new_k=65)
+    other = other.__class__(
+        id_name=other.id_name,
+        shard_name=other.shard_name,
+        buckets=other.buckets,
+        entity_bucket=other.entity_bucket.copy(),
+        entity_pos=other.entity_pos.copy(),
+        vocab=other.vocab.copy(),
+    )
+    other.score(gds)
+    assert counters().get("scoring.code_cache.misses", 0) == 2

@@ -1,0 +1,649 @@
+"""Online serving subsystem: engine parity with the batch scorer,
+micro-batching + admission control, registry hot-swap/fallback, HTTP and
+stdio front ends, and the steady-state no-recompile guarantee."""
+
+import io
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.data.model_store import ModelLoadError, save_game_model
+from photon_ml_tpu.game.dataset import build_game_dataset
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectBucketModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.serving import (
+    BadRequest,
+    MicroBatcher,
+    ModelRegistry,
+    Overloaded,
+    ScoringEngine,
+    ScoringServer,
+    ScoringService,
+    publish_version,
+    serve_stdio,
+)
+from photon_ml_tpu.testing import generate_game_dataset
+
+
+def _make_model(truth, scale=1.0, n_buckets=2, task="logistic"):
+    """FE + per-user RE GameModel straight from planted coefficients."""
+    w_users = truth["w_users"] * scale
+    n_users, local_k = w_users.shape
+    fe = FixedEffectModel(
+        coefficients=jnp.asarray(truth["w_global"] * scale, jnp.float32),
+        shard_name="global",
+    )
+    entity_bucket = (np.arange(n_users) % n_buckets).astype(np.int64)
+    entity_pos = np.zeros(n_users, np.int64)
+    buckets = []
+    for b in range(n_buckets):
+        codes_b = np.nonzero(entity_bucket == b)[0]
+        entity_pos[codes_b] = np.arange(len(codes_b))
+        proj = np.tile(np.arange(local_k, dtype=np.int32), (len(codes_b), 1))
+        buckets.append(
+            RandomEffectBucketModel(
+                coefficients=jnp.asarray(w_users[codes_b], jnp.float32),
+                projection=jnp.asarray(proj),
+                entity_codes=jnp.asarray(codes_b, jnp.int32),
+            )
+        )
+    re = RandomEffectModel(
+        id_name="userId",
+        shard_name="user",
+        buckets=tuple(buckets),
+        entity_bucket=entity_bucket,
+        entity_pos=entity_pos,
+        vocab=np.arange(n_users),
+    )
+    return GameModel(task=task, models={"fixed": fe, "perUser": re})
+
+
+def _request_rows(truth, data, indices):
+    """The dataset's rows re-expressed in the serving request schema."""
+    Xg, Xu, users = truth["Xg"], truth["Xu"], truth["users"]
+    rows = []
+    for i in indices:
+        rows.append(
+            {
+                "features": {
+                    "global": [
+                        [j, float(Xg[i, j])]
+                        for j in range(Xg.shape[1])
+                        if Xg[i, j] != 0
+                    ],
+                    "user": [
+                        [j, float(Xu[i, j])]
+                        for j in range(Xu.shape[1])
+                        if Xu[i, j] != 0
+                    ],
+                },
+                "ids": {"userId": int(users[i])},
+                "offset": float(data.offset[i]),
+            }
+        )
+    return rows
+
+
+@pytest.fixture(scope="module")
+def game_world():
+    data, truth = generate_game_dataset(
+        n_users=12, rows_per_user=10, fe_dim=6, re_dim=4, seed=3
+    )
+    # non-zero offsets so the offset plumbing is actually exercised
+    rng = np.random.default_rng(17)
+    data = build_game_dataset(
+        response=data.response,
+        feature_shards=data.feature_shards,
+        id_columns=data.id_columns,
+        offset=rng.normal(size=data.num_rows) * 0.3,
+    )
+    return data, truth
+
+
+_INDEX_MAPS = {
+    "global": [f"g{j}" for j in range(6)],
+    "user": [f"u{j}" for j in range(4)],
+}
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+def test_engine_matches_predict_mean(game_world):
+    data, truth = game_world
+    model = _make_model(truth)
+    expected = np.asarray(model.predict_mean(data))[: data.num_rows]
+    rows = _request_rows(truth, data, range(data.num_rows))
+    # max_batch below num_rows: internal chunking + several buckets
+    engine = ScoringEngine(model, max_batch=32, version="t").warmup()
+    got = engine.score_rows(rows)
+    np.testing.assert_allclose(got, expected, atol=1e-6)
+    assert engine.warm
+
+
+def test_engine_squared_task_is_raw_scores(game_world):
+    data, truth = game_world
+    model = _make_model(truth, task="squared")
+    expected = np.asarray(model.predict_mean(data))[: data.num_rows]
+    engine = ScoringEngine(model, max_batch=16)
+    got = engine.score_rows(_request_rows(truth, data, range(data.num_rows)))
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_engine_unseen_entity_falls_back_to_fixed_effect(game_world):
+    data, truth = game_world
+    model = _make_model(truth)
+    fe_only = GameModel(
+        task="logistic", models={"fixed": model.models["fixed"]}
+    )
+    expected = np.asarray(fe_only.predict_mean(data))[:3]
+    rows = _request_rows(truth, data, range(3))
+    for r in rows:
+        r["ids"] = {"userId": 424242}  # never in the training vocab
+    engine = ScoringEngine(model, max_batch=8)
+    np.testing.assert_allclose(engine.score_rows(rows), expected, atol=1e-6)
+    assert (
+        telemetry.snapshot()["counters"]["serving.unseen_entities"] == 3
+    )
+    # a row with no id at all gets the same fallback
+    del rows[0]["ids"]
+    np.testing.assert_allclose(
+        engine.score_rows(rows[:1]), expected[:1], atol=1e-6
+    )
+
+
+def test_engine_named_features_resolve_through_index_maps(game_world):
+    data, truth = game_world
+    model = _make_model(truth)
+    engine = ScoringEngine(model, index_maps={
+        "global": {f"g{j}": j for j in range(6)},
+        "user": {f"u{j}": j for j in range(4)},
+    }, max_batch=8)
+    indexed = _request_rows(truth, data, [0, 1])
+    named = []
+    for row in indexed:
+        named.append(
+            {
+                "features": {
+                    "global": [
+                        ["g%d" % c, "", v]
+                        for c, v in row["features"]["global"]
+                    ],
+                    "user": [
+                        {"name": "u%d" % c, "value": v}
+                        for c, v in row["features"]["user"]
+                    ],
+                },
+                "ids": row["ids"],
+                "offset": row["offset"],
+            }
+        )
+    np.testing.assert_allclose(
+        engine.score_rows(named), engine.score_rows(indexed), atol=1e-7
+    )
+    # unknown names score as absent features (index-map default), counted
+    named[0]["features"]["global"].append(["no_such_feature", "", 1.0])
+    engine.score_rows(named)
+    assert telemetry.snapshot()["counters"]["serving.unknown_features"] == 1
+
+
+def test_engine_bad_requests_are_typed(game_world):
+    data, truth = game_world
+    engine = ScoringEngine(_make_model(truth), max_batch=4, max_row_nnz=4)
+    with pytest.raises(BadRequest, match="max_row_nnz"):
+        engine.score_rows(
+            [{"features": {"global": [[j, 1.0] for j in range(5)]}}]
+        )
+    with pytest.raises(BadRequest, match="must be an object"):
+        engine.score_rows(["not-a-row"])
+    with pytest.raises(BadRequest, match="no feature index"):
+        engine.score_rows(
+            [{"features": {"global": [["named", "", 1.0]]}}]
+        )
+    # a typo'd shard name must not silently drop features (the
+    # silent-wrong-scores hazard)
+    with pytest.raises(BadRequest, match="unknown feature shard"):
+        engine.score_rows([{"features": {"globl": [[0, 1.0]]}}])
+    # ...nor an out-of-range feature id (clamped gathers drop it silently)
+    with pytest.raises(BadRequest, match="outside shard"):
+        engine.score_rows([{"features": {"global": [[100, 1.0]]}}])
+    with pytest.raises(BadRequest, match="outside shard"):
+        engine.score_rows([{"features": {"global": [[-1, 1.0]]}}])
+    # non-numeric payloads are 400-class, never internal errors
+    with pytest.raises(BadRequest, match="offset"):
+        engine.score_rows([{"offset": "x"}])
+    with pytest.raises(BadRequest, match="must be numbers"):
+        engine.score_rows([{"features": {"global": [[0, "not-a-number"]]}}])
+
+
+def test_micro_batcher_isolates_bad_unit_from_co_batched(game_world):
+    """One malformed request coalesced into a batch must fail ALONE —
+    the valid co-riders still get their scores."""
+    data, truth = game_world
+    engine = ScoringEngine(_make_model(truth), max_batch=8)
+    batcher = MicroBatcher(
+        lambda rows: (engine.score_rows(rows), engine.version),
+        max_batch=8, max_delay_ms=50.0, queue_depth=100,
+    ).start()
+    try:
+        good_rows = _request_rows(truth, data, [0, 1])
+        good = batcher.submit(good_rows)
+        bad = batcher.submit([{"features": {"globl": [[0, 1.0]]}}])
+        result = good.result(timeout=10)
+        expected = np.asarray(
+            _make_model(truth).predict_mean(data)
+        )[:2]
+        np.testing.assert_allclose(result["scores"], expected, atol=1e-6)
+        with pytest.raises(BadRequest, match="unknown feature shard"):
+            bad.result(timeout=10)
+    finally:
+        batcher.stop()
+
+
+def test_engine_load_requires_feature_indexes(tmp_path, game_world):
+    _, truth = game_world
+    model_dir = str(tmp_path / "model")
+    save_game_model(_make_model(truth), model_dir)
+    with pytest.raises(ModelLoadError, match="feature-indexes"):
+        ScoringEngine.load(model_dir)
+    engine = ScoringEngine.load(model_dir, require_feature_indexes=False)
+    assert engine.version == "model"
+
+
+def test_engine_rejects_unservable_coordinates(game_world):
+    _, truth = game_world
+    model = _make_model(truth)
+    bad = model.with_model("weird", object())
+    with pytest.raises(TypeError, match="online serving supports"):
+        ScoringEngine(bad)
+
+
+def test_steady_state_never_recompiles(game_world):
+    data, truth = game_world
+    engine = ScoringEngine(_make_model(truth), max_batch=16).warmup()
+    rows = _request_rows(truth, data, range(9))
+    engine.score_rows(rows)  # one post-warmup call settles caches
+    before = telemetry.snapshot()["counters"].get("jit_compiles", 0)
+    for size in (1, 3, 9, 16, 5):  # every bucket was warmed
+        engine.score_rows(_request_rows(truth, data, range(size)))
+    after = telemetry.snapshot()["counters"].get("jit_compiles", 0)
+    assert after == before
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+
+def test_micro_batcher_coalesces_under_deadline():
+    dispatched = []
+
+    def scorer(rows):
+        dispatched.append(len(rows))
+        time.sleep(0.01)  # let submissions pile up behind the first batch
+        return np.arange(len(rows), dtype=np.float32), "v9"
+
+    b = MicroBatcher(
+        scorer, max_batch=8, max_delay_ms=25.0, queue_depth=1000
+    ).start()
+    try:
+        futures = [b.submit([{"k": i}, {"k": i}]) for i in range(8)]
+        results = [f.result(timeout=10) for f in futures]
+    finally:
+        b.stop()
+    assert all(len(r["scores"]) == 2 for r in results)
+    assert all(r["model_version"] == "v9" for r in results)
+    assert max(dispatched) > 2  # units rode together, not one-by-one
+    assert sum(dispatched) == 16
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving.requests"] == 8
+    assert snap["histograms"]["serving.batch_size"]["count"] == len(dispatched)
+
+
+def test_micro_batcher_sheds_on_overload():
+    release = threading.Event()
+
+    def scorer(rows):
+        release.wait(timeout=10)
+        return np.zeros(len(rows), np.float32), "v"
+
+    b = MicroBatcher(
+        scorer, max_batch=4, max_delay_ms=1.0, queue_depth=4
+    ).start()
+    try:
+        first = b.submit([{}] * 4)
+        time.sleep(0.1)  # dispatcher grabs the first batch, blocks in scorer
+        second = b.submit([{}] * 4)  # refills the queue to capacity
+        with pytest.raises(Overloaded, match="queue at capacity"):
+            b.submit([{}])
+        assert telemetry.snapshot()["counters"]["serving.shed"] == 1
+        release.set()
+        assert len(first.result(timeout=10)["scores"]) == 4
+        assert len(second.result(timeout=10)["scores"]) == 4
+    finally:
+        release.set()
+        b.stop()
+
+
+def test_micro_batcher_rejects_unservable_giant_request():
+    """A unit larger than queue_depth can never be admitted — it must be
+    a typed 400-class error, not a retryable-looking Overloaded."""
+    b = MicroBatcher(
+        lambda rows: (np.zeros(len(rows), np.float32), "v"),
+        max_batch=4, queue_depth=8,
+    ).start()
+    try:
+        with pytest.raises(BadRequest, match="queue depth"):
+            b.submit([{}] * 9)
+        assert len(b.submit([{}] * 8).result(timeout=10)["scores"]) == 8
+    finally:
+        b.stop()
+
+
+def test_micro_batcher_drops_cancelled_units():
+    """A caller that timed out cancels its future; the dispatcher must
+    not burn device time scoring work nobody will read."""
+    calls = []
+    gate = threading.Event()
+
+    def scorer(rows):
+        calls.append(len(rows))
+        gate.wait(timeout=10)
+        return np.zeros(len(rows), np.float32), "v"
+
+    b = MicroBatcher(scorer, max_batch=4, max_delay_ms=1.0).start()
+    try:
+        first = b.submit([{}])
+        time.sleep(0.1)  # dispatcher is blocked in scorer on `first`
+        doomed = b.submit([{}])
+        assert doomed.cancel()
+        gate.set()
+        assert len(first.result(timeout=10)["scores"]) == 1
+    finally:
+        b.stop()  # drains: the cancelled unit is collected and dropped
+    assert calls == [1]
+
+
+def test_micro_batcher_propagates_scorer_errors():
+    def scorer(rows):
+        raise RuntimeError("device fell over")
+
+    b = MicroBatcher(scorer, max_batch=4, max_delay_ms=1.0).start()
+    try:
+        fut = b.submit([{}])
+        with pytest.raises(RuntimeError, match="device fell over"):
+            fut.result(timeout=10)
+    finally:
+        b.stop()
+    with pytest.raises(RuntimeError, match="not running"):
+        b.submit([{}])
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_skips_corrupt_and_index_less_versions(tmp_path, game_world):
+    _, truth = game_world
+    registry_dir = str(tmp_path)
+    publish_version(registry_dir, _make_model(truth), _INDEX_MAPS)
+    # v2: loadable model but NO feature-indexes -> refused outright
+    v2 = os.path.join(registry_dir, "v-00000002")
+    save_game_model(_make_model(truth, scale=2.0), v2)
+    # v3: partial write (no model-metadata.json)
+    v3 = os.path.join(registry_dir, "v-00000003")
+    os.makedirs(v3)
+    with open(os.path.join(v3, "garbage"), "w") as f:
+        f.write("x")
+    registry = ModelRegistry(registry_dir, max_batch=4, warm=False,
+                             poll_interval=60)
+    registry.start()
+    try:
+        assert registry.engine.version == "v-00000001"
+        skipped = telemetry.snapshot()["counters"]["serving.skipped_versions"]
+        assert skipped >= 2
+        # unchanged bad versions are remembered, not re-read every poll
+        registry.refresh()
+        assert (
+            telemetry.snapshot()["counters"]["serving.skipped_versions"]
+            == skipped
+        )
+    finally:
+        registry.stop()
+
+
+def test_registry_with_no_valid_version_raises(tmp_path):
+    registry = ModelRegistry(str(tmp_path), warm=False, poll_interval=60)
+    with pytest.raises(RuntimeError, match="no valid model version"):
+        registry.start()
+
+
+def test_publish_version_requires_index_maps(tmp_path, game_world):
+    _, truth = game_world
+    with pytest.raises(ValueError, match="index_maps is required"):
+        publish_version(str(tmp_path), _make_model(truth), {})
+
+
+# ---------------------------------------------------------------------------
+# front ends
+# ---------------------------------------------------------------------------
+
+
+def test_stdio_jsonl_mode(game_world):
+    data, truth = game_world
+    model = _make_model(truth)
+    engine = ScoringEngine(model, max_batch=8, version="v-test")
+    rows = _request_rows(truth, data, range(3))
+    expected = np.asarray(model.predict_mean(data))[:3]
+    inp = io.StringIO(
+        json.dumps({"rows": rows})
+        + "\n"
+        + json.dumps({"op": "health"})
+        + "\nnot json\n"
+        + json.dumps({"op": "metrics"})
+        + "\n"
+    )
+    out = io.StringIO()
+    assert serve_stdio(engine, inp, out) == 0
+    lines = [json.loads(ln) for ln in out.getvalue().strip().splitlines()]
+    np.testing.assert_allclose(lines[0]["scores"], expected, atol=1e-6)
+    assert lines[0]["model_version"] == "v-test"
+    assert lines[1]["status"] == "serving"
+    assert "error" in lines[2]
+    assert "counters" in lines[3]
+
+
+def _post(port, body, timeout=15):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/score",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _get(port, path, timeout=15):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def test_http_error_codes(game_world):
+    _, truth = game_world
+    engine = ScoringEngine(_make_model(truth), max_batch=4, max_row_nnz=4)
+    service = ScoringService(engine, max_batch=4, max_delay_ms=1.0)
+    server = ScoringServer(service, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.port, {"not_rows": []})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.port, {"rows": [
+                {"features": {"global": [[j, 1.0] for j in range(9)]}}
+            ]})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server.port, "/nope")
+        assert ei.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_serving_e2e_http_hot_swap(tmp_path, game_world):
+    """The acceptance path: concurrent HTTP scoring matches
+    predict_mean, a mid-run registry publish swaps versions with zero
+    failed requests, and warmed steady state never recompiles."""
+    data, truth = game_world
+    m1 = _make_model(truth)
+    m2 = _make_model(truth, scale=0.5)
+    expected = {
+        "v-00000001": np.asarray(m1.predict_mean(data))[: data.num_rows],
+        "v-00000002": np.asarray(m2.predict_mean(data))[: data.num_rows],
+    }
+    registry_dir = str(tmp_path / "registry")
+    publish_version(registry_dir, m1, _INDEX_MAPS)
+    registry = ModelRegistry(registry_dir, max_batch=16, poll_interval=0.2)
+    registry.start()
+    service = ScoringService(
+        registry, max_batch=16, max_delay_ms=2.0, queue_depth=10_000
+    )
+    server = ScoringServer(service, port=0).start()
+    port = server.port
+    try:
+        health = _get(port, "/healthz")
+        assert health["status"] == "serving"
+        assert health["model_version"] == "v-00000001"
+        assert health["warm"]
+
+        indices = list(range(8))
+        rows = _request_rows(truth, data, indices)
+
+        def check(result):
+            exp = expected[result["model_version"]][indices]
+            np.testing.assert_allclose(result["scores"], exp, atol=1e-6)
+
+        # steady state: the compile counter must be FLAT across >= 3
+        # post-warmup batches
+        check(_post(port, {"rows": rows}))
+        compiles_before = telemetry.snapshot()["counters"].get(
+            "jit_compiles", 0
+        )
+        for _ in range(3):
+            check(_post(port, {"rows": rows}))
+        assert (
+            telemetry.snapshot()["counters"].get("jit_compiles", 0)
+            == compiles_before
+        )
+
+        # concurrent clients hammer while v2 lands mid-run
+        failures, seen_versions = [], set()
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    result = _post(port, {"rows": rows})
+                    check(result)
+                    seen_versions.add(result["model_version"])
+                except Exception as e:  # noqa: BLE001 — recorded, asserted 0
+                    failures.append(repr(e))
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        publish_version(registry_dir, m2, _INDEX_MAPS)
+        deadline = time.monotonic() + 30
+        while (
+            "v-00000002" not in seen_versions
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not failures, failures[:3]
+        assert seen_versions == {"v-00000001", "v-00000002"}
+        assert _get(port, "/healthz")["model_version"] == "v-00000002"
+        metrics = _get(port, "/metricsz")
+        assert metrics["counters"]["serving.model_swaps"] == 2
+        assert metrics["counters"]["serving.requests"] >= 4
+        assert metrics["histograms"]["serving.queue_ms"]["count"] >= 4
+    finally:
+        server.stop()
+        registry.stop()
+
+
+def test_cli_serve_stdio_subprocess(tmp_path, game_world):
+    """`cli serve --registry-dir ... --stdio` drives the full stack (load,
+    warmup, request schema) from a clean process without sockets."""
+    import subprocess
+    import sys
+
+    data, truth = game_world
+    model = _make_model(truth)
+    registry_dir = str(tmp_path / "registry")
+    publish_version(registry_dir, model, _INDEX_MAPS)
+    rows = _request_rows(truth, data, range(4))
+    stdin = (
+        json.dumps({"rows": rows}) + "\n" + json.dumps({"op": "health"}) + "\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli", "serve",
+         "--registry-dir", registry_dir, "--stdio", "--max-batch", "8"],
+        input=stdin, capture_output=True, text=True, timeout=600,
+        cwd=repo, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(ln) for ln in proc.stdout.strip().splitlines()]
+    expected = np.asarray(model.predict_mean(data))[:4]
+    np.testing.assert_allclose(lines[0]["scores"], expected, atol=1e-6)
+    assert lines[0]["model_version"] == "v-00000001"
+    assert lines[1] == {
+        "status": "serving", "model_version": "v-00000001",
+        "warm": True, "buckets": [1, 2, 4, 8],
+    }
+
+
+# ---------------------------------------------------------------------------
+# cli score guard (satellite: silent-wrong-scores hazard)
+# ---------------------------------------------------------------------------
+
+
+def test_score_cli_requires_feature_indexes(tmp_path, game_world):
+    from photon_ml_tpu.cli.score import run
+
+    _, truth = game_world
+    model_dir = str(tmp_path / "model")
+    save_game_model(_make_model(truth), model_dir)
+    with pytest.raises(ModelLoadError, match="feature-indexes"):
+        run(model_dir, {"format": "avro", "paths": []})
+    # --allow-index-rebuild gets past the guard (and then fails on the
+    # empty input spec, NOT on the index maps)
+    with pytest.raises(Exception) as ei:
+        run(
+            model_dir,
+            {"format": "avro", "paths": []},
+            allow_index_rebuild=True,
+        )
+    assert "feature-indexes" not in str(ei.value)

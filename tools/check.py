@@ -28,6 +28,11 @@ Runs, in order:
          library code routes output through loggers/telemetry so fits are
          greppable and machine-readable. CLI modules (photon_ml_tpu/cli/)
          are exempt — stdout IS their interface.
+       - serving hot-path device->host syncs (L010): `jax.device_get`,
+         `np.asarray(...)`, and `float(...)`-on-non-constants inside the
+         serving hot-path modules (photon_ml_tpu/serving/{engine,batcher}.py)
+         — every request would pay a full tunnel round trip per call; the
+         one sanctioned crossing is telemetry.sync_fetch.
   3. ruff + mypy, IF installed (configs live in pyproject.toml)
 
 Exit code 0 = clean. Any finding prints `path:line: code message` and the
@@ -82,6 +87,15 @@ L008_BLESSED = {
     os.path.join("photon_ml_tpu", "game", "checkpoint.py"),
 }
 
+# Serving hot-path modules: every score request flows through these, so a
+# stray device->host sync (jax.device_get, float() on an array, np.asarray
+# on a jax array) costs the full tunnel round trip PER REQUEST. The one
+# sanctioned crossing is telemetry.sync_fetch (device.py accounts it).
+L010_HOT_PATH = {
+    os.path.join("photon_ml_tpu", "serving", "engine.py"),
+    os.path.join("photon_ml_tpu", "serving", "batcher.py"),
+}
+
 
 class _Lint(ast.NodeVisitor):
     def __init__(self, path: str, tree: ast.Module, library: bool = False):
@@ -90,6 +104,7 @@ class _Lint(ast.NodeVisitor):
         # rules L006/L007; benches and tests may time however they like
         self.library = library
         self._l008_exempt = path in L008_BLESSED
+        self._l010_hot = path in L010_HOT_PATH
         # CLI modules own stdout: bare print() is their user interface
         self._l009_exempt = path.startswith(
             os.path.join("photon_ml_tpu", "cli") + os.sep
@@ -190,6 +205,28 @@ class _Lint(ast.NodeVisitor):
             and f.value.id == "json"
         )
 
+    def _is_serving_sync_call(self, node: ast.Call) -> bool:
+        # device->host crossings in serving hot paths: `jax.device_get`
+        # (any spelling), `np.asarray`/`numpy.asarray` (a jax-array arg
+        # forces a fetch), and `float(x)` on anything but a literal
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "device_get":
+            return True
+        if isinstance(f, ast.Name) and f.id == "device_get":
+            return True
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "asarray"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("np", "numpy")
+        ):
+            return True
+        return (
+            isinstance(f, ast.Name)
+            and f.id == "float"
+            and not all(isinstance(a, ast.Constant) for a in node.args)
+        )
+
     def visit_Call(self, node: ast.Call) -> None:
         if self.library and self._is_wall_clock_call(node):
             self._report(
@@ -210,6 +247,14 @@ class _Lint(ast.NodeVisitor):
                 "path) in library code — a crash mid-write leaves a "
                 "truncated file; route through utils.atomic / the "
                 "model_store//checkpoint writers",
+            )
+        if self._l010_hot and self._is_serving_sync_call(node):
+            self._report(
+                node,
+                "L010",
+                "device->host sync in a serving hot-path module — every "
+                "request pays the tunnel round trip; fetch results through "
+                "telemetry.sync_fetch only",
             )
         if (
             self.library
