@@ -82,7 +82,9 @@ def main():
         # rejects at this size with HTTP 413).
         return lbfgs_solve(glm_adapter(obj, batch), w0, cfg)
 
-    run_jit = jax.jit(run)
+    # accounted jit (telemetry.xla): the headline's compile time, FLOPs
+    # and bytes-accessed land in the executable registry for the detail
+    run_jit = telemetry.instrumented_jit(run, name="bench_lbfgs")
 
     # compile + warmup with a DIFFERENT w0 than the timed run: identical
     # (fn, args) re-executions are result-cached on the tunnel TPU, and
@@ -102,6 +104,26 @@ def main():
     iters = int(res.iterations)
     passes = int(res.data_passes)  # init eval + one per iteration (LBFGS)
     rows_per_sec = n_rows * passes / elapsed
+
+    # roofline detail: per-solve cost analysis + achieved-vs-peak numbers
+    # (None = "unknown": backends without cost analysis / unknown peaks)
+    rec = run_jit.record_for(w0, batch)
+    peak_flops, peak_bw = telemetry.xla.device_peaks()
+    device_util = {
+        "flops_per_solve": None if rec is None else rec.flops,
+        "bytes_accessed_per_solve": None if rec is None else rec.bytes_accessed,
+        "compile_seconds": None if rec is None else round(rec.compile_seconds, 3),
+        "mfu": (
+            round(rec.flops / (elapsed * peak_flops), 6)
+            if rec is not None and rec.flops and peak_flops
+            else None
+        ),
+        "bandwidth_utilization": (
+            round(rec.bytes_accessed / (elapsed * peak_bw), 6)
+            if rec is not None and rec.bytes_accessed and peak_bw
+            else None
+        ),
+    }
     layout_line = json.dumps(
         {
             "metric": "tiled_layout_build_rows_per_sec",
@@ -128,6 +150,7 @@ def main():
                     # same schema as TrainingFinishEvent.metrics_snapshot /
                     # --telemetry-out: fetch + compile accounting for the run
                     "telemetry": telemetry.snapshot()["counters"],
+                    "device_utilization": device_util,
                 },
             }
         ),
@@ -136,6 +159,72 @@ def main():
     # the layout-build rate prints AFTER the headline: harness consumers
     # take the first metric line as the training-throughput headline
     print(layout_line, flush=True)
+
+
+#: The metric lines main() itself prints (config #1 + the layout build).
+HEADLINE_METRICS = (
+    "glm_logistic_1Mx10K_rows_per_sec_per_chip",
+    "tiled_layout_build_rows_per_sec",
+)
+
+
+def run_headline(deadline=None):
+    """Config #1: in-process when uncapped; under a budget it runs as a
+    killable ``bench.py --headline-only`` subprocess capped at the
+    remaining budget, so a budget expiring MID-solve still ends in
+    truncated lines + exit 0 instead of the outer timeout's rc=124 (the
+    in-process jax solve cannot be preempted)."""
+    if deadline is None:
+        main()
+        return
+    from bench_suite import truncated_line
+
+    emitted = set()
+    remaining = deadline - time.monotonic()
+    failure = None  # non-budget failure: report an error, not "truncated"
+    if remaining > 0:
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "bench.py"),
+                 "--headline-only"],
+                capture_output=True,
+                text=True,
+                timeout=max(remaining - 5.0, 1.0),
+                cwd=here,
+            )
+            out = proc.stdout
+            if proc.returncode != 0:
+                failure = f"rc={proc.returncode}: {proc.stderr[-400:]}"
+        except subprocess.TimeoutExpired as e:
+            out = e.stdout or ""  # budget cap: truncation, not an error
+        except (subprocess.SubprocessError, OSError) as e:
+            out = ""
+            failure = str(e)[-400:]
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                print(line, flush=True)
+                emitted.add(_metric_of(line))
+        if failure is None and remaining > 60 and not emitted:
+            # plenty of budget yet nothing printed: a crash, not a skip
+            failure = "headline produced no metrics"
+    if failure is not None:
+        # a crashed headline must look like an ERROR, never like a
+        # budget skip (same contract as run_sub_benchmarks)
+        print(
+            json.dumps(
+                {"metric": "bench_headline", "value": None, "unit": None,
+                 "vs_baseline": None, "error": failure}
+            ),
+            flush=True,
+        )
+        return
+    for metric in HEADLINE_METRICS:
+        if metric not in emitted:
+            print(truncated_line(metric), flush=True)
 
 
 from bench_suite import SUITE_METRICS as _SUITE_METRICS
@@ -181,8 +270,17 @@ def run_sub_benchmarks(deadline=None):
                 print(truncated_line(metric), flush=True)
             continue
         timeout = 1500 if script != "bench_northstar.py" else 4500
+        budget_capped = False
         if remaining is not None:
-            timeout = min(timeout, max(remaining, 1.0))
+            # keep a kill grace INSIDE the remaining budget: the deadline
+            # is the flush-by time (bench_suite.budget_deadline already
+            # excludes the exit margin), so the subprocess must be dead —
+            # including the kill escalation — with seconds to spare for
+            # forwarding its partial output and the truncated lines
+            capped = max(remaining - 5.0, 1.0)
+            if capped < timeout:
+                timeout = capped
+                budget_capped = True
         emitted = set()
         try:
             proc = subprocess.run(
@@ -212,8 +310,12 @@ def run_sub_benchmarks(deadline=None):
                 if line.startswith("{"):
                     print(line, flush=True)
                     emitted.add(_metric_of(line))
-            over_budget = (
-                deadline is not None and time.monotonic() >= deadline
+            over_budget = deadline is not None and (
+                time.monotonic() >= deadline
+                or (
+                    budget_capped
+                    and isinstance(e, subprocess.TimeoutExpired)
+                )
             )
             if over_budget:
                 # the budget, not the benchmark, ended this script: emit
@@ -268,7 +370,11 @@ def write_run_report():
 if __name__ == "__main__":
     from bench_suite import budget_deadline
 
+    if "--headline-only" in sys.argv:
+        # subprocess mode for run_headline: just config #1, no recursion
+        main()
+        sys.exit(0)
     _deadline = budget_deadline()
-    main()
+    run_headline(deadline=_deadline)
     run_sub_benchmarks(deadline=_deadline)
     write_run_report()

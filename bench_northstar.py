@@ -245,6 +245,14 @@ def _run(workdir):
 
     import jax
 
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    # roofline summary over the whole pipeline (None = no instrumented
+    # executables ran / "unknown" cost fields on analysis-less backends):
+    # MFU, bandwidth utilization, comms fraction, compile-time share, and
+    # the top executables by cost — the attribution BENCH_r05 lacked
+    device_util = RunReport.from_live().device_utilization()
+
     pipeline_s = train_s + score_s
     print(
         json.dumps(
@@ -281,6 +289,7 @@ def _run(workdir):
                     # device_fetches / device_fetch_seconds expose the
                     # ~100ms tunnel tax, jit_compiles the recompile count
                     "telemetry": telemetry.snapshot()["counters"],
+                    "device_utilization": device_util,
                 },
             },
             default=float,

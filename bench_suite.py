@@ -45,12 +45,62 @@ SUITE_METRICS = (
 )
 
 
+#: Safety margin reserved BEFORE the PHOTON_BENCH_BUDGET_S wall so the
+#: process can kill a running sub-benchmark, flush truncated placeholder
+#: lines, and write the run report while the harness's outer `timeout -k`
+#: has not yet fired. BENCH_r05 lost its whole run to rc=124 because the
+#: old deadline ran right up to the wall: the budget check passed, the
+#: sub-benchmark was capped AT the remaining budget, and the cleanup after
+#: the cap landed past it. Override with PHOTON_BENCH_MARGIN_S.
+DEFAULT_BUDGET_MARGIN_S = 30.0
+
+
+def budget_margin() -> float:
+    raw = os.environ.get("PHOTON_BENCH_MARGIN_S")
+    if not raw:
+        return DEFAULT_BUDGET_MARGIN_S
+    try:
+        return float(raw)
+    except ValueError:
+        # a malformed margin must not kill the bench before any metric
+        # prints — that would be worse than the rc=124 it guards against
+        print(
+            f"ignoring malformed PHOTON_BENCH_MARGIN_S={raw!r}; "
+            f"using {DEFAULT_BUDGET_MARGIN_S}",
+            file=sys.stderr,
+        )
+        return DEFAULT_BUDGET_MARGIN_S
+
+
 def budget_deadline(now: float | None = None):
-    """Monotonic deadline from PHOTON_BENCH_BUDGET_S, or None (no cap)."""
+    """Monotonic flush-by deadline from PHOTON_BENCH_BUDGET_S (the budget
+    minus the flush margin), or None (no cap). Work must STOP at this
+    deadline; the reserved margin pays for truncated-line flushes and the
+    run report so the process exits 0 before the outer kill."""
     budget = os.environ.get("PHOTON_BENCH_BUDGET_S")
     if not budget:
         return None
-    return (time.monotonic() if now is None else now) + float(budget)
+    try:
+        budget_s = float(budget)
+    except ValueError:
+        print(
+            f"ignoring malformed PHOTON_BENCH_BUDGET_S={budget!r}; "
+            "running uncapped",
+            file=sys.stderr,
+        )
+        return None
+    margin = budget_margin()
+    # a budget at or below the margin must not silently skip ALL work:
+    # keep at least half the budget for benchmarking, and say so
+    usable = max(budget_s - margin, budget_s * 0.5)
+    if budget_s <= margin:
+        print(
+            f"PHOTON_BENCH_BUDGET_S={budget_s:g} <= flush margin "
+            f"{margin:g}s; keeping {usable:g}s for work — expect "
+            "heavy truncation",
+            file=sys.stderr,
+        )
+    return (time.monotonic() if now is None else now) + usable
 
 
 def truncated_line(metric: str) -> str:

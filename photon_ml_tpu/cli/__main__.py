@@ -9,13 +9,14 @@ import sys
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m photon_ml_tpu.cli {train|score|serve|glm|index|report} [options]")
+        print("usage: python -m photon_ml_tpu.cli {train|score|serve|glm|index|report|profile} [options]")
         print("  train --config <json> [--output-dir <dir>]   GAME training")
         print("  score --model-dir <dir> --config <json> [--output <avro>]")
         print("  serve --registry-dir <dir> | --model-dir <dir>  online scoring server")
         print("  glm   --config <json> [--output-dir <dir>]   staged legacy GLM")
         print("  index --input <avro...> --output <dir>       feature index build")
         print("  report --trace <jsonl> [--telemetry <jsonl>] [--compare <json>]")
+        print("  profile --profile-dir <dir> -- <command...>  profiler capture around any run")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "train":
@@ -42,8 +43,12 @@ def main(argv=None) -> int:
         from photon_ml_tpu.cli.report import main as report_main
 
         return report_main(rest)
+    if cmd == "profile":
+        from photon_ml_tpu.cli.profile import main as profile_main
+
+        return profile_main(rest)
     print(
-        f"unknown command '{cmd}' (expected train|score|serve|glm|index|report)",
+        f"unknown command '{cmd}' (expected train|score|serve|glm|index|report|profile)",
         file=sys.stderr,
     )
     return 2

@@ -42,7 +42,13 @@ from photon_ml_tpu.optim.common import BoxConstraints
 from photon_ml_tpu.optim.factory import OptimizerConfig, dispatch_solve
 from photon_ml_tpu.optim.guard import damped_objective, solve_health
 from photon_ml_tpu.parallel.distributed import distributed_solve
-from photon_ml_tpu.parallel.mesh import put_sharded, shard_rows, shard_tiles
+from photon_ml_tpu.parallel.mesh import (
+    put_sharded,
+    shard_map_compat,
+    shard_rows,
+    shard_tiles,
+)
+from photon_ml_tpu.telemetry.xla import instrumented_jit
 
 Array = jax.Array
 
@@ -68,7 +74,10 @@ def _fe_solver(config: OptimizerConfig, loss_name: str):
             glm_adapter(obj, batch), w0, config, l1, constraints=constraints
         )
 
-    return jax.jit(run)
+    # multi_shape: one lru-shared solver serves every FE coordinate
+    # (and dataset) with this config — distinct feature/row shapes are by
+    # design, not a storm
+    return instrumented_jit(run, name="fe_solve", multi_shape=True)
 
 
 @dataclasses.dataclass
@@ -387,7 +396,13 @@ def _re_solver(
     # [K] box broadcast to every entity (the streaming table's dense local
     # space) instead of materializing [E, K] bounds.
     c_axis = 0 if constrained is True else None
-    return jax.jit(jax.vmap(solve_one, in_axes=(None, 0, 0, None, c_axis)))
+    # multi_shape: each geometry bucket (entity count, rows, K) is its
+    # own signature by construction
+    return instrumented_jit(
+        jax.vmap(solve_one, in_axes=(None, 0, 0, None, c_axis)),
+        name="re_solve_dense" if packed else "re_solve",
+        multi_shape=True,
+    )
 
 
 @lru_cache(maxsize=64)
@@ -419,7 +434,7 @@ def _re_solver_sharded(
 
     def wrapped(obj, bucket_batch, w0, l1, constraints):
         rep = lambda t: jax.tree.map(lambda _: P(), t)
-        return jax.shard_map(
+        return shard_map_compat(
             local,
             mesh=mesh,
             in_specs=(
@@ -430,10 +445,14 @@ def _re_solver_sharded(
                 jax.tree.map(lambda _: c_spec, constraints),
             ),
             out_specs=P(axis),
-            check_vma=False,
+            check=False,
         )(obj, bucket_batch, w0, l1, constraints)
 
-    return jax.jit(wrapped)
+    return instrumented_jit(
+        wrapped,
+        name="re_solve_sharded_dense" if packed else "re_solve_sharded",
+        multi_shape=True,  # per-bucket shapes are the design
+    )
 
 
 def _pad_entities(batch: SparseBatch, w0: Array, total: int):
@@ -472,7 +491,7 @@ def _re_scorer():
         # per-entity margins x.w (no offsets) -> [E, R]
         return jax.vmap(lambda w, b: b.dot_rows(w))(coeffs, bucket_batch)
 
-    return jax.jit(score_bucket)
+    return instrumented_jit(score_bucket, name="re_score", multi_shape=True)
 
 
 @lru_cache(maxsize=8)
@@ -482,7 +501,7 @@ def _re_dense_scorer():
         x = x_flat.reshape(E, -1, K)
         return jnp.einsum("erk,ek->er", x, coeffs)
 
-    return jax.jit(score)
+    return instrumented_jit(score, name="re_score_dense", multi_shape=True)
 
 
 def _packed_dense_batch(packed, w0):

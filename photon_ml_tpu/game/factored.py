@@ -47,6 +47,7 @@ from photon_ml_tpu.ops.sparse import SparseBatch
 from photon_ml_tpu.optim.adapter import glm_adapter
 from photon_ml_tpu.optim.factory import OptimizerConfig, dispatch_solve
 from photon_ml_tpu.parallel.distributed import distributed_solve
+from photon_ml_tpu.telemetry.xla import instrumented_jit
 
 Array = jax.Array
 
@@ -209,7 +210,9 @@ def _latent_design_T_fn(R: int):
             dimension_numbers=(((1,), (1,)), ((), ())),
         )  # [K, R]
 
-    return jax.jit(jax.vmap(one, in_axes=(0, 0, 0, 0, None)))
+    return instrumented_jit(
+        jax.vmap(one, in_axes=(0, 0, 0, 0, None)), name="factored_project"
+    )
 
 
 @lru_cache(maxsize=64)
@@ -217,10 +220,10 @@ def _latent_fit_solver(config: OptimizerConfig, loss_name: str):
     def run(obj, batch, w0, l1):
         return dispatch_solve(glm_adapter(obj, batch), w0, config, l1)
 
-    return jax.jit(run)
+    return instrumented_jit(run, name="factored_latent_fit")
 
 
-@jax.jit
+@instrumented_jit(name="factored_kron_values")
 def _kron_values(vals_sorted, flat_idx, latent):
     """Row-sorted kron values: pre-permuted base values times a FLAT 1-D
     latent gather (see the construction comment — 2-D/tiny-trailing-dim

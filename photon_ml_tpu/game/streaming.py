@@ -59,7 +59,13 @@ def _chunk_writer(donate: bool):
             table, w.astype(table.dtype), (start, 0)
         )
 
-    return jax.jit(write, donate_argnums=(0,) if donate else ())
+    # multi_shape: the tail chunk is legitimately smaller than the rest
+    return telemetry.instrumented_jit(
+        write,
+        name="streaming_chunk_write",
+        multi_shape=True,
+        donate_argnums=(0,) if donate else (),
+    )
 
 
 def _read_chunk(table, start: int, size: int) -> Array:
@@ -104,8 +110,12 @@ class ShardedCoefficientTable:
             # their sharded layout — no host/full-device copy, and it is
             # multi-controller-safe (every process runs the same program
             # and owns only its shards).
-            self.coefficients = jax.jit(
+            # multi_shape: each table instance is its own executable by
+            # design (a fresh closure per table) — not a recompile storm
+            self.coefficients = telemetry.instrumented_jit(
                 partial(jnp.zeros, (num_entities, dim), dtype),
+                name="streaming_table_init",
+                multi_shape=True,
                 out_shardings=self.sharding,
             )()
 

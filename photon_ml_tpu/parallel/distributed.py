@@ -28,12 +28,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from photon_ml_tpu.telemetry.xla import instrumented_jit, record_collective
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.ops.sparse import SparseBatch
 from photon_ml_tpu.optim.adapter import glm_adapter
 from photon_ml_tpu.optim.common import BoxConstraints, SolveResult
 from photon_ml_tpu.optim.factory import OptimizerConfig, build_objective, dispatch_solve
-from photon_ml_tpu.parallel.mesh import DATA_AXIS
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
 
 Array = jax.Array
 
@@ -64,7 +65,7 @@ def _build_solver(config: OptimizerConfig, mesh: Mesh, axis: str):
     def wrapped(obj, stacked_batch, w0, l1, constraints, init_value, init_grad_norm):
         batch_specs = jax.tree.map(lambda _: P(axis), stacked_batch)
         rep_tree = lambda t: jax.tree.map(lambda _: P(), t)
-        return jax.shard_map(
+        return shard_map_compat(
             local_solve,
             mesh=mesh,
             in_specs=(
@@ -77,10 +78,10 @@ def _build_solver(config: OptimizerConfig, mesh: Mesh, axis: str):
                 rep_tree(init_grad_norm),
             ),
             out_specs=P(),
-            check_vma=False,  # psum'd outputs are replicated by construction
+            check=False,  # psum'd outputs are replicated by construction
         )(obj, stacked_batch, w0, l1, constraints, init_value, init_grad_norm)
 
-    return jax.jit(wrapped)
+    return instrumented_jit(wrapped, name="distributed_solve")
 
 
 def distributed_solve(
@@ -117,6 +118,17 @@ def distributed_solve(
     l1 = jnp.float32(config.regularization.l1_weight(config.regularization_weight))
     key_config = _dc.replace(config, regularization_weight=0.0)
     solver = _build_solver(key_config, mesh, axis)
+    # static comms estimate (telemetry.xla): each data pass psums one [d]
+    # gradient + a scalar objective value over the ring; max_iterations
+    # bounds the pass count (line-search extra evals are not counted —
+    # README "comms methodology" documents the limits)
+    record_collective(
+        "distributed_solve",
+        "psum",
+        int(mesh.shape[axis]),
+        int(w0.nbytes) + 4,
+        count=max(int(config.max_iterations), 1),
+    )
     return solver(
         obj, stacked_batch, w0, l1, constraints, init_value, init_grad_norm
     )
@@ -133,15 +145,15 @@ def _build_sharded_eval(mesh: Mesh, axis: str, method_name: str):
 
     def wrapped(obj, w, stacked_batch):
         batch_specs = jax.tree.map(lambda _: P(axis), stacked_batch)
-        return jax.shard_map(
+        return shard_map_compat(
             f,
             mesh=mesh,
             in_specs=(jax.tree.map(lambda _: P(), obj), P(), batch_specs),
             out_specs=P(),
-            check_vma=False,
+            check=False,
         )(obj, w, stacked_batch)
 
-    return jax.jit(wrapped)
+    return instrumented_jit(wrapped, name=f"distributed_{method_name}")
 
 
 def distributed_value_and_grad(
@@ -152,6 +164,10 @@ def distributed_value_and_grad(
     axis: str = DATA_AXIS,
 ) -> tuple[Array, Array]:
     """Standalone sharded objective evaluation (diagnostics / evaluators)."""
+    record_collective(
+        "distributed_value_and_grad", "psum", int(mesh.shape[axis]),
+        int(w.nbytes) + 4,
+    )
     return _build_sharded_eval(mesh, axis, "value_and_grad")(obj, w, stacked_batch)
 
 
@@ -164,4 +180,8 @@ def distributed_hessian_diagonal(
 ) -> Array:
     """Sharded diag H(w), for coefficient variances
     (DistributedOptimizationProblem.scala computeVariances analog)."""
+    record_collective(
+        "distributed_hessian_diagonal", "psum", int(mesh.shape[axis]),
+        int(w.nbytes),
+    )
     return _build_sharded_eval(mesh, axis, "hessian_diagonal")(obj, w, stacked_batch)

@@ -21,6 +21,31 @@ DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs, check: bool = False):
+    """``jax.shard_map`` across jax versions: newer jax exposes it at the
+    top level with ``check_vma``; older releases only ship
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep``. Every
+    framework shard_map goes through here so the distributed solvers run
+    on both."""
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check,
+            )
+        except TypeError:  # older keyword spelling on this jax
+            return jax.shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=check,
+            )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check,
+    )
+
+
 def make_mesh(
     axis_sizes: Optional[dict[str, int]] = None,
     devices: Optional[Sequence[jax.Device]] = None,

@@ -154,7 +154,14 @@ def _compiled_score_fn(link: str, coords: tuple):
             return jnp.exp(scores)
         return scores
 
-    return jax.jit(fn)
+    # instrumented (lint L011): each padded batch-size bucket is one
+    # accounted executable — per-bucket compile time + cost surface in
+    # healthz/metricsz and the run report's top-executables table.
+    # multi_shape: the bucket set IS the design; warmup compiling every
+    # bucket must not read as a recompile storm
+    return telemetry.instrumented_jit(
+        fn, name="serving_score", multi_shape=True
+    )
 
 
 class ScoringEngine:
@@ -263,6 +270,9 @@ class ScoringEngine:
                 uploaded.append(jnp.asarray(t, jnp.float32))
         self._tables = tuple(uploaded)
         self._fn = _compiled_score_fn(self._link, self._coords)
+        # per-batch-bucket executable records (telemetry.xla), captured at
+        # warmup — the healthz/metricsz compile-state surface
+        self._bucket_records: dict[int, object] = {}
         telemetry.gauge("serving.model_bytes").set(predicted_bytes)
 
     @staticmethod
@@ -478,5 +488,24 @@ class ScoringEngine:
                 telemetry.sync_fetch(
                     self._fn(*inputs, self._tables), label="serving.warmup"
                 )
+                rec = self._fn.record_for(*inputs, self._tables)
+                if rec is not None:
+                    self._bucket_records[b] = rec
         self.warm = True
         return self
+
+    def compile_summary(self) -> dict[str, dict]:
+        """Per-batch-bucket compile state from the executable registry
+        (populated at :meth:`warmup`): compile wall seconds plus the XLA
+        cost/memory analysis of each bucket's executable. Cost fields are
+        None ("unknown") on backends without cost analysis."""
+        out: dict[str, dict] = {}
+        for b, rec in sorted(self._bucket_records.items()):
+            out[str(b)] = {
+                "compile_seconds": round(rec.compile_seconds, 6),
+                "flops": rec.flops,
+                "bytes_accessed": rec.bytes_accessed,
+                "temp_bytes": rec.temp_bytes,
+                "calls": rec.calls,
+            }
+        return out

@@ -41,6 +41,14 @@ def _engine_of(source) -> ScoringEngine:
     return source.engine if hasattr(source, "engine") else source
 
 
+def _metrics_payload() -> dict:
+    """The ``/metricsz`` body: the metrics snapshot plus the executable
+    registry (per-bucket/per-hot-path compile time + cost analysis)."""
+    payload = dict(telemetry.snapshot())
+    payload["xla_executables"] = telemetry.XLA_REGISTRY.snapshot()
+    return payload
+
+
 class ScoringService:
     """Engine-or-registry + micro-batcher glue shared by HTTP and stdio.
 
@@ -99,13 +107,19 @@ class ScoringService:
         except RuntimeError as e:
             return {"status": "loading", "model_version": None,
                     "warm": False, "detail": str(e)}
-        return {
+        state = {
             "status": "serving",
             "model_version": engine.version,
             "warm": engine.warm,
             "buckets": list(engine.bucket_sizes),
             "task": engine.task,
         }
+        if engine.warm:
+            # per-batch-bucket compile time + cost from the executable
+            # registry (telemetry.xla) — which bucket executables exist,
+            # what each cost to compile, and their per-call FLOPs
+            state["compile"] = engine.compile_summary()
+        return state
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -128,7 +142,7 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._reply(200, service.health())
         elif self.path == "/metricsz":
-            self._reply(200, telemetry.snapshot())
+            self._reply(200, _metrics_payload())
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -212,8 +226,10 @@ def serve_stdio(source, inp, out) -> int:
                     "warm": engine.warm,
                     "buckets": list(engine.bucket_sizes),
                 }
+                if engine.warm:
+                    response["compile"] = engine.compile_summary()
             elif op == "metrics":
-                response = telemetry.snapshot()
+                response = _metrics_payload()
             else:
                 rows = (
                     request.get("rows")

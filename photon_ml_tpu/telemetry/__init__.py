@@ -20,6 +20,11 @@ Five layers (ISSUE 1 gave emission; ISSUE 3 the interpretation):
   daemon that keeps long fits audible, and :class:`RunReport`, which
   merges trace + metrics + checkpoint manifests into one markdown/JSON
   report with a regression ``compare()`` (the ``cli report`` perf gate).
+- :mod:`photon_ml_tpu.telemetry.xla` — device-level cost accounting:
+  ``instrumented_jit`` records compile time, cost/memory analysis, and
+  recompile attribution per executable; roofline peaks for MFU and
+  bandwidth utilization; ``comms.*`` collective-bytes estimates (the
+  run report's "Device utilization" section).
 
 Typical use::
 
@@ -43,10 +48,15 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from photon_ml_tpu.telemetry import memory, metrics, trace  # noqa: F401
+from photon_ml_tpu.telemetry import memory, metrics, trace, xla  # noqa: F401
 from photon_ml_tpu.telemetry.device import (  # noqa: F401
     install_compile_hooks,
     sync_fetch,
+)
+from photon_ml_tpu.telemetry.xla import (  # noqa: F401
+    XLA_REGISTRY,
+    instrumented_jit,
+    record_collective,
 )
 from photon_ml_tpu.telemetry.metrics import (  # noqa: F401
     counter,
@@ -85,6 +95,10 @@ __all__ = [
     "perfetto_path",
     "Heartbeat",
     "memory",
+    "xla",
+    "instrumented_jit",
+    "record_collective",
+    "XLA_REGISTRY",
     "configure",
     "configure_from_env",
     "reset",
@@ -133,6 +147,7 @@ def reset() -> None:
     trace.reset()
     metrics.reset()
     memory.reset()
+    xla.reset()
     flush = _env_state["atexit_flush"]
     if flush is not None:
         import atexit

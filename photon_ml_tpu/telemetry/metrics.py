@@ -29,6 +29,7 @@ __all__ = [
     "MetricsRegistry",
     "REGISTRY",
     "counter",
+    "peek_counter",
     "gauge",
     "histogram",
     "snapshot",
@@ -189,6 +190,14 @@ class MetricsRegistry:
                 c = self._counters[name] = Counter(name, self._lock)
             return c
 
+    def peek_counter(self, name: str) -> Optional[float]:
+        """A counter's value WITHOUT registering it: monitors (the
+        heartbeat) must not force absent counters into the snapshot as
+        zeros — downstream consumers read absence as "unknown"."""
+        with self._lock:
+            c = self._counters.get(name)
+            return None if c is None else c.value
+
     def gauge(self, name: str) -> Gauge:
         with self._lock:
             g = self._gauges.get(name)
@@ -244,6 +253,7 @@ class MetricsRegistry:
 REGISTRY = MetricsRegistry()
 
 counter = REGISTRY.counter
+peek_counter = REGISTRY.peek_counter
 gauge = REGISTRY.gauge
 histogram = REGISTRY.histogram
 snapshot = REGISTRY.snapshot

@@ -36,7 +36,7 @@ import threading
 import time
 from typing import Any, Optional
 
-from photon_ml_tpu.telemetry import memory, metrics, trace
+from photon_ml_tpu.telemetry import memory, metrics, trace, xla
 
 __all__ = ["Heartbeat", "DEFAULT_INTERVAL_S"]
 
@@ -71,6 +71,9 @@ class Heartbeat:
         self._last_t = self._t0
         self._last_rows = 0.0
         self._last_coeffs = 0.0
+        self._last_flops = 0.0
+        self._last_xla_bytes = 0.0
+        self._last_comms = 0.0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -82,6 +85,11 @@ class Heartbeat:
         self._last_t = self._t0
         self._last_rows = metrics.counter("progress.rows").value
         self._last_coeffs = metrics.counter("progress.coeffs").value
+        # peek, don't create: registering these at 0 would turn the run
+        # report's "unknown" (counter absent) into a fabricated 0
+        self._last_flops = metrics.peek_counter("xla.flops_total") or 0.0
+        self._last_xla_bytes = metrics.peek_counter("xla.bytes_total") or 0.0
+        self._last_comms = metrics.peek_counter("comms.bytes_total") or 0.0
         self._thread = threading.Thread(
             target=self._run, name="photon-heartbeat", daemon=True
         )
@@ -137,6 +145,27 @@ class Heartbeat:
             "coeffs_total": coeffs,
             "dropped_spans": metrics.counter("trace.dropped_spans").value,
         }
+        # device utilization over the beat window (ISSUE 5): live MFU
+        # needs both cost analysis (flops counted) and a known device
+        # peak; comms fraction needs a comms estimate — absent either,
+        # the fields are simply omitted ("unknown"), never zero
+        flops = metrics.peek_counter("xla.flops_total") or 0.0
+        xla_bytes = metrics.peek_counter("xla.bytes_total") or 0.0
+        comms = metrics.peek_counter("comms.bytes_total") or 0.0
+        d_flops = flops - self._last_flops
+        d_bytes = xla_bytes - self._last_xla_bytes
+        d_comms = comms - self._last_comms
+        self._last_flops, self._last_xla_bytes = flops, xla_bytes
+        self._last_comms = comms
+        if d_flops > 0:
+            peak_flops, _peak_bw = xla.device_peaks()
+            if peak_flops:
+                line["mfu"] = round(d_flops / (dt * peak_flops), 6)
+        if d_comms > 0 and d_bytes > 0:
+            # both sides of the ratio known this window; without HBM
+            # bytes (no cost analysis) the fraction is unknowable — omit
+            # rather than emit a fabricated 100%
+            line["comms_fraction"] = round(d_comms / (d_comms + d_bytes), 6)
         stats = memory.hbm_stats()
         if stats and "bytes_in_use" in stats:
             line["hbm_bytes_in_use"] = int(stats["bytes_in_use"])

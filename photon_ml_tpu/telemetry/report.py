@@ -55,9 +55,27 @@ KEY_METRIC_DIRECTIONS: dict[str, int] = {
     "device_fetches": -1,
     "device_fetch_seconds": -1,
     "dropped_spans": -1,
+    "mfu": +1,
+    "xla_recompiles": -1,
 }
 
 _STEP_MANIFEST_RE = re.compile(r"^step-(\d{8})$")
+
+# Fields of the xla.exec.<name>.<field> metric names the executable table
+# is reconstructed from (suffix-matched: executable names may contain
+# dots, field names never do).
+_XLA_EXEC_COUNTER_FIELDS = (
+    "calls",
+    "compiles",
+    "compile_seconds",
+    "recompiles",
+    "flops_total",
+    "bytes_total",
+)
+_XLA_EXEC_GAUGE_FIELDS = ("flops_per_call", "bytes_per_call", "temp_bytes")
+
+# device_utilization() cache sentinel (the computed value may be None)
+_DU_UNSET = object()
 
 
 @dataclasses.dataclass
@@ -113,11 +131,19 @@ def compare_metrics(
 @dataclasses.dataclass
 class PhaseNode:
     """One aggregated node of the phase-time tree (all spans sharing the
-    same name-path merged: count, total wall time, and self time)."""
+    same name-path merged: count, total wall time, and self time).
+
+    ``flops``/``bytes``/``comms_bytes`` hold the device-cost attrs the
+    instrumented-jit layer accumulated on spans AT this node; the
+    ``subtree_*`` accessors include descendants — the per-phase roofline
+    numerators."""
 
     name: str
     count: int = 0
     total_s: float = 0.0
+    flops: float = 0.0
+    bytes: float = 0.0
+    comms_bytes: float = 0.0
     children: dict[str, "PhaseNode"] = dataclasses.field(default_factory=dict)
 
     @property
@@ -126,8 +152,25 @@ class PhaseNode:
             self.total_s - sum(c.total_s for c in self.children.values()), 0.0
         )
 
+    def _subtree(self, field: str) -> float:
+        return getattr(self, field) + sum(
+            c._subtree(field) for c in self.children.values()
+        )
+
+    @property
+    def subtree_flops(self) -> float:
+        return self._subtree("flops")
+
+    @property
+    def subtree_bytes(self) -> float:
+        return self._subtree("bytes")
+
+    @property
+    def subtree_comms_bytes(self) -> float:
+        return self._subtree("comms_bytes")
+
     def to_dict(self) -> dict[str, Any]:
-        return {
+        d = {
             "name": self.name,
             "count": self.count,
             "total_s": round(self.total_s, 6),
@@ -139,6 +182,13 @@ class PhaseNode:
                 )
             ],
         }
+        if self.subtree_flops:
+            d["flops"] = self.subtree_flops
+        if self.subtree_bytes:
+            d["bytes_accessed"] = self.subtree_bytes
+        if self.subtree_comms_bytes:
+            d["comms_bytes"] = self.subtree_comms_bytes
+        return d
 
 
 def build_phase_tree(spans: Sequence[Mapping[str, Any]]) -> PhaseNode:
@@ -161,6 +211,10 @@ def build_phase_tree(spans: Sequence[Mapping[str, Any]]) -> PhaseNode:
             node = node.children.setdefault(name, PhaseNode(name=name))
         node.count += 1
         node.total_s += float(s.get("dur") or 0.0)
+        attrs = s.get("attrs") or {}
+        node.flops += float(attrs.get("xla_flops") or 0.0)
+        node.bytes += float(attrs.get("xla_bytes") or 0.0)
+        node.comms_bytes += float(attrs.get("comms_bytes") or 0.0)
     return root
 
 
@@ -349,6 +403,12 @@ class RunReport:
         dropped = counters.get("trace.dropped_spans")
         if dropped:
             out["dropped_spans"] = float(dropped)
+        recompiles = counters.get("xla.recompiles")
+        if recompiles:
+            out["xla_recompiles"] = float(recompiles)
+        du = self.device_utilization()
+        if du is not None and du.get("mfu") is not None:
+            out["mfu"] = float(du["mfu"])
         return out
 
     def coordinate_summary(self) -> list[dict]:
@@ -388,6 +448,134 @@ class RunReport:
             c["consecutive_rollbacks"] = int(rollback_counts.get(name, 0))
         return sorted(agg.values(), key=lambda c: c["coordinate"])
 
+    # -- device utilization (telemetry.xla) ----------------------------------
+
+    def xla_executables(self, k: int = 10) -> list[dict]:
+        """Top-k accounted executables, reconstructed from the
+        ``xla.exec.<name>.<field>`` metrics so a report loaded from a
+        metrics JSONL alone still ranks them. Ranked by total FLOPs when
+        known, else by compile seconds."""
+        counters = self.snapshot.get("counters", {})
+        gauges = self.snapshot.get("gauges", {})
+        execs: dict[str, dict[str, Any]] = {}
+        for source, fields in (
+            (counters, _XLA_EXEC_COUNTER_FIELDS),
+            (gauges, _XLA_EXEC_GAUGE_FIELDS),
+        ):
+            for key, value in source.items():
+                if not key.startswith("xla.exec.") or value is None:
+                    continue
+                rest = key[len("xla.exec."):]
+                for field in fields:
+                    if rest.endswith("." + field):
+                        name = rest[: -len(field) - 1]
+                        execs.setdefault(name, {"name": name})[field] = value
+                        break
+        ranked = sorted(
+            execs.values(),
+            key=lambda e: (
+                e.get("flops_total") or 0.0,
+                e.get("compile_seconds") or 0.0,
+            ),
+            reverse=True,
+        )
+        return ranked[:k]
+
+    def device_utilization(self) -> Optional[dict[str, Any]]:
+        """Roofline accounting for the run: overall + per-phase FLOPs,
+        MFU, HBM-bandwidth utilization, comms bytes/fraction, and
+        compile-time share. ``None`` when the run carried no
+        instrumented-jit accounting at all; individual fields are None
+        ("unknown") when the backend offers no cost analysis or the
+        device peaks are unknown. Cached per instance: a report render
+        consumes it from key_metrics, markdown, AND to_json, and the
+        underlying spans/snapshot never change after construction."""
+        cached = self.__dict__.get("_du_cache", _DU_UNSET)
+        if cached is not _DU_UNSET:
+            return cached
+        du = self._device_utilization()
+        self.__dict__["_du_cache"] = du
+        return du
+
+    def _device_utilization(self) -> Optional[dict[str, Any]]:
+        counters = self.snapshot.get("counters", {})
+        gauges = self.snapshot.get("gauges", {})
+        if not any(
+            k.startswith(("xla.", "comms.")) for k in counters
+        ):
+            return None
+        peak_flops = gauges.get("device.peak_flops")
+        peak_bw = gauges.get("device.peak_hbm_bytes_per_sec")
+        tree = self.phase_tree()
+        run_total_s = sum(c.total_s for c in tree.children.values())
+        flops_total = counters.get("xla.flops_total")
+        bytes_total = counters.get("xla.bytes_total")
+        comms_total = counters.get("comms.bytes_total")
+        compile_s = counters.get(
+            "xla.compile_seconds", counters.get("jit_compile_seconds")
+        )
+
+        def _util(work, peak, seconds):
+            if work is None or not peak or not seconds:
+                return None
+            return work / (peak * seconds)
+
+        def _comms_fraction(comms, hbm_bytes):
+            # comms recorded but HBM bytes unknown (no cost analysis):
+            # the denominator is unknowable — say "unknown", never 100%
+            if hbm_bytes is None:
+                return None
+            total = (comms or 0.0) + hbm_bytes
+            return (comms or 0.0) / total if total else None
+
+        phases: list[dict[str, Any]] = []
+
+        def walk(node: PhaseNode, path: list[str]) -> None:
+            for child in sorted(
+                node.children.values(), key=lambda c: -c.total_s
+            ):
+                p = path + [child.name]
+                f = child.subtree_flops or None
+                b = child.subtree_bytes or None
+                cb = child.subtree_comms_bytes or None
+                if f or b or cb:
+                    phases.append(
+                        {
+                            "phase": " > ".join(p),
+                            "total_s": round(child.total_s, 6),
+                            "flops": f,
+                            "bytes_accessed": b,
+                            "comms_bytes": cb,
+                            "mfu": _util(f, peak_flops, child.total_s),
+                            "bandwidth_utilization": _util(
+                                b, peak_bw, child.total_s
+                            ),
+                            "comms_fraction": _comms_fraction(cb, b),
+                        }
+                    )
+                walk(child, p)
+
+        walk(tree, [])
+        return {
+            "peak_flops": peak_flops,
+            "peak_hbm_bytes_per_sec": peak_bw,
+            "flops_total": flops_total,
+            "bytes_accessed_total": bytes_total,
+            "comms_bytes_total": comms_total,
+            "mfu": _util(flops_total, peak_flops, run_total_s),
+            "bandwidth_utilization": _util(bytes_total, peak_bw, run_total_s),
+            "comms_fraction": _comms_fraction(comms_total, bytes_total),
+            "compile_seconds": compile_s,
+            "compile_time_share": (
+                compile_s / run_total_s
+                if compile_s is not None and run_total_s
+                else None
+            ),
+            "recompiles": counters.get("xla.recompiles", 0),
+            "phases": phases,
+            "top_executables": self.xla_executables(),
+        }
+
     # -- compare -------------------------------------------------------------
 
     def compare(
@@ -418,6 +606,7 @@ class RunReport:
             "phases": self.phase_tree().to_dict()["children"],
             "top_spans": self.top_spans(),
             "coordinates": self.coordinate_summary(),
+            "device_utilization": self.device_utilization(),
             "counters": counters,
             "gauges": self.snapshot.get("gauges", {}),
             "histograms": self.snapshot.get("histograms", {}),
@@ -479,6 +668,7 @@ class RunReport:
                 )
             lines.append("")
 
+        lines += self._device_utilization_markdown()
         lines += self._accounting_markdown()
         lines += self._memory_markdown()
         lines += self._coordinates_markdown()
@@ -496,6 +686,101 @@ class RunReport:
         if deltas is not None:
             lines += _compare_markdown(deltas)
         return "\n".join(lines).rstrip() + "\n"
+
+    def _device_utilization_markdown(self) -> list[str]:
+        du = self.device_utilization()
+        if du is None:
+            return []
+        out = ["## Device utilization", ""]
+        peak = du["peak_flops"]
+        out.append(
+            "- MFU: "
+            + _fmt_pct(du["mfu"])
+            + (
+                f" (peak {_fmt(peak / 1e12)} TFLOP/s)"
+                if peak
+                else " (device peak FLOP/s unknown)"
+            )
+        )
+        out.append(
+            "- HBM bandwidth utilization: "
+            + _fmt_pct(du["bandwidth_utilization"])
+            + (
+                f" (peak {_fmt_bytes(du['peak_hbm_bytes_per_sec'])}/s)"
+                if du["peak_hbm_bytes_per_sec"]
+                else " (device peak bandwidth unknown)"
+            )
+        )
+        out.append(
+            f"- total FLOPs: {_fmt_or_unknown(du['flops_total'])}; "
+            f"bytes accessed: "
+            + (
+                _fmt_bytes(du["bytes_accessed_total"])
+                if du["bytes_accessed_total"] is not None
+                else "unknown"
+            )
+        )
+        comms = du["comms_bytes_total"]
+        out.append(
+            "- estimated collective bytes: "
+            + (_fmt_bytes(comms) if comms is not None else "unknown")
+            + f" (comms fraction {_fmt_pct(du['comms_fraction'])})"
+        )
+        out.append(
+            "- compile time: "
+            + (
+                f"{_fmt(du['compile_seconds'])}s "
+                f"({_fmt_pct(du['compile_time_share'])} of run)"
+                if du["compile_seconds"] is not None
+                else "unknown"
+            )
+            + f"; recompiles: {int(du['recompiles'])}"
+        )
+        if du["phases"]:
+            out += [
+                "",
+                "| phase | s | FLOPs | MFU | bytes | BW util | comms |",
+                "|---|---|---|---|---|---|---|",
+            ]
+            for p in du["phases"]:
+                out.append(
+                    f"| `{p['phase']}` | {p['total_s']:.3f} | "
+                    f"{_fmt_or_unknown(p['flops'])} | "
+                    f"{_fmt_pct(p['mfu'])} | "
+                    + (
+                        _fmt_bytes(p["bytes_accessed"])
+                        if p["bytes_accessed"] is not None
+                        else "unknown"
+                    )
+                    + f" | {_fmt_pct(p['bandwidth_utilization'])} | "
+                    + (
+                        _fmt_bytes(p["comms_bytes"])
+                        if p["comms_bytes"] is not None
+                        else "—"
+                    )
+                    + " |"
+                )
+        top = du["top_executables"]
+        if top:
+            out += [
+                "",
+                "Top executables by cost:",
+                "",
+                "| executable | calls | compiles | compile s | "
+                "FLOPs total | bytes total | recompiles |",
+                "|---|---|---|---|---|---|---|",
+            ]
+            for e in top:
+                out.append(
+                    f"| `{e['name']}` | {_fmt(e.get('calls'))} | "
+                    f"{_fmt(e.get('compiles'))} | "
+                    f"{_fmt(e.get('compile_seconds'))} | "
+                    f"{_fmt_or_unknown(e.get('flops_total'))} | "
+                    f"{_fmt_or_unknown(e.get('bytes_total'))} | "
+                    f"{_fmt(e.get('recompiles') or 0)} |"
+                )
+        out.append("")
+        return out
 
     def _accounting_markdown(self) -> list[str]:
         c = self.snapshot.get("counters", {})
@@ -659,6 +944,21 @@ def _fmt(value: Any) -> str:
     if f == int(f) and abs(f) < 1e15:
         return str(int(f))
     return f"{f:.4g}"
+
+
+def _fmt_pct(value: Any) -> str:
+    """Percentage or the explicit string "unknown" (backends without cost
+    analysis / unknown device peaks must say so, never show 0)."""
+    if value is None:
+        return "unknown"
+    try:
+        return f"{float(value):.1%}"
+    except (TypeError, ValueError):
+        return "unknown"
+
+
+def _fmt_or_unknown(value: Any) -> str:
+    return "unknown" if value is None else _fmt(value)
 
 
 def _fmt_bytes(value: Any) -> str:

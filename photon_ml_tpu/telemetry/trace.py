@@ -47,6 +47,7 @@ __all__ = [
     "configure",
     "reset",
     "finished_spans",
+    "set_annotation_factory",
     "to_chrome_trace",
     "export_chrome_trace",
     "perfetto_path",
@@ -126,6 +127,10 @@ class Tracer:
         self._sink_path: Optional[str] = None
         self._sink_fh = None
         self._wall_anchor: Optional[str] = None
+        # optional per-span mirror: a context-manager factory (e.g.
+        # jax.profiler.TraceAnnotation) entered/exited with every span so
+        # the span tree aligns with xprof timelines (`cli profile`)
+        self._annotation_factory = None
 
     # -- configuration -------------------------------------------------------
 
@@ -165,11 +170,19 @@ class Tracer:
         self._sink_fh = None
         self._sink_path = None
 
+    def set_annotation_factory(self, factory) -> None:
+        """Mirror every span into ``factory(name)`` context managers —
+        ``jax.profiler.TraceAnnotation`` makes the span tree line up with
+        xprof timelines during a ``cli profile`` capture. ``None``
+        disables. Annotation failures never fail the span."""
+        self._annotation_factory = factory
+
     def reset(self) -> None:
         """Drop all finished spans, close the sink, clear EVERY thread's
         open-span stack (test isolation; a span left open on a worker
         thread must not parent post-reset spans), and restore the
-        constructor-default buffer limit and drop accounting."""
+        constructor-default buffer limit, drop accounting, and the span
+        annotation mirror."""
         with self._lock:
             self._finished.clear()
             self._close_sink_locked()
@@ -177,6 +190,7 @@ class Tracer:
                 stack.clear()
             self._buffer_limit = self._default_buffer_limit
             self.dropped_spans = 0
+            self._annotation_factory = None
 
     # -- span lifecycle ------------------------------------------------------
 
@@ -227,9 +241,22 @@ class Tracer:
             attrs=dict(attrs),
         )
         stack.append(s)
+        annotation = None
+        factory = self._annotation_factory
+        if factory is not None:
+            try:
+                annotation = factory(name)
+                annotation.__enter__()
+            except Exception:  # noqa: BLE001 — mirroring must never fail
+                annotation = None
         try:
             yield s
         finally:
+            if annotation is not None:
+                try:
+                    annotation.__exit__(None, None, None)
+                except Exception:  # noqa: BLE001
+                    pass
             s.dur = self.now() - s.ts
             # close even if exits arrive out of order (a leaked child span)
             while stack and stack[-1] is not s:
@@ -289,6 +316,7 @@ active_span_path = TRACER.active_span_path
 configure = TRACER.configure
 reset = TRACER.reset
 finished_spans = TRACER.finished_spans
+set_annotation_factory = TRACER.set_annotation_factory
 
 
 # -- Chrome trace (Perfetto) export ------------------------------------------

@@ -54,7 +54,7 @@ def _sweep_solver(config: OptimizerConfig):
     def _sweep_solve(obj, batch, w0, l1, constraints):
         return dispatch_solve(glm_adapter(obj, batch), w0, config, l1, constraints)
 
-    return jax.jit(_sweep_solve)
+    return telemetry.instrumented_jit(_sweep_solve, name="glm_sweep_solve")
 
 
 @dataclasses.dataclass

@@ -619,7 +619,18 @@ def test_cli_serve_stdio_subprocess(tmp_path, game_world):
     expected = np.asarray(model.predict_mean(data))[:4]
     np.testing.assert_allclose(lines[0]["scores"], expected, atol=1e-6)
     assert lines[0]["model_version"] == "v-00000001"
-    assert lines[1] == {
+    health = lines[1]
+    # warm state carries per-batch-bucket compile accounting (ISSUE 5):
+    # one executable-registry entry per padded bucket, with compile wall
+    # time always present and cost fields null-or-numeric ("unknown" on
+    # backends without cost analysis, never a crash)
+    compile_state = health.pop("compile")
+    assert set(compile_state) == {"1", "2", "4", "8"}
+    for entry in compile_state.values():
+        assert entry["compile_seconds"] >= 0
+        assert entry["calls"] >= 1
+        assert "flops" in entry and "bytes_accessed" in entry
+    assert health == {
         "status": "serving", "model_version": "v-00000001",
         "warm": True, "buckets": [1, 2, 4, 8],
     }

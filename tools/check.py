@@ -33,6 +33,11 @@ Runs, in order:
          serving hot-path modules (photon_ml_tpu/serving/{engine,batcher}.py)
          — every request would pay a full tunnel round trip per call; the
          one sanctioned crossing is telemetry.sync_fetch.
+       - bare `jax.jit` in hot-path library modules (L011: parallel/,
+         game/, ops/, training.py, serving/engine.py) — jits must go
+         through telemetry.xla.instrumented_jit so compiles land in the
+         executable registry with cost analysis and recompile
+         attribution; cold paths opt out via L011_COLD_ALLOWLIST.
   3. ruff + mypy, IF installed (configs live in pyproject.toml)
 
 Exit code 0 = clean. Any finding prints `path:line: code message` and the
@@ -96,6 +101,27 @@ L010_HOT_PATH = {
     os.path.join("photon_ml_tpu", "serving", "batcher.py"),
 }
 
+# Hot-path library modules where every jit-compiled program must go
+# through telemetry.xla.instrumented_jit (L011): a bare jax.jit hides its
+# compile time, cost analysis, and recompile attribution from the
+# executable registry — exactly the blind spot that made BENCH_r05
+# unexplainable. Cold paths (one-off summaries, diagnostics) may stay on
+# bare jax.jit via the allowlist.
+L011_HOT_DIRS = (
+    os.path.join("photon_ml_tpu", "parallel") + os.sep,
+    os.path.join("photon_ml_tpu", "game") + os.sep,
+    os.path.join("photon_ml_tpu", "ops") + os.sep,
+)
+L011_HOT_FILES = {
+    os.path.join("photon_ml_tpu", "serving", "engine.py"),
+    "photon_ml_tpu/training.py".replace("/", os.sep),
+}
+L011_COLD_ALLOWLIST = {
+    # gather_to_host: a once-per-summary replicating identity, not a
+    # training/serving hot path
+    os.path.join("photon_ml_tpu", "parallel", "multihost.py"),
+}
+
 
 class _Lint(ast.NodeVisitor):
     def __init__(self, path: str, tree: ast.Module, library: bool = False):
@@ -105,6 +131,9 @@ class _Lint(ast.NodeVisitor):
         self.library = library
         self._l008_exempt = path in L008_BLESSED
         self._l010_hot = path in L010_HOT_PATH
+        self._l011_hot = (
+            path in L011_HOT_FILES or path.startswith(L011_HOT_DIRS)
+        ) and path not in L011_COLD_ALLOWLIST
         # CLI modules own stdout: bare print() is their user interface
         self._l009_exempt = path.startswith(
             os.path.join("photon_ml_tpu", "cli") + os.sep
@@ -114,6 +143,8 @@ class _Lint(ast.NodeVisitor):
         self.used: set[str] = set()
         # names bound to the wall clock by `from time import time [as x]`
         self._time_aliases: set[str] = set()
+        # names bound to the jit transform by `from jax import jit [as x]`
+        self._jit_aliases: set[str] = set()
         self._collect(tree)
 
     def _report(self, node: ast.AST, code: str, msg: str) -> None:
@@ -134,6 +165,8 @@ class _Lint(ast.NodeVisitor):
                     self.imported[a.asname or a.name] = node.lineno
                     if node.module == "time" and a.name == "time":
                         self._time_aliases.add(a.asname or a.name)
+                    if node.module == "jax" and a.name == "jit":
+                        self._jit_aliases.add(a.asname or a.name)
         self.visit(tree)
 
     def visit_Name(self, node: ast.Name) -> None:
@@ -164,6 +197,12 @@ class _Lint(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._check_defaults(node)
+        if self._l011_hot:
+            # `@jax.jit` decorators without a call are Attribute/Name
+            # nodes, invisible to visit_Call
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call) and self._is_bare_jit(dec):
+                    self._report_l011(dec)
         self.generic_visit(node)
 
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
@@ -203,6 +242,28 @@ class _Lint(ast.NodeVisitor):
             and f.attr == "dump"
             and isinstance(f.value, ast.Name)
             and f.value.id == "json"
+        )
+
+    def _is_bare_jit(self, node: ast.AST) -> bool:
+        # `jax.jit(...)` / `@jax.jit` / from-imported `jit(...)`
+        f = node.func if isinstance(node, ast.Call) else node
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "jit"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "jax"
+        ):
+            return True
+        return isinstance(f, ast.Name) and f.id in self._jit_aliases
+
+    def _report_l011(self, node: ast.AST) -> None:
+        self._report(
+            node,
+            "L011",
+            "bare jax.jit in a hot-path library module — compiles escape "
+            "the executable registry (no cost analysis, no recompile "
+            "attribution); use telemetry.xla.instrumented_jit(fn, "
+            "name=...), or add a cold path to L011_COLD_ALLOWLIST",
         )
 
     def _is_serving_sync_call(self, node: ast.Call) -> bool:
@@ -248,6 +309,8 @@ class _Lint(ast.NodeVisitor):
                 "truncated file; route through utils.atomic / the "
                 "model_store//checkpoint writers",
             )
+        if self._l011_hot and self._is_bare_jit(node):
+            self._report_l011(node)
         if self._l010_hot and self._is_serving_sync_call(node):
             self._report(
                 node,
