@@ -10,6 +10,7 @@ Prints one JSON line per metric ({"metric", "value", "unit",
   3. poisson_offsets_box_1Mx10K_rows_per_sec...  (config #3, bench_suite)
   4. glmix_fe_re_logistic_1Mx100Kusers_coeffs... (config #4, bench_game)
   5. game_1B_coeffs_trained_per_sec              (config #5, bench_scale)
+  +  multichip_* scaling efficiency at 1 vs 8 devices (bench_multichip)
   +  avro_ingest_rows_per_sec                    (bench_ingest)
 
 Sub-benchmarks run as subprocesses (fresh jit caches, bounded memory); a
@@ -232,10 +233,13 @@ from bench_suite import SUITE_METRICS as _SUITE_METRICS
 #: Expected metric lines per sub-benchmark, so a budget-skipped script
 #: still emits one valid truncated line PER metric it would have printed.
 #: bench_suite's names come from its own module — one source of truth.
+from bench_multichip import MULTICHIP_METRICS as _MULTICHIP_METRICS
+
 _SCRIPT_METRICS = {
     "bench_suite.py": _SUITE_METRICS,
     "bench_game.py": ("glmix_fe_re_logistic_1Mx100Kusers_coeffs_per_sec",),
     "bench_scale.py": ("game_1B_coeffs_trained_per_sec",),
+    "bench_multichip.py": _MULTICHIP_METRICS,
     "bench_ingest.py": ("avro_ingest_rows_per_sec",),
     "bench_serving.py": ("serving_p50_ms", "serving_p99_ms",
                          "serving_rows_per_sec"),
@@ -258,8 +262,8 @@ def run_sub_benchmarks(deadline=None):
     # north-star (20M-row full pipeline) runs last and longest; the
     # driver's BASELINE numbers come from the earlier lines either way
     for script in ("bench_suite.py", "bench_game.py", "bench_scale.py",
-                   "bench_ingest.py", "bench_serving.py",
-                   "bench_northstar.py"):
+                   "bench_multichip.py", "bench_ingest.py",
+                   "bench_serving.py", "bench_northstar.py"):
         path = os.path.join(here, script)
         expected = _SCRIPT_METRICS.get(script, (script.replace(".py", ""),))
         remaining = (

@@ -320,6 +320,16 @@ def run_gate(
     deltas = compare_metrics(
         current, baseline, threshold=threshold, directions=directions
     )
+    # metrics this run measured that the baseline has never seen (e.g. the
+    # multichip_* lines against a pre-multichip BENCH_r05 baseline) are
+    # SKIPPED WITH A NOTE — a baseline that predates a metric must never
+    # fail the gate (nor crash it); the next baseline refresh picks it up
+    for name in sorted(set(current) - set(baseline)):
+        print(
+            f"gate: {name}: new metric, not in baseline — skipped "
+            "(refresh the baseline to start gating it)",
+            file=sys.stderr,
+        )
     for d in deltas:
         status = "REGRESSED" if d.regressed else "ok"
         print(
@@ -365,8 +375,20 @@ def main(argv=None) -> int:
         default=0.2,
         help="fractional regression threshold for --gate (default 0.2)",
     )
+    parser.add_argument(
+        "--multichip",
+        action="store_true",
+        help="also run bench_multichip.py (1-vs-8-device scaling "
+        "efficiency) and include its metrics in the gate; baselines that "
+        "predate the multichip_* metrics skip them with a note",
+    )
     args = parser.parse_args(argv)
-    results = run_suite(deadline=budget_deadline())
+    deadline = budget_deadline()
+    results = run_suite(deadline=deadline)
+    if args.multichip:
+        from bench_multichip import run_multichip
+
+        results.update(run_multichip(deadline=deadline))
     if args.gate:
         return run_gate(
             results, load_gate_baseline(args.gate), args.gate_threshold

@@ -124,6 +124,40 @@ def read_input(
     raise ValueError(f"unknown input format '{fmt}'")
 
 
+def parse_mesh_flag(raw: str):
+    """``--mesh`` flag -> config ``mesh`` value.
+
+    ``batch=N,model=M`` (either axis optional) builds the named GSPMD
+    mesh; ``auto``/``on`` is the 1-D all-devices mesh; ``off``/``none``
+    disables a config-file mesh."""
+    text = raw.strip().lower()
+    if text in ("auto", "on", "true"):
+        return True
+    if text in ("off", "none", "false"):
+        return False
+    axes: dict[str, int] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, size = part.partition("=")
+        if not eq or not name:
+            raise ValueError(
+                f"--mesh expects 'axis=N[,axis=M]' or 'auto'/'off', got "
+                f"{raw!r}"
+            )
+        try:
+            axes[name.strip()] = int(size)
+        except ValueError:
+            raise ValueError(
+                f"--mesh axis '{name.strip()}' needs an integer size, got "
+                f"{size!r}"
+            ) from None
+    if not axes:
+        raise ValueError(f"--mesh got no axes in {raw!r}")
+    return axes
+
+
 def _init_distributed_and_mesh(config: Mapping):
     """Join a multi-host fleet and build the training mesh when configured.
 
@@ -133,7 +167,10 @@ def _init_distributed_and_mesh(config: Mapping):
         env vars, and on TPU pods everything auto-detects
         (SparkContextConfiguration.asYarnClient analog).
       "mesh": true/"auto" for a 1-D mesh over all (global) devices, or
-        {"axis": size, ...} for an explicit shape.
+        {"axis": size, ...} for an explicit shape — the GSPMD vocabulary
+        is {"batch": N, "model": M} (FE rows shard over `batch`, RE
+        coefficient tables over `model`; the --mesh flag spells it
+        `batch=N,model=M`).
     """
     from photon_ml_tpu.parallel import multihost
 
@@ -441,6 +478,14 @@ def main(argv=None) -> int:
         "heartbeat)",
     )
     parser.add_argument(
+        "--mesh",
+        help="train over a named device mesh: 'batch=N,model=M' shards "
+        "FE rows over the batch axis and RE coefficient tables over the "
+        "model axis via GSPMD (either axis may be omitted); 'auto' uses a "
+        "1-D mesh over all devices; 'off' disables a config mesh "
+        "(overrides config mesh)",
+    )
+    parser.add_argument(
         "--checkpoint-dir",
         help="persist coordinate-descent state here after each "
         "(iteration, coordinate) step; SIGTERM/SIGINT then writes a final "
@@ -465,6 +510,8 @@ def main(argv=None) -> int:
     setup_logging()
     with open(args.config) as f:
         config = json.load(f)
+    if args.mesh:
+        config["mesh"] = parse_mesh_flag(args.mesh)
     if args.trace_out:
         config["trace_out"] = args.trace_out
     if args.telemetry_out:

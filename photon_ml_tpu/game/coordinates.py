@@ -8,11 +8,18 @@ the other coordinates and how to produce its scores on the training data.
 
 TPU realization:
   - FixedEffectCoordinate: one (optionally mesh-sharded) GLM solve; the
-    residuals enter as extra offsets (addScoresToOffsets analog).
+    residuals enter as extra offsets (addScoresToOffsets analog). Under a
+    mesh the FLAT design is committed with
+    ``NamedSharding(mesh, P("batch"))`` and the whole optimizer while-loop
+    runs in one GSPMD jit (parallel.distributed.gspmd_solve) — no
+    shard_map, no host restacking.
   - RandomEffectCoordinate: per geometry bucket, ONE vmapped optimizer call
     solves every entity's independent problem simultaneously; converged
-    entities freeze in the masked while-loop. No cross-device communication
-    during the solve (SURVEY.md §2.f "per-entity model parallelism").
+    entities freeze in the masked while-loop. Under a mesh the bucket's
+    entity axis is committed with ``entity_sharding(mesh, P("model"))``
+    (parallel.sharding) and GSPMD partitions the vmap lanes — no
+    cross-device communication during the solve beyond the one-scalar
+    convergence test (SURVEY.md §2.f "per-entity model parallelism").
 """
 
 from __future__ import annotations
@@ -24,7 +31,7 @@ from typing import Optional, Protocol
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.models import (
@@ -41,14 +48,9 @@ from photon_ml_tpu.optim.adapter import glm_adapter
 from photon_ml_tpu.optim.common import BoxConstraints
 from photon_ml_tpu.optim.factory import OptimizerConfig, dispatch_solve
 from photon_ml_tpu.optim.guard import damped_objective, solve_health
-from photon_ml_tpu.parallel.distributed import distributed_solve
-from photon_ml_tpu.parallel.mesh import (
-    put_sharded,
-    shard_map_compat,
-    shard_rows,
-    shard_tiles,
-)
-from photon_ml_tpu.telemetry.xla import instrumented_jit
+from photon_ml_tpu.parallel.distributed import gspmd_solve
+from photon_ml_tpu.parallel import sharding as psharding
+from photon_ml_tpu.telemetry.xla import instrumented_jit, record_collective
 
 Array = jax.Array
 
@@ -96,7 +98,7 @@ class FixedEffectCoordinate:
     config: OptimizerConfig
     seed: int = 0
     normalization: Optional[NormalizationContext] = None
-    mesh: Optional[Mesh] = None  # 1-D data-axis mesh -> distributed_solve
+    mesh: Optional[Mesh] = None  # mesh with a batch/data axis -> gspmd_solve
     layout: str = "auto"  # "auto" | "tiled" | "coo" training layout
 
     def __post_init__(self):
@@ -163,27 +165,24 @@ class FixedEffectCoordinate:
         self._l1 = jnp.float32(
             self.config.regularization.l1_weight(self.config.regularization_weight)
         )
+        if self.mesh is not None and psharding.data_axis(self.mesh) is None:
+            # an entity-only mesh has no row axis to data-parallel over;
+            # the FE block runs single-device (its RE siblings still shard)
+            self.mesh = None
         if self.mesh is not None:
-            # pre-shard the static nnz structure once; per-update offsets and
-            # weights are re-stacked on device (_restack) so residual updates
-            # and fresh down-samples never rebuild the nnz arrays
-            self._axis = self.mesh.axis_names[0]
-            self._n_shards = int(self.mesh.devices.size)
-            if self._use_tiled:
-                stacked_host = shard_tiles(self._tiled, self._n_shards)
-                self._restack_shape = (
-                    self._n_shards,
-                    int(stacked_host.offsets3.shape[1]),
-                    1,
-                    ROWS_PER_TILE,
-                )
-            else:
-                stacked_host = shard_rows(self._base_batch, self._n_shards)
-                self._restack_shape = (
-                    self._n_shards,
-                    int(stacked_host.labels.shape[1]),
-                )
-            self._stacked = put_sharded(stacked_host, self.mesh, self._axis)
+            # GSPMD path: the FLAT design (tiles or COO slots) is committed
+            # with NamedSharding(mesh, P(batch)) ONCE; per-update offsets
+            # and weights are re-placed with the same row sharding
+            # (_place_rows) so residual updates and fresh down-samples
+            # never rebuild the nnz arrays
+            self._axis = psharding.data_axis(self.mesh)
+            self._n_shards = psharding.axis_size(self.mesh, self._axis)
+            self._row_sharding = psharding.batch_sharding(self.mesh, self._axis)
+            self._solve_batch = psharding.place_batch(
+                self._tiled if self._use_tiled else self._base_batch,
+                self.mesh,
+                self._axis,
+            )
         elif not self._use_tiled:
             # single-device COO solve path: upload the design ONCE; per-row
             # updates (offsets/weights) are swapped onto this device copy
@@ -215,14 +214,18 @@ class FixedEffectCoordinate:
             batch, weights=self._downsampled_weights(batch, update_index)
         )
 
-    def _restack(self, per_row: Array) -> Array:
-        """Reshape a global [n_pad] per-row array into the stacked block
-        layout of shard_rows / shard_tiles and place it on the mesh."""
-        total = int(np.prod(self._restack_shape))
+    def _place_rows(self, per_row: Array) -> Array:
+        """Pad a global [n_pad] per-row array to the sharded solve batch's
+        row count (tiled: into its [T, 1, 128] grid) and commit it with the
+        batch-axis sharding, matching the resident design's placement."""
         a = jnp.asarray(per_row, jnp.float32)
-        a = jnp.pad(a, (0, total - a.shape[0]))
-        a = a.reshape(self._restack_shape)
-        return jax.device_put(a, NamedSharding(self.mesh, P(self._axis)))
+        if self._use_tiled:
+            tiles = self._solve_batch.num_tiles
+            a = jnp.pad(a, (0, tiles * ROWS_PER_TILE - a.shape[0]))
+            a = a.reshape(tiles, 1, ROWS_PER_TILE)
+        else:
+            a = jnp.pad(a, (0, self._solve_batch.num_rows - a.shape[0]))
+        return jax.device_put(a, self._row_sharding)
 
     def _tiled_rows(self, per_row: Array, reshape: bool = True) -> Array:
         """Pad a global [n_pad] per-row array to the tiled row count
@@ -257,27 +260,27 @@ class FixedEffectCoordinate:
         off_field = "offsets3" if self._use_tiled else "offsets"
         wgt_field = "weights3" if self._use_tiled else "weights"
         if self.mesh is not None:
-            # DP path (FixedEffectCoordinate.scala:136-147): rows sharded
-            # over the mesh, whole while-loop inside shard_map, grads psum'd.
-            # Only changed per-row arrays are re-stacked onto the mesh.
-            stacked = self._stacked
+            # DP path (FixedEffectCoordinate.scala:136-147): rows committed
+            # P(batch), whole while-loop in ONE GSPMD jit, grads psum'd by
+            # the compiler. Only changed per-row arrays are re-placed.
+            batch = self._solve_batch
             if residual_scores is not None:
-                stacked = dataclasses.replace(
-                    stacked,
-                    **{off_field: self._restack(
+                batch = dataclasses.replace(
+                    batch,
+                    **{off_field: self._place_rows(
                         self._base_batch.offsets + residual_scores
                     )},
                 )
             if self.config.down_sampling_rate < 1.0:
-                stacked = dataclasses.replace(
-                    stacked,
-                    **{wgt_field: self._restack(
+                batch = dataclasses.replace(
+                    batch,
+                    **{wgt_field: self._place_rows(
                         self._downsampled_weights(self._base_batch, update_index)
                     )},
                 )
-            res = distributed_solve(
+            res = gspmd_solve(
                 self.loss_name,
-                stacked,
+                batch,
                 self.config,
                 w0,
                 self.mesh,
@@ -405,53 +408,44 @@ def _re_solver(
     )
 
 
-@lru_cache(maxsize=64)
-def _re_solver_sharded(
-    config: OptimizerConfig,
-    loss_name: str,
+def place_entity_solve(
     mesh: Mesh,
-    axis: str,
-    constrained: bool | str = False,
-    compute_variances: bool = False,
-    packed: bool = False,
+    axis: Optional[str],
+    batch,
+    w0: Array,
+    constraints: Optional[BoxConstraints] = None,
+    shared_constraints: bool = False,
 ):
-    """Entity-sharded bucket solver: explicit shard_map over ``axis`` — each
-    device runs the vmapped while-loop solve on its local entity block with
-    NO collectives (per-entity problems are independent; the EP-like strategy
-    of SURVEY.md §2.f / RandomEffectCoordinate.scala:101-130).
-
-    ``constrained="shared"``: one replicated [K] box for every entity
-    (streaming dense space) instead of entity-sharded [E, K] bounds."""
-
-    solve_one = _adapt_solve_one(config, compute_variances, packed)
-    c_axis = 0 if constrained is True else None
-    c_spec = P(axis) if constrained is True else P()
-
-    def local(obj, bucket_batch, w0, l1, constraints):
-        return jax.vmap(solve_one, in_axes=(None, 0, 0, None, c_axis))(
-            obj, bucket_batch, w0, l1, constraints
+    """Commit one bucket/chunk solve's inputs for GSPMD entity sharding:
+    batch leaves and w0 get ``entity_sharding(mesh, axis)`` on their
+    leading [E] dim (already padded to the axis size), constraint boxes
+    get the same placement when per-entity ([E, K]) or replication when
+    shared ([K], the streaming dense space). The plain vmapped ``_re_solver``
+    then runs under one jit with the lanes partitioned by the compiler —
+    the EP-like strategy of SURVEY.md §2.f / RandomEffectCoordinate
+    .scala:101-130, with no hand-rolled shard_map."""
+    eshard = psharding.entity_sharding(mesh, axis)
+    batch = jax.tree.map(lambda x: jax.device_put(x, eshard), batch)
+    w0 = jax.device_put(w0, eshard)
+    if constraints is not None:
+        put = (
+            psharding.place_replicated(constraints, mesh)
+            if shared_constraints
+            else jax.tree.map(lambda x: jax.device_put(x, eshard), constraints)
         )
+        constraints = put
+    return batch, w0, constraints
 
-    def wrapped(obj, bucket_batch, w0, l1, constraints):
-        rep = lambda t: jax.tree.map(lambda _: P(), t)
-        return shard_map_compat(
-            local,
-            mesh=mesh,
-            in_specs=(
-                rep(obj),
-                jax.tree.map(lambda _: P(axis), bucket_batch),
-                P(axis),
-                P(),
-                jax.tree.map(lambda _: c_spec, constraints),
-            ),
-            out_specs=P(axis),
-            check=False,
-        )(obj, bucket_batch, w0, l1, constraints)
 
-    return instrumented_jit(
-        wrapped,
-        name="re_solve_sharded_dense" if packed else "re_solve_sharded",
-        multi_shape=True,  # per-bucket shapes are the design
+def record_entity_solve_comms(label: str, mesh: Mesh, axis: str,
+                              iterations: int) -> None:
+    """Static comms estimate for one entity-sharded vmapped solve: the
+    per-entity problems are independent — the only cross-device traffic
+    the masked while-loop needs is its one-scalar convergence test
+    (all-reduce of the active mask) per iteration."""
+    record_collective(
+        label, "psum", int(mesh.shape[axis]), 4,
+        count=max(int(iterations), 1),
     )
 
 
@@ -570,7 +564,8 @@ class RandomEffectCoordinate:
     re_data: RandomEffectDataset
     loss_name: str
     config: OptimizerConfig
-    mesh: Optional[Mesh] = None  # 1-D entity-axis mesh -> shard_map solve
+    mesh: Optional[Mesh] = None  # mesh with a model/entity axis -> GSPMD
+    # entity-sharded bucket solves (place_entity_solve)
     compute_variances: bool = False  # per-coefficient Hessian-diag inverse
 
     def __post_init__(self):
@@ -608,23 +603,11 @@ class RandomEffectCoordinate:
                 )
         key_cfg = dataclasses.replace(self.config, regularization_weight=0.0)
         if self.mesh is not None:
-            self._sharded_solver = _re_solver_sharded(
-                key_cfg,
-                self.loss_name,
-                self.mesh,
-                self.mesh.axis_names[0],
-                constrained,
-                self.compute_variances,
-            )
-            self._sharded_dense_solver = _re_solver_sharded(
-                key_cfg,
-                self.loss_name,
-                self.mesh,
-                self.mesh.axis_names[0],
-                constrained,
-                self.compute_variances,
-                packed=True,
-            )
+            # GSPMD entity sharding: the same vmapped solvers serve the
+            # mesh path, with inputs committed P(model) per bucket
+            self._axis = psharding.model_axis(self.mesh)
+            if self._axis is None:
+                self.mesh = None  # batch-only mesh: no entity axis to use
         self._solver = _re_solver(
             key_cfg, self.loss_name, constrained, self.compute_variances
         )
@@ -681,7 +664,10 @@ class RandomEffectCoordinate:
         tracker_vals = []
         healths = []
         obj = damped_objective(self._obj, self.extra_l2)
-        n_dev = 0 if self.mesh is None else int(self.mesh.devices.size)
+        n_dev = (
+            0 if self.mesh is None
+            else psharding.axis_size(self.mesh, self._axis)
+        )
         for i, (b, bm) in enumerate(zip(self._buckets, model.buckets)):
             bucket = (
                 b if residual_scores is None else b.with_extra_offsets(residual_scores)
@@ -700,8 +686,8 @@ class RandomEffectCoordinate:
                 bb = bucket.entity_batch()
             w0 = bm.coefficients
             cons = self._bucket_constraints[i]
+            solver = self._dense_solver if dense else self._solver
             if self.mesh is None:
-                solver = self._dense_solver if dense else self._solver
                 res, var = solver(obj, bb, w0, self._l1, cons)
                 w = res.w
             else:
@@ -709,9 +695,12 @@ class RandomEffectCoordinate:
                 total = -(-num_e // n_dev) * n_dev
                 bb_p, w0_p = _pad_entities(bb, w0, total)
                 cons_p = _pad_constraints(cons, total)
-                solver = (
-                    self._sharded_dense_solver if dense
-                    else self._sharded_solver
+                bb_p, w0_p, cons_p = place_entity_solve(
+                    self.mesh, self._axis, bb_p, w0_p, cons_p
+                )
+                record_entity_solve_comms(
+                    "re_solve", self.mesh, self._axis,
+                    self.config.max_iterations,
                 )
                 res, var = solver(obj, bb_p, w0_p, self._l1, cons_p)
                 w = res.w[:num_e]

@@ -231,14 +231,34 @@ class GameEstimator:
         opt_overrides: Optional[Mapping[str, OptimizerConfig]] = None,
         only: Optional[set] = None,
     ) -> dict:
-        # One physical mesh, two logical 1-D views over the same devices:
-        # FE rows shard over the 'data' axis, RE entity batches over the
-        # 'entity' axis (SURVEY.md §2.f). Views are free — no data movement.
+        # Meshes with named batch/model axes (the GSPMD vocabulary,
+        # parallel.sharding; `--mesh batch=N,model=M`) are used AS GIVEN:
+        # each coordinate resolves its own axis, so FE rows shard over
+        # 'batch' and RE entity state over 'model' on one physical mesh.
+        # A legacy 1-D mesh still becomes two logical 1-D views over the
+        # same devices ('data' for FE rows, 'entity' for RE batches,
+        # SURVEY.md §2.f). Views are free — no data movement.
         data_mesh = entity_mesh = None
         if mesh is not None:
-            devices = mesh.devices.reshape(-1)
-            data_mesh = Mesh(devices, (DATA_AXIS,))
-            entity_mesh = Mesh(devices, (ENTITY_AXIS,))
+            from photon_ml_tpu.parallel.sharding import BATCH_AXIS, MODEL_AXIS
+
+            named = set(mesh.axis_names) & {BATCH_AXIS, MODEL_AXIS}
+            if named or len(mesh.axis_names) > 1:
+                from photon_ml_tpu.parallel.sharding import data_axis, model_axis
+
+                if data_axis(mesh) is None and model_axis(mesh) is None:
+                    # every coordinate would silently drop the mesh and the
+                    # user's N provisioned devices would train single-device
+                    raise ValueError(
+                        f"mesh axes {mesh.axis_names} name neither a "
+                        "batch/data nor a model/entity axis — nothing would "
+                        "shard; use --mesh batch=N,model=M (or a 1-D mesh)"
+                    )
+                data_mesh = entity_mesh = mesh
+            else:
+                devices = mesh.devices.reshape(-1)
+                data_mesh = Mesh(devices, (DATA_AXIS,))
+                entity_mesh = Mesh(devices, (ENTITY_AXIS,))
         overrides = opt_overrides or {}
         # the caches serve REPEATED fits over the same data (benchmarks,
         # grid sweeps, warm-started re-fits); entries for other datasets are

@@ -300,18 +300,11 @@ class FactoredRandomEffectCoordinate:
         if not self.refit_projection:
             # fixed projection: the kron structure is never needed
             key_re = dataclasses.replace(self.re_config, regularization_weight=0.0)
-            from photon_ml_tpu.game.coordinates import (
-                _re_solver,
-                _re_solver_sharded,
-            )
+            from photon_ml_tpu.game.coordinates import _re_solver
 
             self._re_solver = _re_solver(key_re, self.loss_name)
             if self.mesh is not None:
-                self._axis = self.mesh.axis_names[0]
-                self._n_dev = int(self.mesh.devices.size)
-                self._re_solver_sharded = _re_solver_sharded(
-                    key_re, self.loss_name, self.mesh, self._axis
-                )
+                self._resolve_mesh_axis()
             self._re_obj = make_objective(
                 self.loss_name,
                 l2_weight=self.re_config.regularization.l2_weight(
@@ -390,16 +383,12 @@ class FactoredRandomEffectCoordinate:
         key_lat = dataclasses.replace(self.latent_config, regularization_weight=0.0)
         # the per-entity bucket solver is shared with RandomEffectCoordinate
         # (identical dispatch; one lru_cache entry for both coordinate types)
-        from photon_ml_tpu.game.coordinates import _re_solver, _re_solver_sharded
+        from photon_ml_tpu.game.coordinates import _re_solver
 
         self._re_solver = _re_solver(key_re, self.loss_name)
         self._lat_solver = _latent_fit_solver(key_lat, self.loss_name)
         if self.mesh is not None:
-            self._axis = self.mesh.axis_names[0]
-            self._n_dev = int(self.mesh.devices.size)
-            self._re_solver_sharded = _re_solver_sharded(
-                key_re, self.loss_name, self.mesh, self._axis
-            )
+            self._resolve_mesh_axis()
             # mesh mode never materializes the single-device kron template
             self._latent_template = None
             self._build_stacked_latent(kron_rows[o], kron_cols[o], lab, wgt)
@@ -435,6 +424,21 @@ class FactoredRandomEffectCoordinate:
                 self.latent_config.regularization_weight
             )
         )
+
+    def _resolve_mesh_axis(self) -> None:
+        """Pick the ONE mesh axis this coordinate parallelizes over: the
+        entity-sharded latent solves and the row-stacked kron refit both
+        use it, so their shard counts agree. A model/entity axis wins
+        (the latent table is per-entity state), then a batch/data axis,
+        then the mesh's first axis (legacy 1-D meshes)."""
+        from photon_ml_tpu.parallel import sharding as psharding
+
+        self._axis = (
+            psharding.model_axis(self.mesh)
+            or psharding.data_axis(self.mesh)
+            or self.mesh.axis_names[0]
+        )
+        self._n_dev = psharding.axis_size(self.mesh, self._axis)
 
     def _build_stacked_latent(self, rows_np, cols_np, lab, wgt) -> None:
         """Pre-shard the STATIC kronecker structure over the mesh: contiguous
@@ -545,10 +549,21 @@ class FactoredRandomEffectCoordinate:
                 w = res.w
             else:
                 total = -(-E // self._n_dev) * self._n_dev
-                from photon_ml_tpu.game.coordinates import _pad_entities
+                from photon_ml_tpu.game.coordinates import (
+                    _pad_entities,
+                    place_entity_solve,
+                    record_entity_solve_comms,
+                )
 
                 dense_p, w0_p = _pad_entities(dense, w0, total)
-                res, _ = self._re_solver_sharded(
+                dense_p, w0_p, _ = place_entity_solve(
+                    self.mesh, self._axis, dense_p, w0_p
+                )
+                record_entity_solve_comms(
+                    "latent_re_solve", self.mesh, self._axis,
+                    self.re_config.max_iterations,
+                )
+                res, _ = self._re_solver(
                     self._re_obj, dense_p, w0_p, self._re_l1, None
                 )
                 w = res.w[:E]

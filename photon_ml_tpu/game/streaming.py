@@ -20,8 +20,12 @@ cluster. The TPU-native answer:
     fetch behind a partition solve.
   - Each chunk is ONE vmapped optimizer call on the dense local-design
     layout (ops/dense.DenseBatch — pure MXU-batched matmul sweeps, no
-    random access); under a mesh the chunk is entity-sharded by shard_map
-    with NO collectives (RandomEffectCoordinate.scala:101-130 semantics).
+    random access); under a mesh the chunk is committed with
+    ``parallel.sharding.entity_sharding`` (the reusable P("model")
+    primitive shared with the RE bucket solves and, per ROADMAP item 4,
+    sharded serving) and GSPMD partitions the vmap lanes — the only
+    collective is the one-scalar convergence test per iteration
+    (RandomEffectCoordinate.scala:101-130 semantics).
 
 ``bench_scale.py`` drives this at ~1e9 coefficients on one chip;
 ``__graft_entry__.dryrun_multichip`` runs the sharded-table path on the
@@ -38,9 +42,11 @@ from typing import Callable, Iterable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.parallel import sharding as psharding
+from photon_ml_tpu.telemetry.xla import record_collective
 from photon_ml_tpu.telemetry import memory as telemetry_memory
 from photon_ml_tpu.ops.dense import DenseBatch
 from photon_ml_tpu.ops.objective import make_objective
@@ -88,24 +94,29 @@ class ShardedCoefficientTable:
         num_entities: int,
         dim: int,
         mesh: Optional[Mesh] = None,
-        axis: str = "entity",
+        axis: Optional[str] = None,
         dtype=jnp.float32,
     ):
         self.num_entities = int(num_entities)
         self.dim = int(dim)
         self.mesh = mesh
-        self.axis = axis
         if mesh is None:
+            self.axis = axis
             self.sharding = None
             self.coefficients = jnp.zeros((num_entities, dim), dtype)
         else:
-            n_dev = int(mesh.devices.size)
+            # the ONE entity-sharding definition (parallel.sharding):
+            # training tables, bucket solves and sharded serving all place
+            # through it, so their shards line up across the mesh
+            self.sharding = psharding.entity_sharding(mesh, axis)
+            self.axis = self.sharding.spec[0]
+            n_dev = psharding.axis_size(mesh, self.axis)
             if num_entities % n_dev:
                 raise ValueError(
                     f"num_entities={num_entities} must divide over the "
-                    f"{n_dev}-device '{axis}' axis (pad the entity count)"
+                    f"{n_dev}-device '{self.axis}' axis (pad the entity "
+                    "count)"
                 )
-            self.sharding = NamedSharding(mesh, P(axis, None))
             # jit-with-out_shardings materializes the zeros directly in
             # their sharded layout — no host/full-device copy, and it is
             # multi-controller-safe (every process runs the same program
@@ -218,18 +229,17 @@ class StreamingRandomEffectTrainer:
         loss_name: str,
         config: OptimizerConfig,
         mesh: Optional[Mesh] = None,
-        axis: str = "entity",
+        axis: Optional[str] = None,
         compute_variances: bool = False,
         prefetch: bool = True,
         guard: Optional[GuardSpec] = None,
         feed_retries: int = 2,
     ):
-        # the vmapped / shard_mapped per-entity solver builders are shared
-        # with RandomEffectCoordinate — one lru_cache entry serves both
-        from photon_ml_tpu.game.coordinates import (
-            _re_solver,
-            _re_solver_sharded,
-        )
+        # the vmapped per-entity solver builder is shared with
+        # RandomEffectCoordinate — one lru_cache entry serves both, and
+        # the SAME compiled family serves mesh and single-device calls
+        # (sharded dispatch signatures are distinct registry entries)
+        from photon_ml_tpu.game.coordinates import _re_solver
         from photon_ml_tpu.ops.losses import get_loss
 
         config.validate(loss_name)
@@ -261,23 +271,17 @@ class StreamingRandomEffectTrainer:
         # projection; here the projection is the identity)
         self._constrained = bool(config.box_constraints)
         constrained_mode = "shared" if self._constrained else False
-        self._n_dev = 1 if mesh is None else int(mesh.devices.size)
-        key_cfg = dataclasses.replace(config, regularization_weight=0.0)
         if mesh is None:
-            self._solver = _re_solver(
-                key_cfg, loss_name, constrained_mode, compute_variances
-            )
+            self._sharding = None
+            self._axis = axis
+            self._n_dev = 1
         else:
-            self._solver = _re_solver_sharded(
-                key_cfg,
-                loss_name,
-                mesh,
-                axis,
-                constrained_mode,
-                compute_variances,
-            )
-        self._sharding = (
-            None if mesh is None else NamedSharding(mesh, P(axis))
+            self._sharding = psharding.entity_sharding(mesh, axis)
+            self._axis = self._sharding.spec[0]
+            self._n_dev = psharding.axis_size(mesh, self._axis)
+        key_cfg = dataclasses.replace(config, regularization_weight=0.0)
+        self._solver = _re_solver(
+            key_cfg, loss_name, constrained_mode, compute_variances
         )
         self._obj = make_objective(
             loss_name,
@@ -291,7 +295,15 @@ class StreamingRandomEffectTrainer:
 
     def _prepare(self, source) -> DenseBatch:
         if callable(source):
-            return source()
+            generated = source()
+            if self._sharding is None:
+                return generated
+            # an on-device generator may have produced the chunk on the
+            # default device; commit it to the entity sharding so the
+            # solver program sees the mesh layout
+            return jax.tree.map(
+                lambda x: jax.device_put(x, self._sharding), generated
+            )
         if isinstance(source, LocalChunk):
             if self._sharding is None:
                 return jax.tree.map(jax.device_put, source.batch)
@@ -362,9 +374,12 @@ class StreamingRandomEffectTrainer:
         from photon_ml_tpu.optim.common import BoxConstraints
 
         lower, upper = self.config.dense_box_bounds(dim)
-        return BoxConstraints(
+        cons = BoxConstraints(
             lower=jnp.asarray(lower), upper=jnp.asarray(upper)
         )
+        if self.mesh is not None:
+            cons = psharding.place_replicated(cons, self.mesh)
+        return cons
 
     def _solve(
         self,
@@ -381,6 +396,17 @@ class StreamingRandomEffectTrainer:
                 f"{self._n_dev}-device mesh (pad the chunk)"
             )
         w0 = table.read_chunk(start, size)
+        if self._sharding is not None:
+            # chunk reads slice the sharded table; commit the slice (and
+            # the warm-start layout the solver sees) to the entity axis
+            w0 = jax.device_put(w0, self._sharding)
+            # static comms estimate: per-entity solves are independent —
+            # the masked while-loop's one-scalar convergence test is the
+            # only collective, once per iteration
+            record_collective(
+                "streaming_chunk_solve", "psum", self._n_dev, 4,
+                count=max(int(self.config.max_iterations), 1),
+            )
         cons = self._chunk_constraints(table.dim)
         rolled_back = False
         with telemetry.span("streaming_chunk", start=start, size=int(size)):

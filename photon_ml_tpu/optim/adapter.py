@@ -33,22 +33,41 @@ class _LSCarry(NamedTuple):
 
 
 def glm_adapter(
-    obj: GLMObjective, batch: SparseBatch, axis_name: str | None = None
+    obj: GLMObjective,
+    batch: SparseBatch,
+    axis_name: str | None = None,
+    row_sharding=None,
 ) -> Objective:
     """Build the optimizer-facing adapter for a GLM objective over a batch.
 
     The returned closures capture ``obj`` and ``batch``; under jit they are
     traced with whatever sharding the batch carries, so the same adapter
     serves single-device, vmapped (per-entity) and mesh-sharded training.
-    With ``axis_name`` set (inside a shard_map over that mesh axis, batch =
-    the local row shard), all data sums are psum'd — including the line
-    search's per-trial phi/dphi, which costs one scalar-pair all-reduce over
-    ICI per trial instead of the reference's full treeAggregate round.
+
+    Two mesh modes:
+      - GSPMD (the product path, parallel.distributed.gspmd_solve):
+        ``row_sharding`` pins the margin-space arrays (z, the directional
+        margins u) to the batch rows' ``NamedSharding(mesh, P("batch"))``
+        so the compiler keeps every per-row intermediate distributed and
+        inserts psums only at the data sums — the treeAggregate ->
+        psum-over-ICI mapping of PAPER.md with zero hand-rolled SPMD.
+      - explicit SPMD (legacy shard_map callers): ``axis_name`` set means
+        the batch is the LOCAL row shard and all data sums are psum'd —
+        including the line search's per-trial phi/dphi, one scalar-pair
+        all-reduce over ICI per trial.
     """
     loss = obj.loss
 
     def psum(x):
         return x if axis_name is None else jax.lax.psum(x, axis_name)
+
+    def rows(x):
+        # margin-space arrays carry the batch-axis sharding; a missing
+        # constraint lets GSPMD replicate [n]-sized intermediates, which
+        # is exactly the silent-replication bug class this removes
+        if row_sharding is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, row_sharding)
 
     def value_and_grad(w):
         return obj.value_and_grad(w, batch, axis_name)
@@ -62,6 +81,7 @@ def glm_adapter(
         p_eff, p_shift = obj._effective(p)
         w_eff, w_shift = obj._effective(w)
         z, u = batch.margins_pair(w_eff, w_shift, p_eff, p_shift)
+        z, u = rows(z), rows(u)
         return _LSCarry(
             z=z,
             u=u,
@@ -96,7 +116,7 @@ def glm_adapter(
     # iteration does one gather (u = X'@p) + one scatter (gradient) instead
     # of two fused gather+scatter sweeps
     def margins(w):
-        return obj.margins(w, batch)
+        return rows(obj.margins(w, batch))
 
     def ls_prepare_z(z, w, p):
         u = dir_margins(p)
@@ -118,7 +138,7 @@ def glm_adapter(
 
     def dir_margins(p):
         p_eff, p_shift = obj._effective(p)
-        return batch.dot_rows(p_eff) + p_shift
+        return rows(batch.dot_rows(p_eff) + p_shift)
 
     hessian = None
     if loss.has_hessian and hasattr(batch, "dense_rows"):

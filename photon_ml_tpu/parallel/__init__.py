@@ -1,6 +1,7 @@
 from photon_ml_tpu.parallel.distributed import (  # noqa: F401
     distributed_solve,
     distributed_value_and_grad,
+    gspmd_solve,
 )
 from photon_ml_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
@@ -8,6 +9,17 @@ from photon_ml_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     put_sharded,
     shard_rows,
+)
+from photon_ml_tpu.parallel.sharding import (  # noqa: F401
+    BATCH_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    data_axis,
+    entity_sharding,
+    model_axis,
+    place_batch,
+    place_entities,
+    replicated,
 )
 from photon_ml_tpu.parallel.multihost import (  # noqa: F401
     DistributedConfig,

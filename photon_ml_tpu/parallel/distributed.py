@@ -1,18 +1,31 @@
-"""Data-parallel GLM solving over a device mesh.
+"""Data-parallel GLM solving over a device mesh — GSPMD, not shard_map.
 
-The entire optimizer while-loop runs INSIDE a ``shard_map`` over the data
-axis: coefficients and optimizer state are computed redundantly on every
-device (replicated), the batch rows are device-local shards, and every data
-sum in the objective/line-search psums over ICI. One jit program per solve —
-the reference's per-iteration driver<->executor broadcast/treeAggregate round
-trips (SURVEY.md §3.4) are gone entirely.
+The entire optimizer while-loop runs inside ONE ``jax.jit``: batch rows are
+committed with ``NamedSharding(mesh, P("batch"))`` (parallel.sharding),
+coefficients/optimizer state are replicated, and the XLA compiler (GSPMD)
+inserts the psums at every data sum in the objective/line-search — the
+Spark ``treeAggregate`` -> psum-over-ICI mapping of PAPER.md with no
+hand-rolled SPMD plumbing. One jit program per solve; the reference's
+per-iteration driver<->executor broadcast/treeAggregate round trips
+(SURVEY.md §3.4) are gone entirely.
+
+Two entry points share one compiled-solver core:
+
+- :func:`gspmd_solve` — the product path: a FLAT design (SparseBatch or
+  TiledBatch) placed by ``parallel.sharding.place_batch``; rows/tiles carry
+  the batch-axis sharding directly, no host restacking.
+- :func:`distributed_solve` — the stacked-layout compat surface (leaves
+  carry a leading [num_shards, ...] axis with LOCAL row indices, see
+  parallel.mesh.shard_rows): the stack is flattened back to the global
+  design INSIDE the jit (a sharded reshape, no data movement) and solved by
+  the same GSPMD program. Multi-host callers keep feeding process-local
+  stacked shards via ``make_array_from_process_local_data``.
 
 The compiled solver is cached per (config, mesh, axis, arg-structure) so a
-lambda sweep re-invoking ``distributed_solve`` with new regularization
-weights (traced leaves of the objective) hits the jit cache instead of
-recompiling — the on-device analog of the reference's mutable
-``updateRegularizationWeight`` warm-start loop
-(DistributedOptimizationProblem.scala:60-71).
+lambda sweep re-invoking a solve with new regularization weights (traced
+leaves of the objective) hits the jit cache instead of recompiling — the
+on-device analog of the reference's mutable ``updateRegularizationWeight``
+warm-start loop (DistributedOptimizationProblem.scala:60-71).
 
 Reference analog: DistributedGLMLossFunction + DistributedOptimizationProblem
 (photon-api function/glm/DistributedGLMLossFunction.scala:49-169,
@@ -26,7 +39,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.telemetry.xla import instrumented_jit, record_collective
 from photon_ml_tpu.ops.objective import GLMObjective
@@ -34,24 +47,49 @@ from photon_ml_tpu.ops.sparse import SparseBatch
 from photon_ml_tpu.optim.adapter import glm_adapter
 from photon_ml_tpu.optim.common import BoxConstraints, SolveResult
 from photon_ml_tpu.optim.factory import OptimizerConfig, build_objective, dispatch_solve
-from photon_ml_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
+from photon_ml_tpu.parallel.mesh import DATA_AXIS
 
 Array = jax.Array
 
 
+def _unstack_batch(stacked: SparseBatch) -> SparseBatch:
+    """Flatten a shard-stacked COO batch ([S, ...] leaves, LOCAL row
+    indices — the parallel.mesh.shard_rows layout) back to the flat global
+    design INSIDE jit. The leading stacked axis is sharded, so the merge
+    is a sharded reshape — GSPMD keeps the blocks where they live; only
+    the row indices gain their block offset. (Tiled designs never stack:
+    the flat-GSPMD path places them directly, parallel.sharding.)"""
+    num_shards, rows_per = stacked.labels.shape
+    block = (
+        jnp.arange(num_shards, dtype=stacked.rows.dtype) * rows_per
+    )[:, None]
+    return SparseBatch(
+        values=stacked.values.reshape(-1),
+        rows=(stacked.rows + block).reshape(-1),
+        cols=stacked.cols.reshape(-1),
+        labels=stacked.labels.reshape(-1),
+        offsets=stacked.offsets.reshape(-1),
+        weights=stacked.weights.reshape(-1),
+        num_features=stacked.num_features,
+    )
+
+
 @lru_cache(maxsize=64)
-def _build_solver(config: OptimizerConfig, mesh: Mesh, axis: str):
-    """Compile-once solver factory. All dynamic values (objective leaves —
-    including the l2 weight —, l1 weight, batch shards, w0, constraints,
-    warm-start anchors) are traced arguments; the cache key carries only
-    program-structure statics. The config in the key has its
+def _build_solver(
+    config: OptimizerConfig, mesh: Mesh, axis: str, stacked: bool
+):
+    """Compile-once GSPMD solver factory. All dynamic values (objective
+    leaves — including the l2 weight —, l1 weight, the batch, w0,
+    constraints, warm-start anchors) are traced arguments; the cache key
+    carries only program-structure statics. The config in the key has its
     regularization_weight canonicalized to 0.0 by the caller so lambda
     sweeps share one entry."""
+    row_sharding = NamedSharding(mesh, P(axis))
 
-    def local_solve(obj, batch_shard, w0, l1, constraints, init_value, init_grad_norm):
-        # shard_map delivers leaves with a leading [1, ...] block — squeeze.
-        batch_local = jax.tree.map(lambda x: x[0], batch_shard)
-        adapter = glm_adapter(obj, batch_local, axis_name=axis)
+    def run(obj, batch, w0, l1, constraints, init_value, init_grad_norm):
+        if stacked:
+            batch = _unstack_batch(batch)
+        adapter = glm_adapter(obj, batch, row_sharding=row_sharding)
         return dispatch_solve(
             adapter,
             w0,
@@ -62,26 +100,95 @@ def _build_solver(config: OptimizerConfig, mesh: Mesh, axis: str):
             init_grad_norm=init_grad_norm,
         )
 
-    def wrapped(obj, stacked_batch, w0, l1, constraints, init_value, init_grad_norm):
-        batch_specs = jax.tree.map(lambda _: P(axis), stacked_batch)
-        rep_tree = lambda t: jax.tree.map(lambda _: P(), t)
-        return shard_map_compat(
-            local_solve,
-            mesh=mesh,
-            in_specs=(
-                rep_tree(obj),
-                batch_specs,
-                P(),
-                P(),
-                rep_tree(constraints),
-                rep_tree(init_value),
-                rep_tree(init_grad_norm),
-            ),
-            out_specs=P(),
-            check=False,  # psum'd outputs are replicated by construction
-        )(obj, stacked_batch, w0, l1, constraints, init_value, init_grad_norm)
+    # coefficients and solve telemetry are replicated by construction
+    # (every data sum all-reduces); pin that so callers always receive
+    # fully-replicated results regardless of GSPMD's propagation choices
+    return instrumented_jit(
+        run,
+        name="distributed_solve" if stacked else "gspmd_solve",
+        multi_shape=True,  # one solver serves every dataset shape
+        out_shardings=NamedSharding(mesh, P()),
+    )
 
-    return instrumented_jit(wrapped, name="distributed_solve")
+
+def _solve_common(
+    loss_name: str,
+    batch,
+    config: OptimizerConfig,
+    w0: Array,
+    mesh: Mesh,
+    axis: str,
+    stacked: bool,
+    constraints,
+    factors,
+    shifts,
+    init_value,
+    init_grad_norm,
+    extra_l2: float,
+    label: str,
+) -> SolveResult:
+    import dataclasses as _dc
+
+    from photon_ml_tpu.optim.guard import damped_objective
+
+    config.validate(loss_name)
+    obj = damped_objective(
+        build_objective(loss_name, config, factors=factors, shifts=shifts),
+        extra_l2,
+    )
+    l1 = jnp.float32(config.regularization.l1_weight(config.regularization_weight))
+    key_config = _dc.replace(config, regularization_weight=0.0)
+    solver = _build_solver(key_config, mesh, axis, stacked)
+    # static comms estimate (telemetry.xla): each data pass all-reduces one
+    # [d] gradient + a scalar objective value over the ring (GSPMD lowers
+    # them to the same ring psum shard_map spelled by hand); max_iterations
+    # bounds the pass count (line-search extra evals are not counted —
+    # README "comms methodology" documents the limits)
+    record_collective(
+        label,
+        "psum",
+        int(mesh.shape[axis]),
+        int(w0.nbytes) + 4,
+        count=max(int(config.max_iterations), 1),
+    )
+    return solver(
+        obj, batch, w0, l1, constraints, init_value, init_grad_norm
+    )
+
+
+def gspmd_solve(
+    loss_name: str,
+    batch,
+    config: OptimizerConfig,
+    w0: Array,
+    mesh: Mesh,
+    axis: Optional[str] = None,
+    constraints: Optional[BoxConstraints] = None,
+    factors: Optional[Array] = None,
+    shifts: Optional[Array] = None,
+    init_value: Optional[Array] = None,
+    init_grad_norm: Optional[Array] = None,
+    extra_l2: float = 0.0,
+) -> SolveResult:
+    """Solve a GLM whose FLAT design is row-sharded over ``axis``.
+
+    ``batch`` is a SparseBatch/TiledBatch placed by
+    ``parallel.sharding.place_batch(batch, mesh, axis)`` (leaves committed
+    with ``NamedSharding(mesh, P(axis))``). ``extra_l2`` adds damping on
+    top of the configured regularization (the guarded-solve retry path,
+    optim.guard) — a traced objective leaf, so damped retries hit the same
+    compiled program.
+    """
+    from photon_ml_tpu.parallel.sharding import batch_sharding
+
+    # batch_sharding resolves the axis and raises the clear "no batch/data
+    # axis" ValueError (instead of a KeyError deep in the comms estimate)
+    axis = axis or batch_sharding(mesh).spec[0]
+    return _solve_common(
+        loss_name, batch, config, w0, mesh, axis, False, constraints,
+        factors, shifts, init_value, init_grad_norm, extra_l2,
+        label="gspmd_solve",
+    )
 
 
 def distributed_solve(
@@ -98,62 +205,36 @@ def distributed_solve(
     init_grad_norm: Optional[Array] = None,
     extra_l2: float = 0.0,
 ) -> SolveResult:
-    """Solve a GLM with examples sharded over ``axis`` of ``mesh``.
+    """Solve a GLM fed in the stacked shard layout (compat surface).
 
     ``stacked_batch`` leaves carry a leading [num_shards, ...] axis with
-    LOCAL row indices per shard (see parallel.mesh.shard_rows).
-    ``extra_l2`` adds damping on top of the configured regularization (the
-    guarded-solve retry path, optim.guard) — a traced objective leaf, so
-    damped retries hit the same compiled program.
+    LOCAL row indices per shard (see parallel.mesh.shard_rows) — the
+    layout multi-host workers assemble from process-local rows. The solve
+    itself is the same GSPMD program as :func:`gspmd_solve`; the stack is
+    flattened inside the jit.
     """
-    import dataclasses as _dc
-
-    from photon_ml_tpu.optim.guard import damped_objective
-
-    config.validate(loss_name)
-    obj = damped_objective(
-        build_objective(loss_name, config, factors=factors, shifts=shifts),
-        extra_l2,
-    )
-    l1 = jnp.float32(config.regularization.l1_weight(config.regularization_weight))
-    key_config = _dc.replace(config, regularization_weight=0.0)
-    solver = _build_solver(key_config, mesh, axis)
-    # static comms estimate (telemetry.xla): each data pass psums one [d]
-    # gradient + a scalar objective value over the ring; max_iterations
-    # bounds the pass count (line-search extra evals are not counted —
-    # README "comms methodology" documents the limits)
-    record_collective(
-        "distributed_solve",
-        "psum",
-        int(mesh.shape[axis]),
-        int(w0.nbytes) + 4,
-        count=max(int(config.max_iterations), 1),
-    )
-    return solver(
-        obj, stacked_batch, w0, l1, constraints, init_value, init_grad_norm
+    return _solve_common(
+        loss_name, stacked_batch, config, w0, mesh, axis, True, constraints,
+        factors, shifts, init_value, init_grad_norm, extra_l2,
+        label="distributed_solve",
     )
 
 
 @lru_cache(maxsize=64)
 def _build_sharded_eval(mesh: Mesh, axis: str, method_name: str):
     """Sharded evaluation of one GLMObjective method (value_and_grad /
-    hessian_diagonal / ...): per-shard partial sums psum'd over ``axis``."""
-
-    def f(obj_in, w_in, b):
-        b = jax.tree.map(lambda x: x[0], b)
-        return getattr(obj_in, method_name)(w_in, b, axis_name=axis)
+    hessian_diagonal / ...) over the stacked layout: the stack flattens
+    inside jit and GSPMD all-reduces the data sums."""
 
     def wrapped(obj, w, stacked_batch):
-        batch_specs = jax.tree.map(lambda _: P(axis), stacked_batch)
-        return shard_map_compat(
-            f,
-            mesh=mesh,
-            in_specs=(jax.tree.map(lambda _: P(), obj), P(), batch_specs),
-            out_specs=P(),
-            check=False,
-        )(obj, w, stacked_batch)
+        return getattr(obj, method_name)(w, _unstack_batch(stacked_batch))
 
-    return instrumented_jit(wrapped, name=f"distributed_{method_name}")
+    return instrumented_jit(
+        wrapped,
+        name=f"distributed_{method_name}",
+        multi_shape=True,
+        out_shardings=NamedSharding(mesh, P()),
+    )
 
 
 def distributed_value_and_grad(

@@ -1,10 +1,19 @@
-"""Device-mesh construction and batch sharding helpers.
+"""Device-mesh construction and the stacked-shard batch layout.
 
-The framework's mesh vocabulary (SURVEY.md §2.f):
-  - axis ``data``:   examples sharded for fixed-effect (DP) training
-  - axis ``entity``: per-entity problem batches sharded for random-effect
-                     ("expert-parallel"-like) training
-Both can coexist in a 2-D mesh on larger slices; collectives ride ICI.
+The MODERN mesh vocabulary is the named (``batch``, ``model``) GSPMD pair
+in ``parallel.sharding`` (flat designs committed with NamedSharding, jit
+inserts the collectives). This module keeps:
+
+  - :func:`make_mesh` — mesh construction for any axis names;
+  - the legacy 1-D axis names (``data`` for fixed-effect rows, ``entity``
+    for per-entity batches, SURVEY.md §2.f), which the sharding helpers
+    still resolve;
+  - :func:`shard_rows` / :func:`put_sharded` — the stacked shard layout
+    ([num_shards, ...] leaves with LOCAL row indices) that multi-host
+    workers assemble from process-local rows and feed to
+    ``distributed_solve`` (flattened back inside the jit);
+  - :func:`shard_map_compat` — the cross-version ``shard_map`` shim, for
+    callers that genuinely need explicit SPMD.
 """
 
 from __future__ import annotations
@@ -157,39 +166,3 @@ def put_sharded(stacked, mesh: Mesh, axis: str = DATA_AXIS):
     axis on every leaf) so shard i's block lives on device i."""
     sharding = NamedSharding(mesh, P(axis))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
-
-
-def shard_tiles(tiled, num_shards: int):
-    """Host-side: split a TiledBatch into ``num_shards`` contiguous tile
-    groups stacked on a new leading axis (the tiled analog of shard_rows —
-    tiles are independent, so any contiguous grouping is a valid row shard).
-
-    Tile count is padded to a multiple of ``num_shards`` with empty tiles
-    (vals 0, hi = num_blocks sentinel so gathers contribute nothing,
-    weights 0).
-    """
-    import jax.numpy as jnp
-
-    from photon_ml_tpu.ops.tiled import TiledBatch
-
-    T = tiled.num_tiles
-    Tp = _round_up(T, num_shards)
-    per = Tp // num_shards
-
-    def stack(x, fill):
-        a = np.asarray(x)
-        if Tp != T:
-            pad = np.full((Tp - T,) + a.shape[1:], fill, a.dtype)
-            a = np.concatenate([a, pad], axis=0)
-        return jnp.asarray(a.reshape((num_shards, per) + a.shape[1:]))
-
-    return TiledBatch(
-        vals=stack(tiled.vals, 0.0),
-        hi=stack(tiled.hi, tiled.num_blocks),
-        lo=stack(tiled.lo, 0),
-        rlo=stack(tiled.rlo, 0),
-        labels3=stack(tiled.labels3, 0.0),
-        offsets3=stack(tiled.offsets3, 0.0),
-        weights3=stack(tiled.weights3, 0.0),
-        num_features=tiled.num_features,
-    )

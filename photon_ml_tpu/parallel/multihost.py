@@ -123,6 +123,15 @@ def initialize(config: Optional[DistributedConfig] = None) -> None:
     cfg = config if config is not None else DistributedConfig.from_env()
     cfg.validate()
     if cfg.is_explicit:
+        # explicit fleets are CPU/GPU hosts; the CPU backend only executes
+        # cross-process programs (GSPMD collectives) through gloo, and the
+        # default is "none" — without this every multi-process CPU
+        # computation dies with "Multiprocess computations aren't
+        # implemented on the CPU backend"
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — unknown option on other jax versions
+            pass
         jax.distributed.initialize(
             coordinator_address=cfg.coordinator_address,
             num_processes=cfg.num_processes,

@@ -1,0 +1,189 @@
+"""Reusable GSPMD sharding primitives: named axes, placement helpers, and
+entity sharding for coefficient tables.
+
+The framework's modern mesh vocabulary (ROADMAP item 1; SNIPPETS [3] shows
+the pattern):
+
+  - axis ``batch``: examples sharded for data-parallel fixed-effect
+    training — the tiled design and margins carry
+    ``NamedSharding(mesh, P("batch", ...))`` and ``jax.jit`` inserts the
+    psums (GSPMD), replacing per-solve ``shard_map`` plumbing;
+  - axis ``model``: per-entity state (random-effect coefficient tables,
+    streamed entity chunks) sharded so table capacity scales with devices.
+
+The legacy 1-D axis names ``data``/``entity`` (parallel.mesh) resolve to
+the same roles, so older meshes keep working. This module is a LIBRARY
+surface: online serving (ROADMAP item 4) reuses :func:`entity_sharding`
+for mesh-spanning model state, so keep it free of training-only concerns.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
+
+#: Axis names recognized as the example/row (data-parallel) axis, most
+#: preferred first. "data" is the legacy 1-D spelling.
+_DATA_AXES = (BATCH_AXIS, "data")
+#: Axis names recognized as the per-entity (model-parallel) axis.
+_MODEL_AXES = (MODEL_AXIS, "entity")
+
+
+def data_axis(mesh: Mesh) -> Optional[str]:
+    """The mesh's example-sharding axis name (``batch``/legacy ``data``),
+    or None when the mesh has no such axis (an entity-only mesh)."""
+    for name in _DATA_AXES:
+        if name in mesh.axis_names:
+            return name
+    return None
+
+
+def model_axis(mesh: Mesh) -> Optional[str]:
+    """The mesh's entity-sharding axis name (``model``/legacy ``entity``),
+    or None when the mesh has no such axis (a batch-only mesh)."""
+    for name in _MODEL_AXES:
+        if name in mesh.axis_names:
+            return name
+    return None
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return int(mesh.shape[axis])
+
+
+def batch_sharding(mesh: Mesh, axis: Optional[str] = None) -> NamedSharding:
+    """Sharding for per-row arrays ([n] labels/offsets/weights, [T, ...]
+    tile grids, [nnz] COO slots): leading dim split over the batch axis,
+    everything else replicated. ``P(axis)`` is a prefix spec, so one
+    sharding serves every rank."""
+    axis = axis or data_axis(mesh)
+    if axis is None:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} has no batch/data axis to shard rows "
+            "over"
+        )
+    return NamedSharding(mesh, P(axis))
+
+
+def entity_sharding(mesh: Mesh, axis: Optional[str] = None) -> NamedSharding:
+    """Sharding for per-entity state ([E, K] coefficient tables, [E, ...]
+    chunk batches): the leading entity dim split over the model axis.
+
+    This is the ONE definition of how entity state spans the mesh —
+    the streaming coefficient table, the RE bucket solves, and (ROADMAP
+    item 4) sharded serving all place through it, so their shards line up
+    with no resharding between training and serving."""
+    axis = axis or model_axis(mesh)
+    if axis is None:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} has no model/entity axis to shard "
+            "entities over"
+        )
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated placement (broadcast analog) on ``mesh``."""
+    return NamedSharding(mesh, P())
+
+
+def pad_count(n: int, shards: int) -> int:
+    """Smallest multiple of ``shards`` that is >= ``n``."""
+    return -(-int(n) // int(shards)) * int(shards)
+
+
+def place_entities(tree, mesh: Mesh, axis: Optional[str] = None):
+    """Place every leaf of an entity-leading pytree ([E, ...] per leaf)
+    with :func:`entity_sharding`. E must be a multiple of the axis size
+    (see :func:`pad_count` / game.coordinates._pad_entities)."""
+    sharding = entity_sharding(mesh, axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def place_replicated(tree, mesh: Mesh):
+    """Replicate every leaf of a pytree across the whole mesh."""
+    sharding = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+# ---------------------------------------------------------------------------
+# batch placement: flat (non-stacked) designs onto the batch axis
+# ---------------------------------------------------------------------------
+
+
+def pad_batch_rows(batch, shards: int):
+    """Host-side: pad a batch's row structure so every leading dim divides
+    over ``shards`` — the flat-GSPMD analog of parallel.mesh.shard_rows
+    (which additionally re-stacks; GSPMD needs no stacking).
+
+    SparseBatch: pads rows (weight 0 -> inert) and nnz slots (value 0,
+    row = last row -> inert). TiledBatch: pads whole tiles (weights 0,
+    ``hi`` = num_blocks sentinel so gathers contribute nothing).
+    """
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.sparse import SparseBatch
+    from photon_ml_tpu.ops.tiled import TiledBatch
+
+    if isinstance(batch, TiledBatch):
+        T = batch.num_tiles
+        Tp = pad_count(T, shards)
+        if Tp == T:
+            return batch
+
+        def pad_tiles(x, fill):
+            a = np.asarray(x)
+            pad = np.full((Tp - T,) + a.shape[1:], fill, a.dtype)
+            return jnp.asarray(np.concatenate([a, pad], axis=0))
+
+        return TiledBatch(
+            vals=pad_tiles(batch.vals, 0.0),
+            hi=pad_tiles(batch.hi, batch.num_blocks),
+            lo=pad_tiles(batch.lo, 0),
+            rlo=pad_tiles(batch.rlo, 0),
+            labels3=pad_tiles(batch.labels3, 0.0),
+            offsets3=pad_tiles(batch.offsets3, 0.0),
+            weights3=pad_tiles(batch.weights3, 0.0),
+            num_features=batch.num_features,
+        )
+    if isinstance(batch, SparseBatch):
+        n, nnz = batch.num_rows, batch.nnz
+        n_p, nnz_p = pad_count(n, shards), pad_count(nnz, shards)
+        if n_p == n and nnz_p == nnz:
+            return batch
+
+        def pad_to(x, total, fill):
+            a = np.asarray(x)
+            out = np.full((total,) + a.shape[1:], fill, a.dtype)
+            out[: a.shape[0]] = a
+            return jnp.asarray(out)
+
+        return SparseBatch(
+            values=pad_to(batch.values, nnz_p, 0.0),
+            rows=pad_to(batch.rows, nnz_p, n_p - 1),
+            cols=pad_to(batch.cols, nnz_p, 0),
+            labels=pad_to(batch.labels, n_p, 0.0),
+            offsets=pad_to(batch.offsets, n_p, 0.0),
+            weights=pad_to(batch.weights, n_p, 0.0),
+            num_features=batch.num_features,
+        )
+    raise TypeError(f"cannot pad batch type {type(batch).__name__}")
+
+
+def place_batch(batch, mesh: Mesh, axis: Optional[str] = None):
+    """Pad (:func:`pad_batch_rows`) and upload a flat design so its rows
+    live sharded over the batch axis: every leaf gets
+    ``NamedSharding(mesh, P(axis))`` on its leading dim. The returned
+    batch feeds :func:`photon_ml_tpu.parallel.distributed.gspmd_solve`
+    directly — the whole optimizer while-loop then runs under one jit with
+    GSPMD-inserted psums."""
+    axis = axis or data_axis(mesh)
+    sharding = batch_sharding(mesh, axis)
+    padded = pad_batch_rows(batch, axis_size(mesh, axis))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), padded)

@@ -33,6 +33,7 @@ __all__ = [
     "hbm_stats",
     "set_stats_provider",
     "record_device_memory",
+    "device_spread_bytes",
     "record_phase_memory",
     "estimate_table_bytes",
     "estimate_batch_bytes",
@@ -110,6 +111,33 @@ def record_device_memory(devices: Optional[Sequence] = None) -> dict[str, int]:
             )
         out[str(did)] = in_use
     return out
+
+
+def device_spread_bytes() -> Optional[int]:
+    """Per-device HBM in-use spread (max - min bytes across all devices
+    that expose stats), or None with fewer than two reporting devices.
+
+    ``make_mesh`` publishes the per-device ``memory.device.<id>.*`` gauges
+    at mesh build; this refreshes them from the live probe, falls back to
+    the already-published gauges (statless probes, offline tests), and
+    reduces to the ONE number that makes shard imbalance visible (a
+    balanced entity sharding keeps it near zero). Also published as the
+    ``memory.device_spread_bytes`` gauge so run reports loaded from a
+    metrics JSONL can render it."""
+    per_device = record_device_memory()
+    if len(per_device) < 2:
+        prefix, suffix = "memory.device.", ".bytes_in_use"
+        per_device = {
+            name[len(prefix):-len(suffix)]: value
+            for name, value in metrics.snapshot()["gauges"].items()
+            if name.startswith(prefix) and name.endswith(suffix)
+            and value is not None
+        }
+    if len(per_device) < 2:
+        return None
+    spread = max(per_device.values()) - min(per_device.values())
+    metrics.gauge("memory.device_spread_bytes").set(spread)
+    return int(spread)
 
 
 def record_phase_memory(phase: str, device=None) -> Optional[int]:

@@ -171,6 +171,12 @@ class Heartbeat:
             line["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
             if "bytes_limit" in stats:
                 line["hbm_bytes_limit"] = int(stats["bytes_limit"])
+        spread = memory.device_spread_bytes()
+        if spread is not None:
+            # shard imbalance signal: max-min HBM in use across the mesh
+            # devices (a balanced entity sharding keeps this near zero;
+            # a lopsided one concentrates table bytes on few devices)
+            line["hbm_device_spread_bytes"] = spread
         last_save = metrics.gauge("checkpoint.last_save_ts").value
         if last_save is not None:
             line["checkpoint_age_s"] = round(

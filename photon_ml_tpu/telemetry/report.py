@@ -827,7 +827,16 @@ class RunReport:
         headroom = self.snapshot.get("counters", {}).get(
             "memory.headroom_warnings"
         )
-        if not phase_peaks and not headroom and "memory.bytes_in_use" not in g:
+        has_device_gauges = any(
+            name.startswith("memory.device.") and name.endswith(".bytes_in_use")
+            for name in g
+        )
+        if (
+            not phase_peaks
+            and not headroom
+            and not has_device_gauges
+            and "memory.bytes_in_use" not in g
+        ):
             return []
         out = ["## HBM / memory", ""]
         if "memory.bytes_in_use" in g:
@@ -838,6 +847,29 @@ class RunReport:
                     if g.get("memory.bytes_limit") is not None
                     else ""
                 )
+            )
+        per_device = {
+            name[len("memory.device."):-len(".bytes_in_use")]: value
+            for name, value in g.items()
+            if name.startswith("memory.device.")
+            and name.endswith(".bytes_in_use")
+            and value is not None
+        }
+        if len(per_device) >= 2:
+            # shard-imbalance signal: a balanced entity sharding keeps the
+            # per-device spread near zero; a lopsided one concentrates
+            # table bytes on few devices (heartbeats carry the same number
+            # live as hbm_device_spread_bytes)
+            lo, hi = min(per_device.values()), max(per_device.values())
+            out.append(
+                f"- per-device in use across {len(per_device)} devices: "
+                f"min {_fmt_bytes(lo)}, max {_fmt_bytes(hi)}, spread "
+                f"{_fmt_bytes(hi - lo)}"
+            )
+        elif g.get("memory.device_spread_bytes") is not None:
+            out.append(
+                "- per-device in-use spread (max-min): "
+                f"{_fmt_bytes(g['memory.device_spread_bytes'])}"
             )
         if headroom:
             out.append(

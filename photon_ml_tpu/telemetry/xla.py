@@ -225,13 +225,39 @@ _DTYPE_SHORT = {
 }
 
 
+_named_sharding_cls: Optional[type] = None
+
+
+def _mesh_sharding(leaf: Any):
+    """The leaf's NamedSharding when it is committed to a multi-device
+    mesh, else None. Mesh placement is part of the compiled program (GSPMD
+    partitions differently per sharding), so it must be part of both the
+    dispatch key and the recompile-attribution signature; single-device
+    and host leaves stay sharding-free so existing signatures are
+    unchanged. jax is resolved lazily — a leaf carrying ``.sharding``
+    proves it is already imported."""
+    global _named_sharding_cls
+    sh = getattr(leaf, "sharding", None)
+    if sh is None:
+        return None
+    if _named_sharding_cls is None:
+        from jax.sharding import NamedSharding
+
+        _named_sharding_cls = NamedSharding
+    if isinstance(sh, _named_sharding_cls) and sh.mesh.devices.size > 1:
+        return sh
+    return None
+
+
 def _leaf_sig(leaf: Any) -> str:
     shape = getattr(leaf, "shape", None)
     dtype = getattr(leaf, "dtype", None)
     if shape is not None and dtype is not None:
         dt = _DTYPE_SHORT.get(str(dtype), str(dtype))
         weak = "*" if getattr(getattr(leaf, "aval", None), "weak_type", False) else ""
-        return f"{dt}{weak}[{','.join(str(int(d)) for d in shape)}]"
+        sh = _mesh_sharding(leaf)
+        mesh_sig = "" if sh is None else f"@{sh.spec}"
+        return f"{dt}{weak}[{','.join(str(int(d)) for d in shape)}]{mesh_sig}"
     if isinstance(leaf, bool):
         return "pybool"
     if isinstance(leaf, int):
@@ -264,7 +290,7 @@ def _leaf_key(leaf: Any):
     dtype = getattr(leaf, "dtype", None)
     if shape is not None and dtype is not None:
         weak = getattr(getattr(leaf, "aval", None), "weak_type", False)
-        return (dtype, tuple(shape), weak)
+        return (dtype, tuple(shape), weak, _mesh_sharding(leaf))
     if isinstance(leaf, (bool, int, float, complex)):
         return type(leaf)
     return ("repr", repr(leaf))

@@ -54,6 +54,71 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multichip: needs an 8-device mesh; when this process has fewer "
+        "devices the test transparently re-runs itself in a subprocess "
+        "under XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+        "JAX_PLATFORMS=cpu (the multichip fixture)",
+    )
+
+
+@pytest.fixture
+def multichip(request):
+    """Tier-1-runnable multichip CI: guarantee the test sees >= 8 devices.
+
+    In the normal suite this conftest already forced an 8-device virtual
+    CPU platform, so the fixture is a pass-through. When the suite runs in
+    an environment that latched a different platform (a 1-chip TPU host,
+    a site customization importing jax early), the test re-execs ITSELF
+    via pytest in a subprocess with the forced flags — so sharded-vs-
+    single-device parity always runs somewhere, never silently skips.
+    """
+    if jax.device_count() >= 8:
+        return jax.devices()[:8]
+    if os.environ.get("PHOTON_MULTICHIP_SUBPROCESS") == "1":
+        pytest.fail(
+            "forced 8-device CPU provisioning failed: subprocess still "
+            f"sees {jax.device_count()} devices on "
+            f"{jax.devices()[0].platform}"
+        )
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["PHOTON_MULTICHIP_SUBPROCESS"] = "1"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", "-q",
+            "-p", "no:cacheprovider", request.node.nodeid,
+        ],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    if proc.returncode != 0:
+        pytest.fail(
+            "multichip subprocess rerun failed "
+            f"(rc={proc.returncode}):\n{proc.stdout[-2000:]}\n"
+            f"{proc.stderr[-1000:]}"
+        )
+    pytest.skip(
+        "passed in a forced 8-device CPU subprocess (this process has "
+        f"only {jax.device_count()} devices)"
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)

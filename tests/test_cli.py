@@ -409,3 +409,20 @@ def test_cli_train_config_driven_event_listener(avro_dataset):
     assert "TrainingStartEvent" in log
     assert "OptimizationLogEvent" in log
     assert "TrainingFinishEvent" in log
+
+
+def test_train_parse_mesh_flag():
+    """--mesh 'batch=N,model=M' -> the named GSPMD mesh config dict."""
+    from photon_ml_tpu.cli.train import parse_mesh_flag
+
+    assert parse_mesh_flag("batch=8") == {"batch": 8}
+    assert parse_mesh_flag("batch=4,model=2") == {"batch": 4, "model": 2}
+    assert parse_mesh_flag("model=8") == {"model": 8}
+    assert parse_mesh_flag("auto") is True
+    assert parse_mesh_flag("off") is False
+    with pytest.raises(ValueError, match="axis=N"):
+        parse_mesh_flag("batch")
+    with pytest.raises(ValueError, match="integer size"):
+        parse_mesh_flag("batch=many")
+    with pytest.raises(ValueError, match="no axes"):
+        parse_mesh_flag(" , ")

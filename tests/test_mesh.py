@@ -1,0 +1,184 @@
+"""parallel/mesh.py + parallel/sharding.py unit coverage.
+
+The ``shard_map_compat`` shim unbroke the 7 seed-failing distributed
+tests (PR 5) but its two API branches were never directly tested: newer
+jax exposes top-level ``jax.shard_map`` with ``check_vma`` (and some
+releases spell it ``check_rep``), older jax only ships
+``jax.experimental.shard_map.shard_map`` with ``check_rep``. Both
+branches are pinned here via monkeypatched availability, plus one real
+collective through whichever branch the installed jax provides.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from photon_ml_tpu.parallel import sharding as psharding
+from photon_ml_tpu.parallel.mesh import make_mesh, shard_map_compat
+
+
+@pytest.fixture
+def mesh(multichip):
+    return make_mesh({"data": 8})
+
+
+# ---------------------------------------------------------------------------
+# shard_map_compat: real execution through the installed branch
+# ---------------------------------------------------------------------------
+
+
+def test_compat_executes_a_psum(mesh):
+    x = jnp.arange(8.0)
+
+    def local_sum(block):
+        return jax.lax.psum(jnp.sum(block), "data")
+
+    f = shard_map_compat(local_sum, mesh, in_specs=P("data"), out_specs=P())
+    assert float(jax.jit(f)(x)) == float(np.sum(np.arange(8.0)))
+
+
+# ---------------------------------------------------------------------------
+# shard_map_compat: branch selection via monkeypatched availability
+# ---------------------------------------------------------------------------
+
+
+def _call_through(mesh, check=False):
+    return shard_map_compat(
+        lambda x: x, mesh, in_specs=P("data"), out_specs=P("data"),
+        check=check,
+    )
+
+
+def test_top_level_branch_uses_check_vma(monkeypatch, mesh):
+    seen = {}
+
+    def fake_shard_map(f, mesh, in_specs, out_specs, **kwargs):
+        seen.update(kwargs)
+        return lambda *a: "top-level"
+
+    monkeypatch.setattr(jax, "shard_map", fake_shard_map, raising=False)
+    assert _call_through(mesh, check=True)() == "top-level"
+    assert seen == {"check_vma": True}
+
+
+def test_top_level_branch_falls_back_to_check_rep_spelling(monkeypatch, mesh):
+    calls = []
+
+    def fake_shard_map(f, mesh, in_specs, out_specs, **kwargs):
+        if "check_vma" in kwargs:
+            raise TypeError("got an unexpected keyword argument 'check_vma'")
+        calls.append(kwargs)
+        return lambda *a: "old-keyword"
+
+    monkeypatch.setattr(jax, "shard_map", fake_shard_map, raising=False)
+    assert _call_through(mesh)() == "old-keyword"
+    assert calls == [{"check_rep": False}]
+
+
+def test_experimental_branch_uses_check_rep(monkeypatch, mesh):
+    # no top-level jax.shard_map at all -> the jax.experimental path
+    monkeypatch.delattr(jax, "shard_map", raising=False)
+    import jax.experimental.shard_map as esm
+
+    seen = {}
+
+    def fake_shard_map(f, mesh, in_specs, out_specs, **kwargs):
+        seen.update(kwargs)
+        return lambda *a: "experimental"
+
+    monkeypatch.setattr(esm, "shard_map", fake_shard_map)
+    assert _call_through(mesh, check=True)() == "experimental"
+    assert seen == {"check_rep": True}
+
+
+# ---------------------------------------------------------------------------
+# sharding primitives
+# ---------------------------------------------------------------------------
+
+
+def test_axis_resolution_named_and_legacy(multichip):
+    named = make_mesh({"batch": 4, "model": 2})
+    assert psharding.data_axis(named) == "batch"
+    assert psharding.model_axis(named) == "model"
+    legacy_data = make_mesh({"data": 8})
+    assert psharding.data_axis(legacy_data) == "data"
+    assert psharding.model_axis(legacy_data) is None
+    legacy_entity = make_mesh({"entity": 8})
+    assert psharding.data_axis(legacy_entity) is None
+    assert psharding.model_axis(legacy_entity) == "entity"
+
+
+def test_sharding_builders_reject_missing_axes(multichip):
+    entity_only = make_mesh({"entity": 8})
+    with pytest.raises(ValueError, match="batch/data axis"):
+        psharding.batch_sharding(entity_only)
+    batch_only = make_mesh({"batch": 8})
+    with pytest.raises(ValueError, match="model/entity axis"):
+        psharding.entity_sharding(batch_only)
+
+
+def test_place_entities_shards_leading_axis(multichip):
+    mesh = make_mesh({"model": 8})
+    table = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    placed = psharding.place_entities(table, mesh)
+    assert placed.sharding.spec == P("model")
+    sizes = {s.data.shape for s in placed.addressable_shards}
+    assert sizes == {(2, 4)}
+    np.testing.assert_array_equal(np.asarray(placed), table)
+
+
+def test_place_batch_pads_and_shards_sparse(rng, multichip):
+    from photon_ml_tpu.ops.sparse import SparseBatch
+
+    X = rng.normal(size=(13, 5)) * (rng.random((13, 5)) < 0.7)
+    y = (rng.random(13) > 0.5).astype(float)
+    batch = SparseBatch.from_dense(X, y)
+    mesh = make_mesh({"batch": 8})
+    placed = psharding.place_batch(batch, mesh)
+    assert placed.num_rows % 8 == 0
+    assert placed.nnz % 8 == 0
+    # padded rows are inert: weights 0 beyond the original row count
+    w = np.asarray(placed.weights)
+    assert np.all(w[batch.num_rows:] == 0)
+    # objective parity: padding must not change the value/grad
+    from photon_ml_tpu.ops.objective import make_objective
+
+    obj = make_objective("logistic", l2_weight=0.3)
+    wvec = jnp.asarray(rng.normal(size=batch.num_features) * 0.1, jnp.float32)
+    v0, g0 = obj.value_and_grad(wvec, batch)
+    v1, g1 = obj.value_and_grad(wvec, placed)
+    np.testing.assert_allclose(v1, v0, rtol=1e-5)
+    np.testing.assert_allclose(g1, g0, rtol=1e-4, atol=1e-5)
+
+
+def test_place_batch_pads_tiles(rng, multichip):
+    from photon_ml_tpu.ops.tiled import TiledBatch
+
+    n, d = 300, 40
+    X = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.4)
+    y = (rng.random(n) > 0.5).astype(float)
+    nz = np.nonzero(X)
+    tb = TiledBatch.from_coo(
+        values=X[nz], rows=nz[0], cols=nz[1], labels=y, num_features=d
+    )
+    mesh = make_mesh({"batch": 8})
+    placed = psharding.place_batch(tb, mesh)
+    assert placed.num_tiles % 8 == 0
+    wvec = jnp.asarray(rng.normal(size=d) * 0.1, jnp.float32)
+    z_ref = np.asarray(tb.dot_rows(wvec))
+    z = np.asarray(placed.dot_rows(wvec))
+    np.testing.assert_allclose(z[: len(z_ref)], z_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_pad_count():
+    assert psharding.pad_count(16, 8) == 16
+    assert psharding.pad_count(17, 8) == 24
+    assert psharding.pad_count(0, 8) == 0
+
+
+def test_make_mesh_named_axes(multichip):
+    mesh = make_mesh({"batch": 2, "model": 4})
+    assert dict(mesh.shape) == {"batch": 2, "model": 4}
+    assert isinstance(mesh, Mesh)

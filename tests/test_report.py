@@ -123,6 +123,42 @@ def test_heartbeat_beat_contents(fake_hbm, tmp_path):
     assert line2["rows_per_s"] == 0.0 and line2["seq"] == 2
 
 
+def test_device_spread_from_gauges_and_heartbeat(fake_hbm):
+    """Per-device HBM spread (max-min): computed from the published
+    memory.device.* gauges (make_mesh publishes them; CPU probes are
+    statless) and surfaced on heartbeat lines + its own gauge."""
+    from photon_ml_tpu.telemetry import memory as tmem
+
+    telemetry.gauge("memory.device.0.bytes_in_use").set(10 * 2**20)
+    telemetry.gauge("memory.device.1.bytes_in_use").set(4 * 2**20)
+    assert tmem.device_spread_bytes() == 6 * 2**20
+    assert (
+        telemetry.snapshot()["gauges"]["memory.device_spread_bytes"]
+        == 6 * 2**20
+    )
+    line = Heartbeat(interval=60).beat()
+    assert line["hbm_device_spread_bytes"] == 6 * 2**20
+
+
+def test_device_spread_unknown_with_one_device():
+    from photon_ml_tpu.telemetry import memory as tmem
+
+    telemetry.gauge("memory.device.0.bytes_in_use").set(10 * 2**20)
+    assert tmem.device_spread_bytes() is None
+    line = Heartbeat(interval=60).beat()
+    assert "hbm_device_spread_bytes" not in line
+
+
+def test_report_renders_device_spread():
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    telemetry.gauge("memory.device.0.bytes_in_use").set(3 * 2**30)
+    telemetry.gauge("memory.device.1.bytes_in_use").set(1 * 2**30)
+    md = RunReport.from_live().to_markdown()
+    assert "spread" in md
+    assert "2 devices" in md
+
+
 def test_heartbeat_daemon_thread_emits_and_stops(tmp_path):
     out = tmp_path / "hb.jsonl"
     hb = Heartbeat(interval=0.02, jsonl_path=str(out))

@@ -38,6 +38,12 @@ Runs, in order:
          through telemetry.xla.instrumented_jit so compiles land in the
          executable registry with cost analysis and recompile
          attribution; cold paths opt out via L011_COLD_ALLOWLIST.
+       - sharding discipline (L012: parallel/, the game/ mesh modules,
+         serving/): `jax.device_put` calls must pass an explicit
+         Sharding/device (a bare put lands on the default device and
+         silently replicates at the next jit boundary), and `pmap` is
+         rejected outright — GSPMD via NamedSharding + jit is the one
+         parallelism API (parallel/sharding.py).
   3. ruff + mypy, IF installed (configs live in pyproject.toml)
 
 Exit code 0 = clean. Any finding prints `path:line: code message` and the
@@ -122,6 +128,24 @@ L011_COLD_ALLOWLIST = {
     os.path.join("photon_ml_tpu", "parallel", "multihost.py"),
 }
 
+# Sharding-discipline modules (L012): in these hot paths every
+# `jax.device_put` must name an explicit placement (a Sharding/
+# NamedSharding/device second argument or device=/... keyword) — a bare
+# `device_put(x)` lands on the default device and is then silently
+# replicated/resharded at the next jit boundary, exactly the bug class
+# the GSPMD scale-out removed. Bare `pmap` is rejected outright (the
+# legacy per-device API; use NamedSharding + jit, parallel/sharding.py).
+L012_HOT_DIRS = (
+    os.path.join("photon_ml_tpu", "parallel") + os.sep,
+)
+L012_HOT_FILES = {
+    os.path.join("photon_ml_tpu", "game", "coordinates.py"),
+    os.path.join("photon_ml_tpu", "game", "streaming.py"),
+    os.path.join("photon_ml_tpu", "game", "factored.py"),
+    os.path.join("photon_ml_tpu", "serving", "engine.py"),
+    os.path.join("photon_ml_tpu", "serving", "registry.py"),
+}
+
 
 class _Lint(ast.NodeVisitor):
     def __init__(self, path: str, tree: ast.Module, library: bool = False):
@@ -134,6 +158,9 @@ class _Lint(ast.NodeVisitor):
         self._l011_hot = (
             path in L011_HOT_FILES or path.startswith(L011_HOT_DIRS)
         ) and path not in L011_COLD_ALLOWLIST
+        self._l012_hot = (
+            path in L012_HOT_FILES or path.startswith(L012_HOT_DIRS)
+        )
         # CLI modules own stdout: bare print() is their user interface
         self._l009_exempt = path.startswith(
             os.path.join("photon_ml_tpu", "cli") + os.sep
@@ -288,7 +315,39 @@ class _Lint(ast.NodeVisitor):
             and not all(isinstance(a, ast.Constant) for a in node.args)
         )
 
+    def _check_l012(self, node: ast.Call) -> None:
+        f = node.func
+        attr = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if attr == "pmap":
+            self._report(
+                node,
+                "L012",
+                "bare pmap in a sharding-discipline module — the legacy "
+                "per-device API replicates state and bypasses GSPMD; use "
+                "NamedSharding + jit (parallel/sharding.py)",
+            )
+        if attr == "device_put":
+            explicit = len(node.args) >= 2 or any(
+                k.arg in ("device", "sharding")
+                for k in node.keywords
+                if k.arg is not None
+            )
+            if not explicit:
+                self._report(
+                    node,
+                    "L012",
+                    "jax.device_put without an explicit Sharding — an "
+                    "unsharded upload lands on the default device and "
+                    "silently replicates/reshards at the next jit "
+                    "boundary; pass a NamedSharding (parallel/sharding.py "
+                    "placement helpers)",
+                )
+
     def visit_Call(self, node: ast.Call) -> None:
+        if self._l012_hot:
+            self._check_l012(node)
         if self.library and self._is_wall_clock_call(node):
             self._report(
                 node,
