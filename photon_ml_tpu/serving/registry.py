@@ -183,14 +183,19 @@ class ModelRegistry:
                     engine.warmup()
             except (ValueError, OSError, TypeError, KeyError) as e:
                 # ModelLoadError is a ValueError; OSError covers a
-                # half-deleted directory; TypeError an unservable model
-                self._skipped[path] = mtime
+                # half-deleted directory; TypeError an unservable model.
+                # _skipped is shared with concurrent refresh() callers
+                # (start() on the main thread vs the poll loop), so its
+                # writes take the lock like every other registry mutation
+                # (lint L015)
+                with self._lock:
+                    self._skipped[path] = mtime
                 telemetry.counter("serving.skipped_versions").inc()
                 logger.warning("skipping unusable model version %s: %s",
                                path, e)
                 continue
-            self._skipped.pop(path, None)
             with self._lock:
+                self._skipped.pop(path, None)
                 if version <= self._version:  # raced with another refresh
                     return False
                 old = self._engine

@@ -26,6 +26,11 @@ train CLI leaves the heartbeat on by default with a ~30 s interval.
 The heartbeat must never fail or slow training: all probes swallow
 errors, the JSONL sink is append-only and disabled on write failure, and
 ``stop()`` always joins the thread.
+
+``beat()`` is public (tests drive it directly) AND the daemon thread's
+whole job, so the sampling state (``_seq``, the ``_last_*`` rate cursors,
+the sink path) is written from two threads: every write sits under
+``self._lock`` (lint L015 — the lock-discipline pass — enforces this).
 """
 
 from __future__ import annotations
@@ -66,6 +71,11 @@ class Heartbeat:
         self.jsonl_path = jsonl_path
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # guards the sampling cursors below: beat() runs on the daemon
+        # thread AND is public API for deterministic tests — an unlocked
+        # read-modify-write of the _last_* deltas from both sides would
+        # double-count or lose a rate window (lint L015)
+        self._lock = threading.Lock()
         self._seq = 0
         self._t0 = time.monotonic()
         self._last_t = self._t0
@@ -81,15 +91,18 @@ class Heartbeat:
         if self._thread is not None:
             return self  # idempotent
         self._stop.clear()
-        self._t0 = time.monotonic()
-        self._last_t = self._t0
-        self._last_rows = metrics.counter("progress.rows").value
-        self._last_coeffs = metrics.counter("progress.coeffs").value
-        # peek, don't create: registering these at 0 would turn the run
-        # report's "unknown" (counter absent) into a fabricated 0
-        self._last_flops = metrics.peek_counter("xla.flops_total") or 0.0
-        self._last_xla_bytes = metrics.peek_counter("xla.bytes_total") or 0.0
-        self._last_comms = metrics.peek_counter("comms.bytes_total") or 0.0
+        with self._lock:
+            self._t0 = time.monotonic()
+            self._last_t = self._t0
+            self._last_rows = metrics.counter("progress.rows").value
+            self._last_coeffs = metrics.counter("progress.coeffs").value
+            # peek, don't create: registering these at 0 would turn the
+            # run report's "unknown" (counter absent) into a fabricated 0
+            self._last_flops = metrics.peek_counter("xla.flops_total") or 0.0
+            self._last_xla_bytes = (
+                metrics.peek_counter("xla.bytes_total") or 0.0
+            )
+            self._last_comms = metrics.peek_counter("comms.bytes_total") or 0.0
         self._thread = threading.Thread(
             target=self._run, name="photon-heartbeat", daemon=True
         )
@@ -120,43 +133,57 @@ class Heartbeat:
     # -- one beat ------------------------------------------------------------
 
     def beat(self) -> dict[str, Any]:
-        """Sample progress, emit one line, and return it."""
-        now = time.monotonic()
-        dt = max(now - self._last_t, 1e-9)
-        rows = metrics.counter("progress.rows").value
-        coeffs = metrics.counter("progress.coeffs").value
-        rows_per_s = (rows - self._last_rows) / dt
-        coeffs_per_s = (coeffs - self._last_coeffs) / dt
-        self._last_t, self._last_rows, self._last_coeffs = now, rows, coeffs
-        if rows_per_s > 0:
-            metrics.gauge("progress.rows_per_sec").set(rows_per_s)
-        if coeffs_per_s > 0:
-            metrics.gauge("progress.coeffs_per_sec").set(coeffs_per_s)
+        """Sample progress, emit one line, and return it.
 
-        self._seq += 1
-        line: dict[str, Any] = {
-            "type": "heartbeat",
-            "seq": self._seq,
-            "uptime_s": round(now - self._t0, 3),
-            "span": trace.active_span_path(),
-            "rows_per_s": round(rows_per_s, 1),
-            "coeffs_per_s": round(coeffs_per_s, 1),
-            "rows_total": rows,
-            "coeffs_total": coeffs,
-            "dropped_spans": metrics.counter("trace.dropped_spans").value,
-        }
-        # device utilization over the beat window (ISSUE 5): live MFU
-        # needs both cost analysis (flops counted) and a known device
-        # peak; comms fraction needs a comms estimate — absent either,
-        # the fields are simply omitted ("unknown"), never zero
-        flops = metrics.peek_counter("xla.flops_total") or 0.0
-        xla_bytes = metrics.peek_counter("xla.bytes_total") or 0.0
-        comms = metrics.peek_counter("comms.bytes_total") or 0.0
-        d_flops = flops - self._last_flops
-        d_bytes = xla_bytes - self._last_xla_bytes
-        d_comms = comms - self._last_comms
-        self._last_flops, self._last_xla_bytes = flops, xla_bytes
-        self._last_comms = comms
+        Sampling (the ``_last_*`` delta cursors and ``_seq``) runs under
+        ``self._lock`` — the daemon thread and a direct test caller may
+        beat concurrently; the log/sink emit stays outside the lock so
+        slow I/O never blocks the other sampler."""
+        with self._lock:
+            now = time.monotonic()
+            dt = max(now - self._last_t, 1e-9)
+            rows = metrics.counter("progress.rows").value
+            coeffs = metrics.counter("progress.coeffs").value
+            rows_per_s = (rows - self._last_rows) / dt
+            coeffs_per_s = (coeffs - self._last_coeffs) / dt
+            self._last_t, self._last_rows, self._last_coeffs = (
+                now, rows, coeffs,
+            )
+            if rows_per_s > 0:
+                metrics.gauge("progress.rows_per_sec").set(rows_per_s)
+            if coeffs_per_s > 0:
+                metrics.gauge("progress.coeffs_per_sec").set(coeffs_per_s)
+
+            self._seq += 1
+            line: dict[str, Any] = {
+                "type": "heartbeat",
+                "seq": self._seq,
+                "uptime_s": round(now - self._t0, 3),
+                "span": trace.active_span_path(),
+                "rows_per_s": round(rows_per_s, 1),
+                "coeffs_per_s": round(coeffs_per_s, 1),
+                "rows_total": rows,
+                "coeffs_total": coeffs,
+                "dropped_spans": metrics.counter("trace.dropped_spans").value,
+            }
+            # device utilization over the beat window (ISSUE 5): live MFU
+            # needs both cost analysis (flops counted) and a known device
+            # peak; comms fraction needs a comms estimate — absent either,
+            # the fields are simply omitted ("unknown"), never zero
+            flops = metrics.peek_counter("xla.flops_total") or 0.0
+            xla_bytes = metrics.peek_counter("xla.bytes_total") or 0.0
+            comms = metrics.peek_counter("comms.bytes_total") or 0.0
+            d_flops = flops - self._last_flops
+            d_bytes = xla_bytes - self._last_xla_bytes
+            d_comms = comms - self._last_comms
+            self._last_flops, self._last_xla_bytes = flops, xla_bytes
+            self._last_comms = comms
+            sink = self.jsonl_path
+
+        # everything below reads device/metrics state, not heartbeat
+        # cursors — it stays OUTSIDE the lock so a stalled device probe
+        # (hbm_stats queries every mesh device) never blocks the other
+        # sampler; the deltas feeding these fields were captured above
         if d_flops > 0:
             peak_flops, _peak_bw = xla.device_peaks()
             if peak_flops:
@@ -193,14 +220,14 @@ class Heartbeat:
             line["guard"] = guard
 
         logger.info("heartbeat %s", json.dumps(line, default=str))
-        if self.jsonl_path is not None:
+        if sink is not None:
             try:
-                with open(self.jsonl_path, "a", encoding="utf-8") as fh:
+                with open(sink, "a", encoding="utf-8") as fh:
                     fh.write(json.dumps(line, default=str) + "\n")
             except OSError:
                 logger.warning(
-                    "heartbeat sink %s unwritable; disabling it",
-                    self.jsonl_path,
+                    "heartbeat sink %s unwritable; disabling it", sink
                 )
-                self.jsonl_path = None
+                with self._lock:
+                    self.jsonl_path = None
         return line

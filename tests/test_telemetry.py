@@ -552,13 +552,11 @@ def test_fe_tracker_feeds_histogram():
 
 
 def test_check_lint_rejects_fake_timing_in_library_code(tmp_path):
+    # the _Lint monolith moved into the tools.analysis package (ISSUE 7);
+    # the per-file rules live in LocalLint and emit structured findings
     import ast
 
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
-    try:
-        from check import _Lint
-    finally:
-        sys.path.pop(0)
+    from tools.analysis.local import LocalLint
 
     src = (
         "import time\n"
@@ -577,20 +575,20 @@ def test_check_lint_rejects_fake_timing_in_library_code(tmp_path):
         "    block_until_ready(x)\n"
         "    return t0\n"
     )
-    ev = _Lint("photon_ml_tpu/z.py", ast.parse(evasive), library=True)
-    ev_codes = [f.split()[1] for f in ev.findings]
+    ev = LocalLint("photon_ml_tpu/z.py", ast.parse(evasive), library=True)
+    ev_codes = [f.code for f in ev.findings]
     assert "L006" in ev_codes and "L007" in ev_codes
     tree = ast.parse(src)
-    lib = _Lint("photon_ml_tpu/x.py", tree, library=True)
-    codes = [f.split()[1] for f in lib.findings]
+    lib = LocalLint("photon_ml_tpu/x.py", tree, library=True)
+    codes = [f.code for f in lib.findings]
     assert "L006" in codes and "L007" in codes
     # benches/tests keep their freedom
-    bench = _Lint("bench.py", ast.parse(src), library=False)
-    assert not any(" L006 " in f or " L007 " in f for f in bench.findings)
+    bench = LocalLint("bench.py", ast.parse(src), library=False)
+    assert not any(f.code in ("L006", "L007") for f in bench.findings)
     # a USED result is not flagged (only bare statements are timing syncs)
     used = ast.parse("import jax\ndef g(x):\n    return jax.block_until_ready(x)\n")
-    lib2 = _Lint("photon_ml_tpu/y.py", used, library=True)
-    assert not any("L007" in f for f in lib2.findings)
+    lib2 = LocalLint("photon_ml_tpu/y.py", used, library=True)
+    assert not any(f.code == "L007" for f in lib2.findings)
 
 
 def test_check_lint_rejects_bare_print_in_library_code():
@@ -598,26 +596,24 @@ def test_check_lint_rejects_bare_print_in_library_code():
     in CLI modules (stdout is their interface) and in benches/tests."""
     import ast
 
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
-    try:
-        from check import _Lint
-    finally:
-        sys.path.pop(0)
+    from tools.analysis.local import LocalLint
 
     src = 'def f():\n    print("hi")\n'
-    lib = _Lint("photon_ml_tpu/game/x.py", ast.parse(src), library=True)
-    assert any(" L009 " in f for f in lib.findings)
-    cli = _Lint("photon_ml_tpu/cli/train.py", ast.parse(src), library=True)
-    assert not any(" L009 " in f for f in cli.findings)
-    bench = _Lint("bench.py", ast.parse(src), library=False)
-    assert not any(" L009 " in f for f in bench.findings)
+    lib = LocalLint("photon_ml_tpu/game/x.py", ast.parse(src), library=True)
+    assert any(f.code == "L009" for f in lib.findings)
+    cli = LocalLint(
+        "photon_ml_tpu/cli/train.py", ast.parse(src), library=True
+    )
+    assert not any(f.code == "L009" for f in cli.findings)
+    bench = LocalLint("bench.py", ast.parse(src), library=False)
+    assert not any(f.code == "L009" for f in bench.findings)
     # method calls named print (e.g. logger-ish objects) are not flagged
-    method = _Lint(
+    method = LocalLint(
         "photon_ml_tpu/game/y.py",
         ast.parse("def f(doc):\n    doc.print()\n"),
         library=True,
     )
-    assert not any(" L009 " in f for f in method.findings)
+    assert not any(f.code == "L009" for f in method.findings)
 
 
 # -- reset / env configuration ------------------------------------------------

@@ -1,0 +1,1 @@
+"""Repo tooling: the static-analysis gate lives in tools/analysis."""

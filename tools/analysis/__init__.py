@@ -1,0 +1,34 @@
+"""Multi-pass, whole-package static analysis behind ``tools/check.py``.
+
+The gate's reference analog is the scalastyle + Apache RAT pair of the
+reference build: zero-setup, stdlib-only, every source file must pass
+before code lands. The passes, in execution order:
+
+1. :mod:`tools.analysis.core` — parse every file ONCE (syntax errors are
+   findings of the single parse, not a separate compile phase) and carry
+   the shared ASTs, ``# photon: noqa[Lxxx]`` suppressions, and the
+   ``--baseline`` diff machinery.
+2. :mod:`tools.analysis.local` — the per-file AST lint (L001-L012),
+   formerly the monolithic ``_Lint`` visitor inside check.py.
+3. :mod:`tools.analysis.callgraph` — module index + import-resolved
+   intra-package call graph over ``photon_ml_tpu/`` (AST-only: the gate
+   still runs in hermetic images with no linters installed).
+4. :mod:`tools.analysis.hotpath` — L013: the L010/L011 path lists become
+   *seeds*; hotness propagates transitively along call edges, and a sync
+   or bare jit hiding in a helper module is reported with its full call
+   chain.
+5. :mod:`tools.analysis.jitpurity` — L014: functions traced by
+   ``instrumented_jit`` / ``jax.jit`` / ``lax.while_loop`` / ``lax.scan``
+   (resolved through the call graph) must not touch host state — those
+   effects run once at trace time and silently never again.
+6. :mod:`tools.analysis.locks` — L015: classes that spawn threads must
+   guard attributes written from both the thread target and public
+   methods with ``with self._lock/_cv``.
+
+:mod:`tools.analysis.driver` orchestrates all of it and owns the CLI
+surface (``--json``, ``--baseline``, ``--write-baseline``, ``--root``).
+"""
+
+from tools.analysis.driver import analyze, Result  # noqa: F401 (re-export)
+
+__all__ = ["analyze", "Result"]

@@ -1,0 +1,387 @@
+"""Pass 1: module index + import-resolved intra-package call graph.
+
+AST-only and stdlib-only by design — the gate must run in hermetic images
+with nothing installed, so resolution is static name-following, not
+import execution:
+
+- every module under the package root is indexed: top-level functions,
+  class methods, and *nested* functions (the repo's dominant jit idiom is
+  a closure factory — ``_fe_solver`` returning ``instrumented_jit(run)`` —
+  so nested defs are first-class graph nodes, connected to their enclosing
+  function by a containment edge);
+- import bindings (``import m as x``, ``from pkg.mod import f as g``,
+  relative forms) are recorded per module from the WHOLE file, including
+  function-local imports (``ScoringEngine.load`` imports the model store
+  inside the method body);
+- calls resolve through those bindings, following re-exports one hop at a
+  time (``telemetry.instrumented_jit`` -> ``telemetry/__init__`` binding
+  -> ``telemetry.xla.instrumented_jit``), ``self.method`` to the defining
+  class, and ``ClassName(...)`` to ``ClassName.__init__``;
+- unresolvable calls (dynamic attributes, externals) resolve to a dotted
+  name when the root is an imported module (``t.time`` with
+  ``import time as t`` -> ``time.time`` — exactly what the wall-clock and
+  jit detectors need) and to ``None`` otherwise. Inheritance is NOT
+  walked: a miss means a silently absent edge, so passes that depend on
+  reachability keep their seed lists explicit and verified (W002).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Optional
+
+from tools.analysis.core import SourceFile
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qname: str
+    name: str
+    module: str  # module dotted name
+    rel: str  # file path (for findings)
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    lineno: int
+    class_qname: Optional[str] = None
+    parent: Optional[str] = None  # enclosing function qname (nested defs)
+    nested: list = dataclasses.field(default_factory=list)  # child qnames
+    # (resolved dotted name or None, ast.Call) for every call in the OWN
+    # body — nested defs collect their own calls
+    calls: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qname: str
+    name: str
+    module: str
+    rel: str
+    node: ast.ClassDef
+    methods: dict = dataclasses.field(default_factory=dict)  # name -> qname
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str
+    rel: str
+    tree: ast.Module
+    is_init: bool
+    bindings: dict = dataclasses.field(default_factory=dict)  # name -> dotted
+
+
+def module_name_for(rel: str) -> tuple[str, bool]:
+    """repo-relative path -> (dotted module name, is __init__)."""
+    parts = rel[: -len(".py")].split(os.sep)
+    if parts[-1] == "__init__":
+        return ".".join(parts[:-1]), True
+    return ".".join(parts), False
+
+
+def own_body_nodes(fn_node: ast.AST):
+    """Yield every AST node of a def's own body, NOT descending into
+    nested function/class definitions (those are separate graph nodes);
+    lambdas stay inline — their calls belong to the enclosing function."""
+    stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class PackageGraph:
+    """Whole-package index + call graph (see module docstring)."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve_export(self, dotted: str) -> str:
+        """Follow import re-exports until the name stops moving.
+
+        ``photon_ml_tpu.telemetry.instrumented_jit`` resolves through the
+        ``__init__`` binding to ``photon_ml_tpu.telemetry.xla
+        .instrumented_jit``; external names (``jax.lax.while_loop``,
+        ``time.time``) come back unchanged — detectors match on them."""
+        seen = set()
+        while dotted not in seen:
+            seen.add(dotted)
+            if (
+                dotted in self.functions
+                or dotted in self.classes
+                or dotted in self.modules
+            ):
+                return dotted
+            head, _, tail = dotted.rpartition(".")
+            if not head:
+                return dotted
+            if head in self.modules:
+                nxt = self.modules[head].bindings.get(tail)
+                if nxt is None or nxt == dotted:
+                    return dotted
+                dotted = nxt
+                continue
+            resolved_head = self.resolve_export(head)
+            if resolved_head == head:
+                return dotted
+            dotted = resolved_head + "." + tail
+        return dotted
+
+    def _resolve_func_expr(
+        self, fn: FunctionInfo, expr: ast.AST
+    ) -> Optional[str]:
+        """Resolve a Call.func expression to a dotted name, or None."""
+        if isinstance(expr, ast.Name):
+            # enclosing-function scope chain: own nested defs first, then
+            # each ancestor function's nested defs
+            scope: Optional[FunctionInfo] = fn
+            while scope is not None:
+                cand = scope.qname + "." + expr.id
+                if cand in self.functions:
+                    return cand
+                scope = (
+                    self.functions.get(scope.parent)
+                    if scope.parent
+                    else None
+                )
+            mod = self.modules[fn.module]
+            cand = mod.name + "." + expr.id
+            if cand in self.functions or cand in self.classes:
+                return cand
+            target = mod.bindings.get(expr.id)
+            if target is not None:
+                return self.resolve_export(target)
+            return None
+        if isinstance(expr, ast.Attribute):
+            parts: list[str] = []
+            root: ast.AST = expr
+            while isinstance(root, ast.Attribute):
+                parts.append(root.attr)
+                root = root.value
+            parts.reverse()
+            if not isinstance(root, ast.Name):
+                return None
+            if root.id in ("self", "cls") and fn.class_qname is not None:
+                if len(parts) == 1:
+                    cand = fn.class_qname + "." + parts[0]
+                    if cand in self.functions:
+                        return cand
+                return None
+            mod = self.modules[fn.module]
+            base = mod.bindings.get(root.id)
+            if base is None:
+                # a sibling definition used as a namespace (rare) or an
+                # unimported name — give up rather than guess
+                cand = mod.name + "." + root.id
+                if cand in self.classes:
+                    base = cand
+                else:
+                    return None
+            return self.resolve_export(base + "." + ".".join(parts))
+        return None
+
+    def resolve_call_target(self, resolved: Optional[str]) -> Optional[str]:
+        """Map a resolved dotted name to a graph FUNCTION node, following
+        ``ClassName`` to ``ClassName.__init__``; None for externals."""
+        if resolved is None:
+            return None
+        if resolved in self.functions:
+            return resolved
+        if resolved in self.classes:
+            init = self.classes[resolved].methods.get("__init__")
+            return init
+        return None
+
+    def callees(self, qname: str) -> list[tuple[str, int]]:
+        """(callee function qname, call lineno) edges, including the
+        containment edges to nested defs (a closure factory's inner
+        function runs whenever the factory's product is called — the
+        conservative reading that makes hot-path propagation sound for
+        the ``return instrumented_jit(run)`` idiom)."""
+        fn = self.functions[qname]
+        out = []
+        for resolved, call in fn.calls:
+            target = self.resolve_call_target(resolved)
+            if target is not None:
+                out.append((target, call.lineno))
+        for child in fn.nested:
+            out.append((child, self.functions[child].lineno))
+        return out
+
+    def reachable(
+        self, seeds: list[str]
+    ) -> dict[str, tuple[Optional[str], int]]:
+        """BFS closure: qname -> (predecessor qname or None for a seed,
+        lineno of the edge's call site). Shortest chains by construction."""
+        frontier = [q for q in seeds if q in self.functions]
+        visited: dict[str, tuple[Optional[str], int]] = {
+            q: (None, self.functions[q].lineno) for q in frontier
+        }
+        while frontier:
+            nxt: list[str] = []
+            for q in frontier:
+                for callee, lineno in self.callees(q):
+                    if callee not in visited:
+                        visited[callee] = (q, lineno)
+                        nxt.append(callee)
+            frontier = nxt
+        return visited
+
+    def chain_to(
+        self, reach: dict[str, tuple[Optional[str], int]], qname: str
+    ) -> tuple[str, ...]:
+        """Seed-first call chain for a reached function."""
+        chain = [qname]
+        cur = qname
+        while True:
+            pred = reach[cur][0]
+            if pred is None:
+                break
+            chain.append(pred)
+            cur = pred
+        return tuple(reversed(chain))
+
+
+# ---------------------------------------------------------------------------
+# Construction
+# ---------------------------------------------------------------------------
+
+
+def _collect_bindings(mod: ModuleInfo) -> None:
+    base_parts = mod.name.split(".")
+    if not mod.is_init:
+        base_parts = base_parts[:-1]
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname is not None:
+                    mod.bindings[a.asname] = a.name
+                else:
+                    root = a.name.split(".")[0]
+                    mod.bindings[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            if node.level == 0:
+                prefix = node.module or ""
+            else:
+                up = base_parts[: len(base_parts) - (node.level - 1)]
+                prefix = ".".join(up + ([node.module] if node.module else []))
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                target = f"{prefix}.{a.name}" if prefix else a.name
+                mod.bindings[a.asname or a.name] = target
+
+
+def _direct_defs(body):
+    """Function/class statements directly in scope: descends through
+    control flow (if/try/with bodies) but never across another def or
+    class boundary — those open their own scope."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            yield node
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _DefIndexer:
+    """Index top-level functions, class methods, and nested defs."""
+
+    def __init__(self, graph: PackageGraph, mod: ModuleInfo):
+        self.graph = graph
+        self.mod = mod
+        self._func_stack: list[str] = []  # enclosing function qnames
+        self._class_stack: list[str] = []  # enclosing class qnames
+
+    def _qualify(self, name: str) -> str:
+        if self._func_stack:
+            return self._func_stack[-1] + "." + name
+        if self._class_stack:
+            return self._class_stack[-1] + "." + name
+        return self.mod.name + "." + name
+
+    def index_module(self) -> None:
+        for node in _direct_defs(self.mod.tree.body):
+            self._visit(node)
+
+    def _visit(self, node) -> None:
+        if isinstance(node, ast.ClassDef):
+            if self._func_stack:
+                return  # classes defined inside functions: out of scope
+            self._visit_class(node)
+        else:
+            self._visit_def(node)
+
+    def _visit_class(self, node: ast.ClassDef) -> None:
+        qname = self._qualify(node.name)
+        self.graph.classes[qname] = ClassInfo(
+            qname=qname,
+            name=node.name,
+            module=self.mod.name,
+            rel=self.mod.rel,
+            node=node,
+        )
+        self._class_stack.append(qname)
+        for child in _direct_defs(node.body):
+            self._visit(child)
+        self._class_stack.pop()
+
+    def _visit_def(self, node) -> None:
+        qname = self._qualify(node.name)
+        in_class = bool(self._class_stack) and not self._func_stack
+        info = FunctionInfo(
+            qname=qname,
+            name=node.name,
+            module=self.mod.name,
+            rel=self.mod.rel,
+            node=node,
+            lineno=node.lineno,
+            class_qname=self._class_stack[-1] if in_class else None,
+            parent=self._func_stack[-1] if self._func_stack else None,
+        )
+        self.graph.functions[qname] = info
+        if info.parent:
+            self.graph.functions[info.parent].nested.append(qname)
+        if in_class:
+            self.graph.classes[self._class_stack[-1]].methods[
+                node.name
+            ] = qname
+        self._func_stack.append(qname)
+        for child in _direct_defs(node.body):
+            self._visit(child)
+        self._func_stack.pop()
+
+
+def build_graph(package_files: list[SourceFile]) -> PackageGraph:
+    """Index + resolve the call graph over the package's source files."""
+    graph = PackageGraph()
+    for sf in package_files:
+        if sf.tree is None:
+            continue  # syntax errors are already findings
+        name, is_init = module_name_for(sf.rel)
+        mod = ModuleInfo(name=name, rel=sf.rel, tree=sf.tree, is_init=is_init)
+        _collect_bindings(mod)
+        graph.modules[name] = mod
+    for mod in graph.modules.values():
+        _DefIndexer(graph, mod).index_module()
+    # resolve calls only after EVERY module is indexed (forward refs,
+    # re-exports through __init__ surfaces)
+    for fn in graph.functions.values():
+        for node in own_body_nodes(fn.node):
+            if isinstance(node, ast.Call):
+                fn.calls.append(
+                    (graph._resolve_func_expr(fn, node.func), node)
+                )
+    return graph

@@ -1,0 +1,231 @@
+"""Shared plumbing for the analysis passes: parsed sources, findings,
+inline suppressions, and the baseline diff.
+
+Parse-once is a deliberate perf fix: the old gate compiled every file in
+``check_syntax`` and then re-parsed the survivors in ``check_lint`` — two
+full passes over a 130-file tree. Here every file is parsed exactly once;
+a failed parse becomes a ``SYNTAX`` finding and the file simply carries no
+tree for the later passes.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from typing import Iterable, Optional
+
+#: Inline suppression comment on the exact line the finding is reported
+#: at; the form is `photon: noqa` followed by the bracketed code list
+#: (one code, or comma-separated). Matched against real COMMENT tokens
+#: only — the same text inside a string literal (test fixtures, docs)
+#: neither suppresses nor counts as a stale suppression.
+NOQA_RE = re.compile(r"#\s*photon:\s*noqa\[([A-Za-z0-9_,\s]+)\]")
+
+#: Code of the unused-suppression warning (itself not suppressible:
+#: a noqa that silences the warning about itself would always be "used").
+UNUSED_SUPPRESSION = "W001"
+
+#: Code of a pass-configuration error (e.g. a hot-path seed that no longer
+#: resolves after a rename — the pass would silently stop guarding).
+BAD_SEED = "W002"
+
+
+@dataclasses.dataclass
+class Finding:
+    """One gate finding. ``chain`` carries the call path for the
+    interprocedural passes (L013/L014), seed first, offending function
+    last."""
+
+    path: str
+    line: int
+    code: str
+    message: str
+    chain: Optional[tuple[str, ...]] = None
+
+    def render(self) -> str:
+        text = f"{self.path}:{self.line}: {self.code} {self.message}"
+        if self.chain:
+            text += f" [via {' -> '.join(self.chain)}]"
+        return text
+
+    def key(self) -> tuple[str, str, str]:
+        # baseline identity deliberately excludes the line number — pure
+        # line drift (code added above a grandfathered finding) must not
+        # resurrect it. Messages themselves may embed line numbers (L014
+        # cites the jit registration site, L015 lists write lines), so
+        # digits are normalized out of the key for the same reason.
+        return (self.path, self.code, re.sub(r"\d+", "#", self.message))
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "code": self.code,
+            "message": self.message,
+            "chain": list(self.chain) if self.chain else None,
+        }
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """One parsed source file: the single AST shared by every pass."""
+
+    rel: str  # repo-relative path (the path findings report)
+    abspath: str
+    text: str
+    lines: list[str]
+    tree: Optional[ast.Module]
+    error: Optional[SyntaxError]
+
+
+def load_source(root_rel: str, abspath: str) -> SourceFile:
+    with open(abspath, encoding="utf-8") as fh:
+        text = fh.read()
+    tree: Optional[ast.Module] = None
+    error: Optional[SyntaxError] = None
+    try:
+        tree = ast.parse(text, filename=abspath)
+    except SyntaxError as e:
+        error = e
+    return SourceFile(
+        rel=root_rel,
+        abspath=abspath,
+        text=text,
+        lines=text.splitlines(),
+        tree=tree,
+        error=error,
+    )
+
+
+def syntax_findings(files: Iterable[SourceFile]) -> list[Finding]:
+    out = []
+    for sf in files:
+        if sf.error is not None:
+            out.append(
+                Finding(
+                    path=sf.rel,
+                    line=sf.error.lineno or 0,
+                    code="SYNTAX",
+                    message=sf.error.msg or "invalid syntax",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+def collect_suppressions(sf: SourceFile) -> dict[int, set[str]]:
+    """1-based line -> set of codes suppressed on that line.
+
+    Tokenizes the file so only REAL comments count: a noqa-shaped string
+    inside a docstring or a test fixture literal is inert."""
+    out: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(sf.text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = NOQA_RE.search(tok.string)
+            if m:
+                codes = {
+                    c.strip() for c in m.group(1).split(",") if c.strip()
+                }
+                if codes:
+                    out.setdefault(tok.start[0], set()).update(codes)
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        pass  # unparseable files are already SYNTAX findings
+    return out
+
+
+def apply_suppressions(
+    findings: list[Finding],
+    suppressions: dict[str, dict[int, set[str]]],
+) -> tuple[list[Finding], list[Finding]]:
+    """-> (kept findings, unused-suppression warnings).
+
+    A finding is suppressed when its exact reported line carries a
+    ``# photon: noqa[<its code>]`` comment. Every suppression entry that
+    silenced nothing becomes a W001 warning, so stale noqa comments are
+    flushed out instead of rotting into false confidence.
+    """
+    used: set[tuple[str, int, str]] = set()
+    kept: list[Finding] = []
+    for f in findings:
+        codes = suppressions.get(f.path, {}).get(f.line, set())
+        if f.code in codes:
+            used.add((f.path, f.line, f.code))
+        else:
+            kept.append(f)
+    warnings = []
+    for path, per_line in sorted(suppressions.items()):
+        for line, codes in sorted(per_line.items()):
+            for code in sorted(codes):
+                if (path, line, code) not in used:
+                    warnings.append(
+                        Finding(
+                            path=path,
+                            line=line,
+                            code=UNUSED_SUPPRESSION,
+                            message=(
+                                f"unused suppression `# photon: "
+                                f"noqa[{code}]` — nothing on this line "
+                                f"triggers {code}; delete the comment"
+                            ),
+                        )
+                    )
+    return kept, warnings
+
+
+# ---------------------------------------------------------------------------
+# Baseline diff
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict[tuple[str, str, str], int]:
+    """Grandfathered finding keys -> accepted OCCURRENCE COUNT, from a
+    ``--baseline`` JSON file (the ``--write-baseline`` / ``--json``
+    schema: ``{"findings": [...]}`` with ``path``/``code``/``message``
+    per entry; duplicate keys accumulate)."""
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data["findings"] if isinstance(data, dict) else data
+    out: dict[tuple[str, str, str], int] = {}
+    for e in entries:
+        # normalize exactly like Finding.key(): stored messages carry the
+        # line numbers of their era, keys must not
+        key = (e["path"], e["code"], re.sub(r"\d+", "#", e["message"]))
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+def split_baseline(
+    findings: list[Finding], baseline
+) -> tuple[list[Finding], list[Finding], list[tuple[str, str, str]]]:
+    """-> (new findings that fail CI, grandfathered findings, stale
+    baseline keys no current finding consumed — fixed, delete them).
+
+    MULTISET semantics: each baseline entry absorbs exactly ONE matching
+    occurrence. Per-file rules have constant messages, so set semantics
+    would let one grandfathered ``print()`` green-light every future
+    ``print()`` in the same file — the exact "only NEW findings fail"
+    contract the baseline exists to keep."""
+    if not isinstance(baseline, dict):
+        baseline = {k: 1 for k in baseline}
+    remaining = dict(baseline)
+    new: list[Finding] = []
+    grandfathered: list[Finding] = []
+    for f in findings:
+        if remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+            grandfathered.append(f)
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in remaining.items() if n > 0)
+    return new, grandfathered, stale

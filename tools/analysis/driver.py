@@ -1,0 +1,140 @@
+"""Orchestration: file discovery, the single parse, every pass, then
+suppressions and the baseline diff. ``tools/check.py`` is a thin CLI over
+:func:`analyze`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import os
+from typing import Optional
+
+from tools.analysis import hotpath, jitpurity, local, locks
+from tools.analysis.callgraph import build_graph
+from tools.analysis.core import (
+    Finding,
+    SourceFile,
+    apply_suppressions,
+    collect_suppressions,
+    load_source,
+    split_baseline,
+    syntax_findings,
+)
+
+TARGETS = ("photon_ml_tpu", "tests", "tools", "__graft_entry__.py")
+PACKAGE_DIR = "photon_ml_tpu"
+
+
+def source_files(root: str) -> list[str]:
+    # every bench script is gated (a literal list silently missed new ones)
+    out = sorted(_glob.glob(os.path.join(root, "bench*.py")))
+    for t in TARGETS:
+        path = os.path.join(root, t)
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        if not os.path.isdir(path):
+            continue  # --root trees (tests) may carry only the package
+        for walk_root, _dirs, files in os.walk(path):
+            out.extend(
+                os.path.join(walk_root, f)
+                for f in files
+                if f.endswith(".py")
+            )
+    return sorted(out)
+
+
+@dataclasses.dataclass
+class Result:
+    root: str
+    files: list[SourceFile]
+    findings: list[Finding]  # NEW findings: these fail the gate
+    grandfathered: list[Finding]  # matched --baseline entries
+    stale_baseline: list[tuple[str, str, str]]  # baseline keys gone stale
+    # call-graph coverage (tests assert the interprocedural passes really
+    # ran over the whole package, not a silently empty graph)
+    graph_stats: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.code] = out.get(f.code, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "root": self.root,
+            "files": len(self.files),
+            "findings": [f.to_json() for f in self.findings],
+            "grandfathered": [f.to_json() for f in self.grandfathered],
+            "stale_baseline": [list(k) for k in self.stale_baseline],
+            "counts": self.counts(),
+            "graph": self.graph_stats,
+        }
+
+
+def analyze(
+    root: str,
+    baseline: Optional[dict] = None,  # key -> count, or a set (count 1)
+    require_seeds: bool = True,
+) -> Result:
+    """Run the whole gate over ``root``. ``require_seeds=False`` relaxes
+    the W002 seed check for reduced test trees that intentionally carry
+    only a few modules."""
+    files = [
+        load_source(os.path.relpath(p, root), p) for p in source_files(root)
+    ]
+    findings = syntax_findings(files)
+
+    pkg_prefix = PACKAGE_DIR + os.sep
+    for sf in files:
+        if sf.tree is None:
+            continue
+        if os.path.basename(sf.rel) == "__init__.py":
+            continue  # re-export surfaces import without using
+        findings.extend(
+            local.lint_file(
+                sf.rel, sf.tree, library=sf.rel.startswith(pkg_prefix)
+            )
+        )
+
+    # interprocedural passes over the library package (incl. __init__
+    # trees: re-export bindings are what resolution follows)
+    package_files = [sf for sf in files if sf.rel.startswith(pkg_prefix)]
+    graph = build_graph(package_files)
+    findings.extend(hotpath.run(graph, require_seeds=require_seeds))
+    findings.extend(jitpurity.run(graph))
+    findings.extend(locks.run(graph))
+    graph_stats = {
+        "modules": len(graph.modules),
+        "functions": len(graph.functions),
+        "classes": len(graph.classes),
+    }
+
+    suppressions = {}
+    for sf in files:
+        per_file = collect_suppressions(sf)
+        if per_file:
+            suppressions[sf.rel] = per_file
+    kept, unused_warnings = apply_suppressions(findings, suppressions)
+    kept.extend(unused_warnings)
+    kept.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+
+    if baseline:
+        new, grandfathered, stale = split_baseline(kept, baseline)
+    else:
+        new, grandfathered, stale = kept, [], []
+    return Result(
+        root=root,
+        files=files,
+        findings=new,
+        grandfathered=grandfathered,
+        stale_baseline=stale,
+        graph_stats=graph_stats,
+    )

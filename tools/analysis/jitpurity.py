@@ -1,0 +1,219 @@
+"""Pass 3 — L014 jit-purity.
+
+A function handed to ``instrumented_jit`` / ``jax.jit`` (or used as a
+``lax.while_loop`` / ``lax.scan`` / ``lax.fori_loop`` body) executes its
+Python exactly ONCE, at trace time. Host side effects inside it —
+telemetry counters, log lines, wall-clock reads, file I/O, module-global
+mutation — appear to work on the first call and then silently never run
+again; the two newest bug classes in the tree both started this way.
+
+This pass resolves every jit registration site through the call graph
+(including the repo's dominant idiom: a closure factory returning
+``instrumented_jit(run)`` where ``run`` calls shared solver machinery),
+walks the transitive callee closure of each traced function, and flags
+impure operations with the chain from the traced root.
+
+The detectors are deliberately NARROW (exact resolved names, module-level
+``logger`` convention, ``print``/``open``/``global``): a purity pass that
+cries wolf gets allowlisted into uselessness. Verifiably pure host-side
+helpers that only *construct* traced computations are fine — tracing
+double-executes nothing for them; the danger is effects the author
+expected to repeat per call.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.callgraph import FunctionInfo, PackageGraph
+from tools.analysis.core import Finding
+from tools.analysis.hotpath import short_chain
+
+#: Wrappers that register a traced function: positional arg 0 is traced.
+JIT_WRAPPERS = {
+    "jax.jit",
+    "photon_ml_tpu.telemetry.xla.instrumented_jit",
+}
+
+#: Control-flow primitives whose function-valued args are traced bodies.
+LOOP_WRAPPERS = {
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.scan": (0,),
+    "jax.lax.fori_loop": (2,),
+}
+
+#: Transform wrappers to look through when resolving the traced function:
+#: ``instrumented_jit(jax.vmap(solve_one, ...))`` traces ``solve_one``.
+TRANSPARENT_WRAPPERS = {"jax.vmap", "jax.pmap", "functools.partial"}
+
+#: Exact resolved call names that are impure inside a trace.
+WALL_CLOCK = {
+    "time.time",
+    "time.monotonic",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.process_time",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+TELEMETRY_SINKS = {
+    "photon_ml_tpu.telemetry.metrics.counter",
+    "photon_ml_tpu.telemetry.metrics.gauge",
+    "photon_ml_tpu.telemetry.metrics.histogram",
+    "photon_ml_tpu.telemetry.trace.add_event",
+    "photon_ml_tpu.telemetry.trace.span",
+    "photon_ml_tpu.telemetry.device.sync_fetch",
+}
+FILE_OPS = {
+    "os.remove",
+    "os.rename",
+    "os.replace",
+    "os.makedirs",
+    "os.unlink",
+    "os.rmdir",
+    "shutil.rmtree",
+    "shutil.copyfile",
+    "shutil.copytree",
+}
+_LOG_METHODS = {
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+}
+
+
+def impure_sites(fn: FunctionInfo) -> list[tuple[int, str]]:
+    """(lineno, description) for every impure operation in the body."""
+    out: list[tuple[int, str]] = []
+    for node in _own_nodes(fn.node):
+        if isinstance(node, ast.Global):
+            out.append(
+                (node.lineno, "mutates module global(s) "
+                              f"{', '.join(node.names)}")
+            )
+    for resolved, call in fn.calls:
+        f = call.func
+        if resolved in WALL_CLOCK:
+            out.append((call.lineno, f"reads the wall clock ({resolved})"))
+        elif resolved in TELEMETRY_SINKS:
+            out.append(
+                (call.lineno,
+                 f"records telemetry ({resolved.rsplit('.', 1)[-1]})")
+            )
+        elif resolved in FILE_OPS:
+            out.append((call.lineno, f"filesystem side effect ({resolved})"))
+        elif isinstance(f, ast.Name) and f.id == "open":
+            out.append((call.lineno, "opens a file"))
+        elif isinstance(f, ast.Name) and f.id == "print":
+            out.append((call.lineno, "prints to stdout"))
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr in _LOG_METHODS
+            and isinstance(f.value, ast.Name)
+            and (f.value.id in ("logging",) or "log" in f.value.id.lower())
+        ):
+            out.append((call.lineno, f"logs via {f.value.id}.{f.attr}()"))
+    return out
+
+
+def _own_nodes(fn_node: ast.AST):
+    stack = list(fn_node.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _unwrap(graph: PackageGraph, fn: FunctionInfo, expr: ast.AST) -> ast.AST:
+    """Look through vmap/partial wrappers to the traced function expr."""
+    while isinstance(expr, ast.Call):
+        resolved = graph._resolve_func_expr(fn, expr.func)
+        name = None
+        if isinstance(expr.func, ast.Name):
+            name = expr.func.id
+        elif isinstance(expr.func, ast.Attribute):
+            name = expr.func.attr
+        if resolved in TRANSPARENT_WRAPPERS or name in ("vmap", "partial"):
+            if not expr.args:
+                break
+            expr = expr.args[0]
+            continue
+        break
+    return expr
+
+
+def trace_roots(graph: PackageGraph) -> list[tuple[str, str, int, str]]:
+    """(traced function qname, registration file, line, wrapper name) for
+    every jit/loop registration site resolvable through the graph."""
+    roots: list[tuple[str, str, int, str]] = []
+    for fn in graph.functions.values():
+        for resolved, call in fn.calls:
+            if resolved in JIT_WRAPPERS and call.args:
+                arg_specs = [(0, resolved.rsplit(".", 1)[-1])]
+            elif resolved in LOOP_WRAPPERS:
+                short = resolved.rsplit(".", 1)[-1]
+                arg_specs = [
+                    (i, f"lax.{short}") for i in LOOP_WRAPPERS[resolved]
+                ]
+            else:
+                continue
+            for idx, wrapper in arg_specs:
+                if idx >= len(call.args):
+                    continue
+                expr = _unwrap(graph, fn, call.args[idx])
+                target = graph.resolve_call_target(
+                    graph._resolve_func_expr(fn, expr)
+                )
+                if target is not None:
+                    roots.append((target, fn.rel, call.lineno, wrapper))
+        # decorator forms: @jax.jit / @instrumented_jit(name=...) /
+        # @functools.partial(jax.jit, ...)
+        for dec in getattr(fn.node, "decorator_list", []):
+            expr = dec.func if isinstance(dec, ast.Call) else dec
+            resolved = graph._resolve_func_expr(fn, expr)
+            if resolved in JIT_WRAPPERS:
+                roots.append(
+                    (fn.qname, fn.rel, dec.lineno,
+                     resolved.rsplit(".", 1)[-1])
+                )
+            elif (
+                isinstance(dec, ast.Call)
+                and resolved == "functools.partial"
+                and dec.args
+                and graph._resolve_func_expr(fn, dec.args[0]) in JIT_WRAPPERS
+            ):
+                roots.append((fn.qname, fn.rel, dec.lineno, "partial(jit)"))
+    return roots
+
+
+def run(graph: PackageGraph) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple[str, int, str]] = set()
+    for root, reg_rel, reg_line, wrapper in trace_roots(graph):
+        reach = graph.reachable([root])
+        for qname in sorted(reach):
+            fn = graph.functions[qname]
+            for lineno, desc in impure_sites(fn):
+                key = (fn.rel, lineno, desc)
+                if key in seen:
+                    continue  # one report per site, first traced root wins
+                seen.add(key)
+                chain = short_chain(graph.chain_to(reach, qname))
+                findings.append(
+                    Finding(
+                        path=fn.rel,
+                        line=lineno,
+                        code="L014",
+                        message=(
+                            f"{desc} inside jit-traced code — this runs "
+                            f"ONCE at trace time and silently never "
+                            f"again (traced via {wrapper} at "
+                            f"{reg_rel}:{reg_line}); hoist the effect to "
+                            f"the host side of the jit boundary"
+                        ),
+                        chain=chain,
+                    )
+                )
+    return findings

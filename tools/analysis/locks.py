@@ -1,0 +1,225 @@
+"""Pass 4 — L015 lock discipline.
+
+``serving/`` and ``telemetry/progress.py`` run real daemon threads now.
+For every class that spawns one (``threading.Thread(target=self._x)``),
+this pass finds instance attributes written BOTH from the thread target's
+call closure and from the public API, and requires every such write to
+sit under a ``with self._lock:`` / ``with self._cv:`` block. An attribute
+written from two threads without a lock is exactly the shared-state race
+the GIL papers over until it doesn't (read-modify-write interleavings,
+torn multi-field invariants).
+
+Scope decisions, deliberately:
+
+- ``__init__`` writes are exempt — construction happens-before the thread
+  exists.
+- Attributes written only from public methods (e.g. ``self._thread`` in
+  ``start``/``stop``) or only from the thread side are not flagged; the
+  pass targets the cross-thread pairs.
+- A "lock" context manager is any ``with self.<attr>:`` whose attribute
+  name contains lock/cv/cond/mutex — the repo convention (``_lock``,
+  ``_cv``). Methods called WHILE holding a lock are not modeled (no
+  interprocedural lock state): a write must be lexically inside the
+  ``with`` block. That is the repo's existing style and keeps the pass
+  exact; a justified exception takes a ``# photon: noqa[L015]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from tools.analysis.callgraph import ClassInfo, PackageGraph
+from tools.analysis.core import Finding
+
+_LOCKISH = ("lock", "cv", "cond", "mutex")
+
+#: Dunder methods that are public API surface (context-manager protocol).
+_PUBLIC_DUNDERS = {"__enter__", "__exit__", "__call__", "__iter__",
+                   "__next__"}
+
+
+@dataclasses.dataclass
+class _Write:
+    attr: str
+    lineno: int
+    locked: bool
+    method: str  # method qname the write lives in
+
+
+def _is_lock_cm(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and any(k in expr.attr.lower() for k in _LOCKISH)
+    )
+
+
+def _flatten_targets(target: ast.AST):
+    """Unpack tuple/list/starred assignment targets:
+    ``self._a, self._b = ...`` writes BOTH attributes."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _flatten_targets(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _flatten_targets(target.value)
+    else:
+        yield target
+
+
+def _self_attr_of_target(target: ast.AST) -> Optional[str]:
+    """`self._x = ...` / `self._x[k] = ...` / `self._x += ...` -> `_x`."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if (
+        isinstance(target, ast.Attribute)
+        and isinstance(target.value, ast.Name)
+        and target.value.id == "self"
+    ):
+        return target.attr
+    return None
+
+
+def attr_writes(fn_node: ast.AST, method_qname: str) -> list[_Write]:
+    """Every ``self.<attr>`` write in the method body with its lock
+    context (lexically enclosing ``with self._lock/_cv:`` blocks)."""
+    out: list[_Write] = []
+
+    def rec(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = locked or any(
+                _is_lock_cm(item.context_expr) for item in node.items
+            )
+            for child in node.body:
+                rec(child, inner)
+            return
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return  # nested defs are their own graph nodes
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for leaf in _flatten_targets(t):
+                    attr = _self_attr_of_target(leaf)
+                    if attr is not None:
+                        out.append(
+                            _Write(attr, leaf.lineno, locked, method_qname)
+                        )
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            attr = _self_attr_of_target(node.target)
+            if attr is not None:
+                out.append(
+                    _Write(attr, node.target.lineno, locked, method_qname)
+                )
+        for child in ast.iter_child_nodes(node):
+            rec(child, locked)
+
+    for stmt in fn_node.body:
+        rec(stmt, False)
+    return out
+
+
+def thread_targets(graph: PackageGraph, cls: ClassInfo) -> list[str]:
+    """Method qnames this class hands to ``threading.Thread(target=...)``."""
+    out = []
+    for mname, mq in cls.methods.items():
+        fn = graph.functions[mq]
+        for resolved, call in fn.calls:
+            is_thread = resolved == "threading.Thread" or (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "Thread"
+            ) or (
+                isinstance(call.func, ast.Name)
+                and call.func.id == "Thread"
+            )
+            if not is_thread:
+                continue
+            for kw in call.keywords:
+                if kw.arg != "target":
+                    continue
+                v = kw.value
+                if (
+                    isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self"
+                    and v.attr in cls.methods
+                ):
+                    out.append(cls.methods[v.attr])
+    return out
+
+
+def _class_closure(
+    graph: PackageGraph, cls: ClassInfo, entries: list[str]
+) -> set[str]:
+    """Methods (and their nested defs) reachable from ``entries`` through
+    self-calls, restricted to this class's own functions."""
+    own = set()
+    for mq in cls.methods.values():
+        own.add(mq)
+        stack = [mq]
+        while stack:
+            q = stack.pop()
+            for child in graph.functions[q].nested:
+                if child not in own:
+                    own.add(child)
+                    stack.append(child)
+    reach = graph.reachable(entries)
+    return {q for q in reach if q in own}
+
+
+def run(graph: PackageGraph) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls in graph.classes.values():
+        entries = thread_targets(graph, cls)
+        if not entries:
+            continue
+        init_q = cls.methods.get("__init__")
+        thread_side = _class_closure(graph, cls, entries)
+        public_entries = [
+            mq
+            for mname, mq in cls.methods.items()
+            if (not mname.startswith("_") or mname in _PUBLIC_DUNDERS)
+        ]
+        public_side = _class_closure(graph, cls, public_entries)
+
+        writes: dict[str, list[_Write]] = {}
+        for mq in sorted(thread_side | public_side):
+            if mq == init_q:
+                continue  # construction happens-before the thread
+            fn = graph.functions[mq]
+            for w in attr_writes(fn.node, mq):
+                writes.setdefault(w.attr, []).append(w)
+
+        for attr in sorted(writes):
+            sites = writes[attr]
+            t_sites = [w for w in sites if w.method in thread_side]
+            p_sites = [w for w in sites if w.method in public_side]
+            if not t_sites or not p_sites:
+                continue  # single-sided: not a cross-thread attribute
+            unlocked = [w for w in sites if not w.locked]
+            if not unlocked:
+                continue
+            first = min(unlocked, key=lambda w: w.lineno)
+            lines = ", ".join(
+                str(w.lineno) for w in sorted(unlocked, key=lambda w: w.lineno)
+            )
+            t_m = graph.functions[t_sites[0].method].name
+            p_m = graph.functions[p_sites[0].method].name
+            findings.append(
+                Finding(
+                    path=cls.rel,
+                    line=first.lineno,
+                    code="L015",
+                    message=(
+                        f"attribute `self.{attr}` of {cls.name} is "
+                        f"written from the thread target path "
+                        f"(`{t_m}`) and the public API (`{p_m}`) with "
+                        f"unlocked write(s) at line(s) {lines} — guard "
+                        f"every shared write with `with self._lock:` / "
+                        f"`with self._cv:`"
+                    ),
+                )
+            )
+    return findings

@@ -5,474 +5,55 @@ Reference analog: the scalastyle + Apache RAT gates of the reference build
 (scalastyle-config.xml, build-scripts/rat.gradle) — a zero-setup check that
 every source file parses and passes lint before code lands.
 
-Runs, in order:
-  1. syntax: compile every .py under photon_ml_tpu/ tests/ tools/ (py_compile)
-  2. stdlib AST lint (dependency-free, so the gate works in hermetic
-     images with no linters installed):
-       - unused imports (module scope)
-       - bare `except:` clauses
-       - mutable default arguments (list/dict/set literals)
-       - `== None` / `!= None` comparisons
-       - f-strings with no placeholders
-       - library-only (photon_ml_tpu/) fake-timing rules from PERF_NOTES.md:
-         `time.time()` (wall-clock steps corrupt durations — use
-         time.monotonic()/utils.timing.Timer) and bare
-         `block_until_ready()` statements (a NO-OP sync through the
-         tunnel — use telemetry.sync_fetch, the accounted fetch point)
-       - library-only non-atomic persistence (L008): `np.savez*` /
-         `json.dump`-to-final-path writes outside the blessed atomic
-         writers (utils/atomic.py and the model/checkpoint stores built on
-         it) — a crash mid-write must never leave a truncated file a later
-         load half-reads
-       - library-only bare `print()` (L009): stdout belongs to drivers;
-         library code routes output through loggers/telemetry so fits are
-         greppable and machine-readable. CLI modules (photon_ml_tpu/cli/)
-         are exempt — stdout IS their interface.
-       - serving hot-path device->host syncs (L010): `jax.device_get`,
-         `np.asarray(...)`, and `float(...)`-on-non-constants inside the
-         serving hot-path modules (photon_ml_tpu/serving/{engine,batcher}.py)
-         — every request would pay a full tunnel round trip per call; the
-         one sanctioned crossing is telemetry.sync_fetch.
-       - bare `jax.jit` in hot-path library modules (L011: parallel/,
-         game/, ops/, training.py, serving/engine.py) — jits must go
-         through telemetry.xla.instrumented_jit so compiles land in the
-         executable registry with cost analysis and recompile
-         attribution; cold paths opt out via L011_COLD_ALLOWLIST.
-       - sharding discipline (L012: parallel/, the game/ mesh modules,
-         serving/): `jax.device_put` calls must pass an explicit
-         Sharding/device (a bare put lands on the default device and
-         silently replicates at the next jit boundary), and `pmap` is
-         rejected outright — GSPMD via NamedSharding + jit is the one
-         parallelism API (parallel/sharding.py).
-  3. ruff + mypy, IF installed (configs live in pyproject.toml)
+The analysis itself lives in the tools/analysis package (see its module
+docstrings for the pass-by-pass story):
 
-Exit code 0 = clean. Any finding prints `path:line: code message` and the
-run exits 1.
+  1. single parse of every .py under photon_ml_tpu/ tests/ tools/ bench*.py
+     (syntax errors are findings of that one parse — no separate
+     py_compile phase)
+  2. per-file stdlib AST lint, rules L001-L012 (tools/analysis/local.py)
+  3. whole-package interprocedural passes over the import-resolved call
+     graph (tools/analysis/callgraph.py):
+       L013  hot-path propagation — the L010/L011 path lists are seeds;
+             syncs/bare jits reachable from ScoringEngine.score_rows or
+             the solver loops are flagged WITH the call chain
+       L014  jit-purity — functions traced by instrumented_jit/jax.jit/
+             lax.while_loop/lax.scan must not touch host state (telemetry,
+             logs, wall clock, files, module globals): trace-time effects
+             run once and silently never again
+       L015  lock discipline — thread-spawning classes (MicroBatcher,
+             ModelRegistry, Heartbeat) must guard attributes written from
+             both the thread target and public methods with
+             `with self._lock/_cv:`
+  4. ruff + mypy, IF installed (configs live in pyproject.toml)
+
+Inline suppression: `# photon: noqa[L013]` on the reported line (stale
+suppressions are themselves findings, W001). `--baseline accepted.json`
+grandfathers existing findings so only NEW ones fail CI;
+`--write-baseline` emits that file. `--json` prints the machine-readable
+findings document (the schema tests/test_static_gate.py pins).
+
+Exit code 0 = clean (no new findings). Otherwise every finding prints as
+`path:line: code message [via call -> chain]` and the run exits 1.
 """
 
 from __future__ import annotations
 
-import ast
+import argparse
+import json
 import os
 import shutil
 import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-TARGETS = ("photon_ml_tpu", "tests", "tools", "__graft_entry__.py")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.analysis import core, driver  # noqa: E402 (path bootstrap above)
 
 
-def source_files() -> list[str]:
-    import glob as _glob
-
-    # every bench script is gated (a literal list silently missed new ones)
-    out = sorted(_glob.glob(os.path.join(REPO, "bench*.py")))
-    for t in TARGETS:
-        path = os.path.join(REPO, t)
-        if os.path.isfile(path):
-            out.append(path)
-            continue
-        for root, _dirs, files in os.walk(path):
-            out.extend(
-                os.path.join(root, f) for f in files if f.endswith(".py")
-            )
-    return sorted(out)
-
-
-def check_syntax(files: list[str]) -> list[str]:
-    errs = []
-    for f in files:
-        with open(f, encoding="utf-8") as fh:
-            try:
-                compile(fh.read(), f, "exec")
-            except SyntaxError as e:
-                errs.append(f"{f}:{e.lineno}: SYNTAX {e.msg}")
-    return errs
-
-
-# Files allowed to call np.savez/json.dump directly: the atomic-write
-# primitives and the persistence layers built immediately on top of them.
-L008_BLESSED = {
-    os.path.join("photon_ml_tpu", "utils", "atomic.py"),
-    os.path.join("photon_ml_tpu", "data", "model_store.py"),
-    os.path.join("photon_ml_tpu", "game", "checkpoint.py"),
-}
-
-# Serving hot-path modules: every score request flows through these, so a
-# stray device->host sync (jax.device_get, float() on an array, np.asarray
-# on a jax array) costs the full tunnel round trip PER REQUEST. The one
-# sanctioned crossing is telemetry.sync_fetch (device.py accounts it).
-L010_HOT_PATH = {
-    os.path.join("photon_ml_tpu", "serving", "engine.py"),
-    os.path.join("photon_ml_tpu", "serving", "batcher.py"),
-}
-
-# Hot-path library modules where every jit-compiled program must go
-# through telemetry.xla.instrumented_jit (L011): a bare jax.jit hides its
-# compile time, cost analysis, and recompile attribution from the
-# executable registry — exactly the blind spot that made BENCH_r05
-# unexplainable. Cold paths (one-off summaries, diagnostics) may stay on
-# bare jax.jit via the allowlist.
-L011_HOT_DIRS = (
-    os.path.join("photon_ml_tpu", "parallel") + os.sep,
-    os.path.join("photon_ml_tpu", "game") + os.sep,
-    os.path.join("photon_ml_tpu", "ops") + os.sep,
-)
-L011_HOT_FILES = {
-    os.path.join("photon_ml_tpu", "serving", "engine.py"),
-    "photon_ml_tpu/training.py".replace("/", os.sep),
-}
-L011_COLD_ALLOWLIST = {
-    # gather_to_host: a once-per-summary replicating identity, not a
-    # training/serving hot path
-    os.path.join("photon_ml_tpu", "parallel", "multihost.py"),
-}
-
-# Sharding-discipline modules (L012): in these hot paths every
-# `jax.device_put` must name an explicit placement (a Sharding/
-# NamedSharding/device second argument or device=/... keyword) — a bare
-# `device_put(x)` lands on the default device and is then silently
-# replicated/resharded at the next jit boundary, exactly the bug class
-# the GSPMD scale-out removed. Bare `pmap` is rejected outright (the
-# legacy per-device API; use NamedSharding + jit, parallel/sharding.py).
-L012_HOT_DIRS = (
-    os.path.join("photon_ml_tpu", "parallel") + os.sep,
-)
-L012_HOT_FILES = {
-    os.path.join("photon_ml_tpu", "game", "coordinates.py"),
-    os.path.join("photon_ml_tpu", "game", "streaming.py"),
-    os.path.join("photon_ml_tpu", "game", "factored.py"),
-    os.path.join("photon_ml_tpu", "serving", "engine.py"),
-    os.path.join("photon_ml_tpu", "serving", "registry.py"),
-}
-
-
-class _Lint(ast.NodeVisitor):
-    def __init__(self, path: str, tree: ast.Module, library: bool = False):
-        self.path = path
-        # library code (photon_ml_tpu/) additionally gets the fake-timing
-        # rules L006/L007; benches and tests may time however they like
-        self.library = library
-        self._l008_exempt = path in L008_BLESSED
-        self._l010_hot = path in L010_HOT_PATH
-        self._l011_hot = (
-            path in L011_HOT_FILES or path.startswith(L011_HOT_DIRS)
-        ) and path not in L011_COLD_ALLOWLIST
-        self._l012_hot = (
-            path in L012_HOT_FILES or path.startswith(L012_HOT_DIRS)
-        )
-        # CLI modules own stdout: bare print() is their user interface
-        self._l009_exempt = path.startswith(
-            os.path.join("photon_ml_tpu", "cli") + os.sep
-        )
-        self.findings: list[str] = []
-        self.imported: dict[str, int] = {}  # name -> lineno (module scope)
-        self.used: set[str] = set()
-        # names bound to the wall clock by `from time import time [as x]`
-        self._time_aliases: set[str] = set()
-        # names bound to the jit transform by `from jax import jit [as x]`
-        self._jit_aliases: set[str] = set()
-        self._collect(tree)
-
-    def _report(self, node: ast.AST, code: str, msg: str) -> None:
-        self.findings.append(f"{self.path}:{node.lineno}: {code} {msg}")
-
-    def _collect(self, tree: ast.Module) -> None:
-        for node in tree.body:  # module scope only: re-export surfaces stay
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    name = (a.asname or a.name).split(".")[0]
-                    self.imported[name] = node.lineno
-            elif isinstance(node, ast.ImportFrom):
-                if node.module == "__future__" or any(
-                    a.name == "*" for a in node.names
-                ):
-                    continue
-                for a in node.names:
-                    self.imported[a.asname or a.name] = node.lineno
-                    if node.module == "time" and a.name == "time":
-                        self._time_aliases.add(a.asname or a.name)
-                    if node.module == "jax" and a.name == "jit":
-                        self._jit_aliases.add(a.asname or a.name)
-        self.visit(tree)
-
-    def visit_Name(self, node: ast.Name) -> None:
-        self.used.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        root = node
-        while isinstance(root, ast.Attribute):
-            root = root.value
-        if isinstance(root, ast.Name):
-            self.used.add(root.id)
-        self.generic_visit(node)
-
-    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
-        if node.type is None:
-            self._report(node, "L002", "bare `except:` (catch something)")
-        self.generic_visit(node)
-
-    def _check_defaults(self, node) -> None:
-        for d in list(node.args.defaults) + [
-            d for d in node.args.kw_defaults if d is not None
-        ]:
-            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                self._report(
-                    d, "L003", "mutable default argument (use None sentinel)"
-                )
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self._check_defaults(node)
-        if self._l011_hot:
-            # `@jax.jit` decorators without a call are Attribute/Name
-            # nodes, invisible to visit_Call
-            for dec in node.decorator_list:
-                if not isinstance(dec, ast.Call) and self._is_bare_jit(dec):
-                    self._report_l011(dec)
-        self.generic_visit(node)
-
-    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    def visit_Compare(self, node: ast.Compare) -> None:
-        for op, comp in zip(node.ops, node.comparators):
-            if isinstance(op, (ast.Eq, ast.NotEq)) and (
-                isinstance(comp, ast.Constant) and comp.value is None
-            ):
-                self._report(node, "L004", "use `is None` / `is not None`")
-        self.generic_visit(node)
-
-    def _is_wall_clock_call(self, node: ast.Call) -> bool:
-        # `time.time()` or a bare `time()` bound by `from time import time`
-        f = node.func
-        if (
-            isinstance(f, ast.Attribute)
-            and f.attr == "time"
-            and isinstance(f.value, ast.Name)
-            and f.value.id == "time"
-        ):
-            return True
-        return isinstance(f, ast.Name) and f.id in self._time_aliases
-
-    def _is_non_atomic_persist_call(self, node: ast.Call) -> bool:
-        # `<anything>.savez(...)` / `<anything>.savez_compressed(...)` and
-        # `json.dump(...)` (json.dumps returns a string and is fine)
-        f = node.func
-        if isinstance(f, ast.Attribute) and f.attr in (
-            "savez", "savez_compressed",
-        ):
-            return True
-        return (
-            isinstance(f, ast.Attribute)
-            and f.attr == "dump"
-            and isinstance(f.value, ast.Name)
-            and f.value.id == "json"
-        )
-
-    def _is_bare_jit(self, node: ast.AST) -> bool:
-        # `jax.jit(...)` / `@jax.jit` / from-imported `jit(...)`
-        f = node.func if isinstance(node, ast.Call) else node
-        if (
-            isinstance(f, ast.Attribute)
-            and f.attr == "jit"
-            and isinstance(f.value, ast.Name)
-            and f.value.id == "jax"
-        ):
-            return True
-        return isinstance(f, ast.Name) and f.id in self._jit_aliases
-
-    def _report_l011(self, node: ast.AST) -> None:
-        self._report(
-            node,
-            "L011",
-            "bare jax.jit in a hot-path library module — compiles escape "
-            "the executable registry (no cost analysis, no recompile "
-            "attribution); use telemetry.xla.instrumented_jit(fn, "
-            "name=...), or add a cold path to L011_COLD_ALLOWLIST",
-        )
-
-    def _is_serving_sync_call(self, node: ast.Call) -> bool:
-        # device->host crossings in serving hot paths: `jax.device_get`
-        # (any spelling), `np.asarray`/`numpy.asarray` (a jax-array arg
-        # forces a fetch), and `float(x)` on anything but a literal
-        f = node.func
-        if isinstance(f, ast.Attribute) and f.attr == "device_get":
-            return True
-        if isinstance(f, ast.Name) and f.id == "device_get":
-            return True
-        if (
-            isinstance(f, ast.Attribute)
-            and f.attr == "asarray"
-            and isinstance(f.value, ast.Name)
-            and f.value.id in ("np", "numpy")
-        ):
-            return True
-        return (
-            isinstance(f, ast.Name)
-            and f.id == "float"
-            and not all(isinstance(a, ast.Constant) for a in node.args)
-        )
-
-    def _check_l012(self, node: ast.Call) -> None:
-        f = node.func
-        attr = f.attr if isinstance(f, ast.Attribute) else (
-            f.id if isinstance(f, ast.Name) else None
-        )
-        if attr == "pmap":
-            self._report(
-                node,
-                "L012",
-                "bare pmap in a sharding-discipline module — the legacy "
-                "per-device API replicates state and bypasses GSPMD; use "
-                "NamedSharding + jit (parallel/sharding.py)",
-            )
-        if attr == "device_put":
-            explicit = len(node.args) >= 2 or any(
-                k.arg in ("device", "sharding")
-                for k in node.keywords
-                if k.arg is not None
-            )
-            if not explicit:
-                self._report(
-                    node,
-                    "L012",
-                    "jax.device_put without an explicit Sharding — an "
-                    "unsharded upload lands on the default device and "
-                    "silently replicates/reshards at the next jit "
-                    "boundary; pass a NamedSharding (parallel/sharding.py "
-                    "placement helpers)",
-                )
-
-    def visit_Call(self, node: ast.Call) -> None:
-        if self._l012_hot:
-            self._check_l012(node)
-        if self.library and self._is_wall_clock_call(node):
-            self._report(
-                node,
-                "L006",
-                "time.time() in library code — wall-clock steps corrupt "
-                "phase durations; use time.monotonic() / utils.timing.Timer",
-            )
-        if (
-            self.library
-            and not self._l008_exempt
-            and self._is_non_atomic_persist_call(node)
-        ):
-            self._report(
-                node,
-                "L008",
-                "non-atomic persistence (np.savez/json.dump to a final "
-                "path) in library code — a crash mid-write leaves a "
-                "truncated file; route through utils.atomic / the "
-                "model_store//checkpoint writers",
-            )
-        if self._l011_hot and self._is_bare_jit(node):
-            self._report_l011(node)
-        if self._l010_hot and self._is_serving_sync_call(node):
-            self._report(
-                node,
-                "L010",
-                "device->host sync in a serving hot-path module — every "
-                "request pays the tunnel round trip; fetch results through "
-                "telemetry.sync_fetch only",
-            )
-        if (
-            self.library
-            and not self._l009_exempt
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            self._report(
-                node,
-                "L009",
-                "bare print() in library code — stdout belongs to CLI "
-                "drivers; route output through logging or telemetry",
-            )
-        self.generic_visit(node)
-
-    def visit_Expr(self, node: ast.Expr) -> None:
-        # a bare `x.block_until_ready()` / `jax.block_until_ready(x)` /
-        # from-imported `block_until_ready(x)` STATEMENT is a timing sync —
-        # which is a no-op through the tunnel (PERF_NOTES.md); uses whose
-        # result feeds real code are fine
-        call = node.value
-        if (
-            self.library
-            and isinstance(call, ast.Call)
-            and (
-                (
-                    isinstance(call.func, ast.Attribute)
-                    and call.func.attr == "block_until_ready"
-                )
-                or (
-                    isinstance(call.func, ast.Name)
-                    and call.func.id == "block_until_ready"
-                )
-            )
-        ):
-            self._report(
-                node,
-                "L007",
-                "bare block_until_ready() for timing is a no-op sync on the "
-                "tunnel TPU; fetch via telemetry.sync_fetch instead",
-            )
-        self.generic_visit(node)
-
-    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
-        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
-            self._report(node, "L005", "f-string without placeholders")
-        self.generic_visit(node)
-
-    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
-        # format specs parse as nested JoinedStrs of constants (e.g. ':.3g');
-        # visiting them would false-positive L005 on every formatted field
-        self.visit(node.value)
-
-    def unused_imports(self, tree: ast.Module) -> None:
-        exported = set()
-        for node in tree.body:
-            if (
-                isinstance(node, ast.Assign)
-                and any(
-                    isinstance(t, ast.Name) and t.id == "__all__"
-                    for t in node.targets
-                )
-                and isinstance(node.value, (ast.List, ast.Tuple))
-            ):
-                exported |= {
-                    e.value
-                    for e in node.value.elts
-                    if isinstance(e, ast.Constant)
-                }
-        for name, lineno in sorted(self.imported.items(), key=lambda kv: kv[1]):
-            if name not in self.used and name not in exported:
-                self.findings.append(
-                    f"{self.path}:{lineno}: L001 unused import `{name}`"
-                )
-
-
-def check_lint(files: list[str]) -> list[str]:
-    findings = []
-    for f in files:
-        if os.path.basename(f) == "__init__.py":
-            continue  # re-export surfaces import without using
-        with open(f, encoding="utf-8") as fh:
-            try:
-                tree = ast.parse(fh.read(), filename=f)
-            except SyntaxError:
-                continue  # reported by the syntax phase
-        rel = os.path.relpath(f, REPO)
-        lint = _Lint(
-            rel, tree, library=rel.startswith("photon_ml_tpu" + os.sep)
-        )
-        lint.unused_imports(tree)
-        findings.extend(lint.findings)
-    return findings
-
-
-def run_external() -> list[str]:
+def run_external(quiet: bool) -> list[core.Finding]:
     errs = []
     for tool, args in (
         ("ruff", ["check", "photon_ml_tpu", "tests", "tools"]),
@@ -480,28 +61,113 @@ def run_external() -> list[str]:
     ):
         exe = shutil.which(tool)
         if exe is None:
-            print(f"  - {tool}: not installed, skipped (stdlib gate still ran)")
+            if not quiet:
+                print(
+                    f"  - {tool}: not installed, skipped "
+                    f"(stdlib gate still ran)"
+                )
             continue
         proc = subprocess.run(
             [exe, *args], cwd=REPO, capture_output=True, text=True
         )
         if proc.returncode != 0:
-            errs.append(f"{tool} failed:\n{proc.stdout}\n{proc.stderr}")
-        else:
+            errs.append(
+                core.Finding(
+                    path=tool,
+                    line=0,
+                    code="EXT",
+                    message=f"{tool} failed:\n{proc.stdout}\n{proc.stderr}",
+                )
+            )
+        elif not quiet:
             print(f"  - {tool}: clean")
     return errs
 
 
-def main() -> int:
-    files = source_files()
-    print(f"checking {len(files)} files")
-    findings = check_syntax(files)
-    findings += check_lint(files)
-    print("external tools:")
-    findings += run_external()
-    if findings:
-        print("\n".join(findings))
-        print(f"\n{len(findings)} finding(s)")
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="print the findings document as JSON (stdout carries ONLY "
+        "the JSON)",
+    )
+    ap.add_argument(
+        "--baseline",
+        metavar="PATH",
+        help="accepted-findings JSON: matching findings are grandfathered "
+        "and only NEW findings fail the gate",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        metavar="PATH",
+        help="write the current findings as a baseline file and exit 0",
+    )
+    ap.add_argument(
+        "--root",
+        default=REPO,
+        help="tree to analyze (default: this repo; tests point it at "
+        "fixture trees)",
+    )
+    ap.add_argument(
+        "--no-external",
+        action="store_true",
+        help="skip ruff/mypy even when installed",
+    )
+    args = ap.parse_args(argv)
+
+    baseline = None
+    if args.baseline:
+        baseline = core.load_baseline(args.baseline)
+
+    root = os.path.abspath(args.root)
+    # fixture trees are not this repo: their seed classes are whatever the
+    # test planted, so the missing-seed config check (W002) stays repo-only
+    result = driver.analyze(
+        root, baseline=baseline, require_seeds=(root == REPO)
+    )
+
+    if args.write_baseline:
+        # include currently-grandfathered findings: refreshing a baseline
+        # with --baseline also on the command line must not silently drop
+        # every previously-accepted entry
+        accepted = result.findings + result.grandfathered
+        doc = {
+            "version": 1,
+            "findings": [f.to_json() for f in accepted],
+        }
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+        print(
+            f"wrote {len(accepted)} finding(s) to {args.write_baseline}"
+        )
+        return 0
+
+    if not args.json:
+        print(f"checking {len(result.files)} files")
+
+    external: list[core.Finding] = []
+    if not args.no_external and root == REPO:
+        if not args.json:
+            print("external tools:")
+        external = run_external(quiet=args.json)
+    result.findings.extend(external)
+
+    if args.json:
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.render())
+    if result.grandfathered:
+        print(
+            f"({len(result.grandfathered)} baselined finding(s) "
+            f"grandfathered)"
+        )
+    for key in result.stale_baseline:
+        print(f"note: stale baseline entry (fixed — delete it): {key}")
+    if result.findings:
+        print(f"\n{len(result.findings)} finding(s)")
         return 1
     print("clean")
     return 0
