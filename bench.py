@@ -234,12 +234,16 @@ from bench_suite import SUITE_METRICS as _SUITE_METRICS
 #: still emits one valid truncated line PER metric it would have printed.
 #: bench_suite's names come from its own module — one source of truth.
 from bench_multichip import MULTICHIP_METRICS as _MULTICHIP_METRICS
+from bench_overlap import OVERLAP_METRICS as _OVERLAP_METRICS
+from bench_sweep import SWEEP_METRICS as _SWEEP_METRICS
 
 _SCRIPT_METRICS = {
     "bench_suite.py": _SUITE_METRICS,
     "bench_game.py": ("glmix_fe_re_logistic_1Mx100Kusers_coeffs_per_sec",),
     "bench_scale.py": ("game_1B_coeffs_trained_per_sec",),
     "bench_multichip.py": _MULTICHIP_METRICS,
+    "bench_sweep.py": _SWEEP_METRICS,
+    "bench_overlap.py": _OVERLAP_METRICS,
     "bench_ingest.py": ("avro_ingest_rows_per_sec",),
     "bench_serving.py": ("serving_p50_ms", "serving_p99_ms",
                          "serving_rows_per_sec"),
@@ -262,7 +266,8 @@ def run_sub_benchmarks(deadline=None):
     # north-star (20M-row full pipeline) runs last and longest; the
     # driver's BASELINE numbers come from the earlier lines either way
     for script in ("bench_suite.py", "bench_game.py", "bench_scale.py",
-                   "bench_multichip.py", "bench_ingest.py",
+                   "bench_multichip.py", "bench_sweep.py",
+                   "bench_overlap.py", "bench_ingest.py",
                    "bench_serving.py", "bench_northstar.py"):
         path = os.path.join(here, script)
         expected = _SCRIPT_METRICS.get(script, (script.replace(".py", ""),))

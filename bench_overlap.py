@@ -1,11 +1,17 @@
-"""Host->device streaming overlap measurement (not part of bench.py's
-driver chain — run manually; results recorded in PERF_NOTES.md).
+"""Host->device streaming overlap measurement, wired into the bench.py /
+bench_suite.py driver chain (``bench_suite --overlap``) so the streaming-
+overlap number gets a per-round trajectory instead of living only in
+PERF_NOTES.md.
 
 Streams HOST numpy chunks through StreamingRandomEffectTrainer twice:
 with the one-chunk-ahead enqueue (prefetch=True: chunk i+1's H2D transfer
 overlaps chunk i's solve through JAX async dispatch) and fully
 synchronous (prefetch=False: block_until_ready between chunks). Reports
-both wall-clocks and the overlap factor.
+both wall-clocks and the overlap factor as the ``overlap_factor`` metric.
+
+Budget: ``PHOTON_BENCH_BUDGET_S`` is honored — a run starting past the
+deadline emits a valid ``{"metric": "overlap_factor", "truncated": true}``
+line instead of silence.
 
 Caveat (PERF_NOTES "Round 4: 1B"): on this rig the TPU sits behind a
 ~4 MB/s tunnel, so transfer dominates absurdly and the overlap factor is
@@ -23,8 +29,18 @@ import time
 
 import numpy as np
 
+OVERLAP_METRICS = ("overlap_factor",)
 
-def main():
+
+def run_overlap(deadline=None) -> dict[str, float | None]:
+    """Measure the prefetch-vs-sync overlap factor; emits one JSON line.
+    Returns ``{metric: value-or-None}`` for the ``--gate`` flow."""
+    from bench_suite import truncated_line
+
+    if deadline is not None and time.monotonic() > deadline:
+        print(truncated_line("overlap_factor"), flush=True)
+        return {"overlap_factor": None}
+
     from photon_ml_tpu.game.streaming import (
         ShardedCoefficientTable,
         StreamingRandomEffectTrainer,
@@ -91,7 +107,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "streaming_overlap_factor",
+                "metric": "overlap_factor",
                 "value": round(factor, 3),
                 "unit": "x",
                 "vs_baseline": None,
@@ -106,8 +122,16 @@ def main():
                     "platform": jax.devices()[0].platform,
                 },
             }
-        )
+        ),
+        flush=True,
     )
+    return {"overlap_factor": round(factor, 3)}
+
+
+def main():
+    from bench_suite import budget_deadline
+
+    run_overlap(deadline=budget_deadline())
 
 
 if __name__ == "__main__":

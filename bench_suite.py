@@ -44,6 +44,10 @@ SUITE_METRICS = (
     "poisson_offsets_box_1Mx10K_rows_per_sec_per_chip",
 )
 
+#: Gate metrics where a RISE is the regression (wall-time ratios); all
+#: other gated metrics are rates where a drop regresses.
+LOWER_IS_BETTER_METRICS = frozenset({"sweep_over_single_ratio"})
+
 
 #: Safety margin reserved BEFORE the PHOTON_BENCH_BUDGET_S wall so the
 #: process can kill a running sub-benchmark, flush truncated placeholder
@@ -316,7 +320,12 @@ def run_gate(
     from photon_ml_tpu.telemetry.report import compare_metrics
 
     current = {k: v for k, v in results.items() if v is not None}
-    directions = {name: +1 for name in set(current) | set(baseline)}
+    # rows/s-style metrics regress when they DROP; ratio-of-walltime
+    # metrics (the sweep bench) regress when they RISE
+    directions = {
+        name: (-1 if name in LOWER_IS_BETTER_METRICS else +1)
+        for name in set(current) | set(baseline)
+    }
     deltas = compare_metrics(
         current, baseline, threshold=threshold, directions=directions
     )
@@ -382,6 +391,21 @@ def main(argv=None) -> int:
         "efficiency) and include its metrics in the gate; baselines that "
         "predate the multichip_* metrics skip them with a note",
     )
+    parser.add_argument(
+        "--sweep",
+        action="store_true",
+        help="also run bench_sweep.py (16-config λ-sweep wall time as a "
+        "multiple of single-fit wall time) and include "
+        "sweep_over_single_ratio in the gate; baselines that predate it "
+        "skip with a note",
+    )
+    parser.add_argument(
+        "--overlap",
+        action="store_true",
+        help="also run bench_overlap.py (streaming prefetch overlap "
+        "factor) and include overlap_factor in the gate; baselines that "
+        "predate it skip with a note",
+    )
     args = parser.parse_args(argv)
     deadline = budget_deadline()
     results = run_suite(deadline=deadline)
@@ -389,6 +413,14 @@ def main(argv=None) -> int:
         from bench_multichip import run_multichip
 
         results.update(run_multichip(deadline=deadline))
+    if args.sweep:
+        from bench_sweep import run_sweep_bench
+
+        results.update(run_sweep_bench(deadline=deadline))
+    if args.overlap:
+        from bench_overlap import run_overlap
+
+        results.update(run_overlap(deadline=deadline))
     if args.gate:
         return run_gate(
             results, load_gate_baseline(args.gate), args.gate_threshold

@@ -1,0 +1,172 @@
+"""16-config λ-sweep wall time vs single-fit wall time on the headline
+GLM config (ISSUE 8 acceptance: ``sweep_over_single_ratio`` < 3x on TPU).
+
+Measures, on the headline problem shape (logistic 1M x 10K, tiled
+layout, LBFGS fixed-work):
+
+  1. one single fit (the bench.py headline recipe), and
+  2. one 16-point vmapped λ sweep through sweep.runner.sweep_glm
+     (warm_start=False, rounds=1: identical per-lane work to 16
+     independent fits — the ratio measures pure batching efficiency),
+
+and reports ``sweep_over_single_ratio`` = sweep_s / single_s. A value of
+16 means the config axis bought nothing; the MXU target is < 3. Also
+emits ``sweep_parity_max_rel_err``: the max relative loss difference of
+3 probed lanes vs true independent single fits (the correctness side of
+the acceptance, cheap enough to ride the bench).
+
+On non-TPU backends the problem shrinks (vmapped pallas-interpret at
+1M x 10K x 16 is not a benchmark) and the line carries
+``"simulated": true`` — the <3x target is only meaningful on TPU.
+
+Budget: ``PHOTON_BENCH_BUDGET_S`` honored; skipped phases emit valid
+``"truncated": true`` lines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+
+SWEEP_METRICS = ("sweep_over_single_ratio",)
+
+N_CONFIGS = 16
+
+
+def _problem(n_rows, n_features, nnz_per_row):
+    rng = np.random.default_rng(0)
+    nnz = n_rows * nnz_per_row
+    rows = np.repeat(np.arange(n_rows, dtype=np.int64), nnz_per_row)
+    cols = rng.integers(0, n_features, size=nnz)
+    values = rng.normal(size=nnz)
+    w_true = rng.normal(size=n_features) * 0.5
+    margins = np.zeros(n_rows)
+    np.add.at(margins, rows, values * w_true[cols])
+    y = (rng.random(n_rows) < 1.0 / (1.0 + np.exp(-margins))).astype(
+        np.float64
+    )
+    return values, rows, cols, y
+
+
+def run_sweep_bench(deadline=None) -> dict[str, float | None]:
+    from bench_suite import truncated_line
+
+    if deadline is not None and time.monotonic() > deadline:
+        print(truncated_line("sweep_over_single_ratio"), flush=True)
+        return {"sweep_over_single_ratio": None}
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.ops.tiled import TiledBatch
+    from photon_ml_tpu.optim import (
+        OptimizerConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_ml_tpu.optim.factory import solve
+    from photon_ml_tpu.sweep.runner import sweep_glm
+
+    telemetry.configure_from_env()
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        n_rows, n_features, nnz_per_row, max_iters = 1_000_000, 10_000, 20, 20
+    else:
+        # CPU smoke shape: same code path, honest "simulated" marker
+        n_rows, n_features, nnz_per_row, max_iters = 50_000, 1_000, 10, 10
+
+    values, rows, cols, y = _problem(n_rows, n_features, nnz_per_row)
+    batch = TiledBatch.from_coo(
+        values=values, rows=rows, cols=cols, labels=y,
+        num_features=n_features,
+    ) if on_tpu else None
+    if batch is None:
+        from photon_ml_tpu.ops.sparse import SparseBatch
+
+        batch = SparseBatch.from_coo(
+            values=values, rows=rows, cols=cols, labels=y,
+            num_features=n_features,
+        ).device()
+    cfg = OptimizerConfig(
+        max_iterations=max_iters,
+        tolerance=0.0,  # fixed work: every lane runs max_iters
+        regularization=RegularizationContext(RegularizationType.L2),
+    )
+    lams = tuple(float(v) for v in np.logspace(2, -4, N_CONFIGS))
+    w0 = jnp.zeros((n_features,), jnp.float32)
+
+    # --- single fit (headline recipe: warm with different args, then time)
+    single_cfg = dataclasses.replace(cfg, regularization_weight=lams[0])
+
+    def single_run(w, b):
+        return solve("logistic", b, single_cfg, w)
+
+    single_jit = telemetry.instrumented_jit(single_run, name="bench_single")
+    float(single_jit(w0 + 1e-3, batch).value)  # warmup
+    t0 = time.perf_counter()
+    res = single_jit(w0, batch)
+    float(telemetry.sync_fetch(res.value, label="single"))
+    single_s = time.perf_counter() - t0
+
+    if deadline is not None and time.monotonic() > deadline:
+        print(truncated_line("sweep_over_single_ratio"), flush=True)
+        return {"sweep_over_single_ratio": None}
+
+    # --- 16-config vmapped sweep (cold lanes = same work as 16 fits)
+    sweep_glm(batch, "logistic", lams, cfg, warm_start=False)  # warmup
+    t0 = time.perf_counter()
+    sres = sweep_glm(batch, "logistic", lams, cfg, warm_start=False)
+    float(telemetry.sync_fetch(sres.values[-1], label="sweep"))
+    sweep_s = time.perf_counter() - t0
+    ratio = sweep_s / max(single_s, 1e-9)
+
+    # --- parity probe: 3 lanes vs true independent fits
+    probes = (0, N_CONFIGS // 2, N_CONFIGS - 1)
+    max_rel = 0.0
+    sweep_vals = np.asarray(sres.values)
+    for g in probes:
+        ind = solve(
+            "logistic", batch,
+            dataclasses.replace(cfg, regularization_weight=lams[g]), w0,
+        )
+        iv = float(telemetry.sync_fetch(ind.value, label="parity"))
+        max_rel = max(max_rel, abs(sweep_vals[g] - iv) / max(abs(iv), 1e-12))
+
+    print(
+        json.dumps(
+            {
+                "metric": "sweep_over_single_ratio",
+                "value": round(ratio, 3),
+                "unit": "x",
+                "vs_baseline": None,
+                "detail": {
+                    "configs": N_CONFIGS,
+                    "single_fit_s": round(single_s, 3),
+                    "sweep_s": round(sweep_s, 3),
+                    "rows": n_rows,
+                    "features": n_features,
+                    "max_iterations": max_iters,
+                    "sweep_parity_max_rel_err": float(max_rel),
+                    "per_config_iterations": sres.iterations.tolist(),
+                    "platform": jax.devices()[0].platform,
+                    "simulated": not on_tpu,
+                },
+            }
+        ),
+        flush=True,
+    )
+    return {"sweep_over_single_ratio": round(ratio, 3)}
+
+
+def main():
+    from bench_suite import budget_deadline
+
+    run_sweep_bench(deadline=budget_deadline())
+
+
+if __name__ == "__main__":
+    main()

@@ -342,6 +342,26 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
         telemetry.configure(trace_out=trace_out)
     checkpoint_spec = _parse_checkpoint_spec(config)
     guard = _parse_guard_spec(config)
+    if config.get("sweep"):
+        # the vmapped sweep path has no checkpoint/resume or mesh support
+        # yet; accepting the keys and silently not honoring them is worse
+        # than refusing (a "checkpointed" sweep would also swallow the
+        # scheduler's SIGTERM via GracefulStop and then save NOTHING).
+        # Guard config is inert in sweep mode (on-by-default, so it
+        # cannot be an explicit request) — divergent lanes surface
+        # through per-config convergence reasons instead.
+        if checkpoint_spec is not None:
+            raise ValueError(
+                "checkpointing is not supported with a sweep yet — drop "
+                'the "checkpoint" config (sweeps are one batched solve '
+                "per coordinate, not a resumable step sequence)"
+            )
+        if config.get("mesh"):
+            raise ValueError(
+                "mesh training is not supported with a GAME sweep yet — "
+                'drop the "mesh" config / --mesh flag (plain-GLM sweeps '
+                "can shard the config axis via sweep.sweep_glm(mesh=...))"
+            )
     stop = GracefulStop()
     if checkpoint_spec is not None:
         # without a checkpoint there is nothing durable to write on SIGTERM;
@@ -370,6 +390,45 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     try:
         if heartbeat is not None:
             heartbeat.start()
+        if config.get("sweep"):
+            # multi-λ sweep + best-model selection INSTEAD of a single
+            # fit: the winner lands under <output_dir>/best (and in the
+            # sweep registry_dir, if configured) — cli/sweep.py
+            from photon_ml_tpu.cli.sweep import run_sweep_fit
+
+            with timed("sweep"):
+                sweep_summary = run_sweep_fit(
+                    estimator,
+                    config["sweep"],
+                    train_data,
+                    validation_data,
+                    index_maps,
+                    output_dir,
+                )
+            summary = {
+                "sweep": sweep_summary,
+                "best_metric": sweep_summary["selected_metric"],
+                "output_dir": output_dir,
+                "num_rows": train_data.num_rows,
+            }
+            if output_dir is not None and index_maps is not None:
+                import os
+
+                with timed("save index maps"):
+                    for shard, imap in index_maps.items():
+                        imap.save(
+                            os.path.join(
+                                output_dir, "best", "feature-indexes", shard
+                            )
+                        )
+            if telemetry_out:
+                summary["telemetry"] = telemetry.flush_metrics(telemetry_out)
+            if trace_out:
+                telemetry.export_chrome_trace(
+                    trace_out, telemetry.perfetto_path(trace_out)
+                )
+            _maybe_write_report(config, summary, trace_out, telemetry_out)
+            return summary
         with timed("fit"):
             result = estimator.fit(
                 train_data,
@@ -486,6 +545,28 @@ def main(argv=None) -> int:
         "(overrides config mesh)",
     )
     parser.add_argument(
+        "--sweep",
+        action="append",
+        help="train a multi-λ sweep instead of a single fit: grid tokens "
+        "like 'lambda=1e-4:1e2:log16' or 'lambda.perUser=0.1,1,10' "
+        "(repeatable; needs a validation input; config key sweep.grid)",
+    )
+    parser.add_argument(
+        "--sweep-metric",
+        help="validation metric the sweep selects on (default: the "
+        "task's ModelSelection metric; config sweep.metric)",
+    )
+    parser.add_argument(
+        "--sweep-policy",
+        choices=("best", "parsimonious"),
+        help="sweep selection policy (config sweep.policy)",
+    )
+    parser.add_argument(
+        "--sweep-registry-dir",
+        help="publish the sweep winner here via publish_version for live "
+        "ModelRegistry hot-swap (config sweep.registry_dir)",
+    )
+    parser.add_argument(
         "--checkpoint-dir",
         help="persist coordinate-descent state here after each "
         "(iteration, coordinate) step; SIGTERM/SIGINT then writes a final "
@@ -512,6 +593,25 @@ def main(argv=None) -> int:
         config = json.load(f)
     if args.mesh:
         config["mesh"] = parse_mesh_flag(args.mesh)
+    if (
+        args.sweep or args.sweep_metric or args.sweep_policy
+        or args.sweep_registry_dir
+    ):
+        from photon_ml_tpu.cli.sweep import merge_sweep_flags
+
+        sweep_cfg = merge_sweep_flags(
+            config,
+            grid=args.sweep,
+            metric=args.sweep_metric,
+            policy=args.sweep_policy,
+            registry_dir=args.sweep_registry_dir,
+        )
+        if not sweep_cfg or not sweep_cfg.get("grid"):
+            parser.error(
+                "--sweep-metric/--sweep-policy/--sweep-registry-dir need a "
+                "grid: pass --sweep lambda=... (or config sweep.grid)"
+            )
+        config["sweep"] = sweep_cfg
     if args.trace_out:
         config["trace_out"] = args.trace_out
     if args.telemetry_out:

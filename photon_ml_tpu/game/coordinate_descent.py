@@ -55,19 +55,28 @@ class CoordinateDescentResult:
     history: list[dict]  # per (iteration, coordinate) telemetry
 
 
+def padded_validation_arrays(
+    data: GameDataset, n_pad: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(labels, weights, offsets) as [n_pad] f32 device arrays with
+    weight-0 padding rows — the evaluator input layout. Shared by the CD
+    validation path below and the sweep selector (sweep/select.py), so
+    both score against identical padded arrays."""
+
+    def pad(a, fill=0.0):
+        out = np.full((n_pad,), fill)
+        out[: data.num_rows] = a
+        return jnp.asarray(out, jnp.float32)
+
+    return pad(data.response), pad(data.weight), pad(data.offset)
+
+
 def _evaluate(model: GameModel, spec: ValidationSpec) -> dict[str, float]:
     scores = model.score(spec.data)
     n = spec.data.num_rows
     n_pad = scores.shape[0]
-
-    def pad(a, fill=0.0):
-        out = np.full((n_pad,), fill)
-        out[:n] = a
-        return jnp.asarray(out, jnp.float32)
-
-    labels = pad(spec.data.response)
-    weights = pad(spec.data.weight)  # padded rows weight 0
-    full_scores = scores + pad(spec.data.offset)
+    labels, weights, offsets = padded_validation_arrays(spec.data, n_pad)
+    full_scores = scores + offsets
 
     out = {}
     for spec_str in spec.evaluators:

@@ -145,6 +145,17 @@ class GameFitResult:
 
 
 @dataclasses.dataclass
+class SweepFitResult:
+    """A finished vmapped λ sweep: the selection, the winning model, and
+    the full per-config record (sweep.runner.GameSweepResult)."""
+
+    model: GameModel  # the selected winner
+    selection: "SweepSelection"
+    sweep: "GameSweepResult"
+    published_version: Optional[str] = None  # registry path when exported
+
+
+@dataclasses.dataclass
 class GridFitEntry:
     """One combination of a fit_grid sweep: the per-coordinate optimizer
     configs used and the resulting fit (the reference's (config, model,
@@ -491,6 +502,81 @@ class GameEstimator:
                 extra_metadata=meta,
             )
         return fit
+
+    def fit_sweep(
+        self,
+        data: GameDataset,
+        validation_data: GameDataset,
+        grid: "SweepGrid",
+        metric: Optional[str] = None,
+        policy: str = "best",
+        rel_tol: float = 0.01,
+        num_iterations: Optional[int] = None,
+        warm_start: bool = True,
+        output_dir: Optional[str] = None,
+        registry_dir: Optional[str] = None,
+        index_maps: Optional[Mapping] = None,
+    ) -> SweepFitResult:
+        """Train EVERY λ of ``grid`` simultaneously and ship the best.
+
+        The vmapped multi-config path (sweep.runner.sweep_game): one
+        batched executable per coordinate update covers all G configs,
+        unconverged lanes warm-start from their more-regularized
+        neighbor, every lane is scored on device against
+        ``validation_data``, and the winner is selected by ``metric``
+        (default: the task's ModelSelection metric) under ``policy``.
+
+        With ``output_dir`` the winner is saved under ``<output_dir>/best``
+        (the training driver's best/ layout); with ``registry_dir`` (+
+        ``index_maps`` pinning the feature space) it is published through
+        ``serving.registry.publish_version`` for live hot-swap.
+        """
+        from photon_ml_tpu.sweep.runner import sweep_game
+        from photon_ml_tpu.sweep.select import export_winner, run_selection
+
+        result = sweep_game(
+            self.config,
+            data,
+            grid,
+            num_iterations=num_iterations,
+            warm_start=warm_start,
+        )
+        selection = run_selection(
+            result, validation_data, metric=metric, policy=policy,
+            rel_tol=rel_tol,
+        )
+        model = result.model_for(selection.index)
+        meta = {
+            "config": _config_metadata(self.config),
+            "sweep_grid": grid.to_json(),
+        }
+        if output_dir is not None:
+            from photon_ml_tpu.data.model_store import save_game_model
+
+            save_game_model(
+                model,
+                os.path.join(output_dir, "best"),
+                extra_metadata={**meta,
+                                "sweep_selection": selection.to_json()},
+            )
+        published = None
+        if registry_dir is not None:
+            if not index_maps:
+                raise ValueError(
+                    "publishing a sweep winner to a registry requires "
+                    "index_maps (the registry refuses versions without a "
+                    "pinned feature space)"
+                )
+            published = export_winner(
+                model, index_maps, registry_dir,
+                selection=selection, extra_metadata=meta,
+            )
+        return SweepFitResult(
+            model=model,
+            selection=selection,
+            sweep=result,
+            published_version=published,
+        )
 
     def fit_grid(
         self,

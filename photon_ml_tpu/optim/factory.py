@@ -147,6 +147,25 @@ class OptimizerConfig:
                 )
 
 
+def split_reg_weights(
+    reg: RegularizationContext, weights
+) -> tuple[jax.Array, jax.Array]:
+    """Vectorized (l2, l1) split of a λ GRID: the per-scalar
+    ``RegularizationContext.l1_weight``/``l2_weight`` arithmetic applied to
+    a whole [G] array at once, always returning [G] arrays (NONE-type
+    regularization broadcasts its 0.0 so the sweep solvers' config axis
+    keeps a uniform shape)."""
+    lams = jnp.asarray(weights, jnp.float32)
+    return (
+        jnp.broadcast_to(
+            jnp.asarray(reg.l2_weight(lams), jnp.float32), lams.shape
+        ),
+        jnp.broadcast_to(
+            jnp.asarray(reg.l1_weight(lams), jnp.float32), lams.shape
+        ),
+    )
+
+
 def build_objective(
     loss_name: str,
     config: OptimizerConfig,

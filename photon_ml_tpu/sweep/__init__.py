@@ -1,0 +1,37 @@
+"""On-device hyperparameter sweeps: vmapped multi-λ training, warm-started
+regularization paths, and best-model selection (ROADMAP item 5).
+
+- :mod:`photon_ml_tpu.sweep.grid` — the ``lambda=1e-4:1e2:log16`` spec
+  grammar with per-coordinate overrides, descending path order, and typed
+  parse errors.
+- :mod:`photon_ml_tpu.sweep.runner` — G configs batched into single
+  ``instrumented_jit`` executables (the config axis composes with the
+  per-entity vmap lane on random-effect buckets), with unconverged lanes
+  warm-started from their more-regularized neighbor.
+- :mod:`photon_ml_tpu.sweep.select` — one vmapped evaluator pass over all
+  lanes, NaN-safe selection policies, and ``publish_version`` export of
+  the winner into the serving registry.
+"""
+
+from photon_ml_tpu.sweep.grid import (  # noqa: F401
+    SweepGrid,
+    SweepSpecError,
+    parse_sweep_spec,
+)
+from photon_ml_tpu.sweep.runner import (  # noqa: F401
+    GameSweepResult,
+    GlmSweepResult,
+    SweepUnsupportedError,
+    path_warm_start,
+    sweep_game,
+    sweep_glm,
+)
+from photon_ml_tpu.sweep.select import (  # noqa: F401
+    SweepSelection,
+    SweepSelectionError,
+    default_metric,
+    evaluate_sweep,
+    export_winner,
+    run_selection,
+    select_best,
+)

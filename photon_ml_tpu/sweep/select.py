@@ -1,0 +1,230 @@
+"""Best-model selection over a finished sweep + export to the serving
+registry.
+
+Reference analog: photon-client ModelSelection (AUC for classifiers, RMSE
+for linear regression, Poisson loss for Poisson) and the GameEstimator's
+evaluator-ranked (config, model, evaluation) output. Here every config
+lane is scored ON DEVICE in one vmapped evaluator call — a [G, n]
+score matrix in, a [G] metric vector out, ONE host fetch for the whole
+sweep — then a host-side selection policy picks the winner and
+:func:`export_winner` publishes it through ``serving.registry
+.publish_version`` in the exact layout a live ``ModelRegistry``
+hot-swaps from.
+
+Degenerate-metric discipline (the silent-argmax-over-NaNs hazard): lanes
+whose metric is NaN (all-NaN validation columns, empty effective splits)
+are EXCLUDED from selection with a warning + ``sweep.nan_configs``
+counter; if every lane is NaN, selection raises a typed
+:class:`SweepSelectionError` instead of exporting garbage. Single-class
+AUC degrades to the evaluators' documented 0.5 fallback and stays
+selectable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.evaluation.evaluators import EVALUATORS, better_than
+from photon_ml_tpu.game.coordinate_descent import padded_validation_arrays
+from photon_ml_tpu.game.dataset import GameDataset
+from photon_ml_tpu.telemetry.xla import instrumented_jit
+
+logger = logging.getLogger("photon_ml_tpu.sweep")
+
+__all__ = [
+    "SweepSelectionError",
+    "SweepSelection",
+    "default_metric",
+    "evaluate_sweep",
+    "select_best",
+    "run_selection",
+    "export_winner",
+]
+
+
+class SweepSelectionError(ValueError):
+    """No config lane produced a usable validation metric (or the metric
+    spec itself is unusable for sweeps); the message names the metric and
+    the lane count so the failure is diagnosable from the log alone."""
+
+
+@dataclasses.dataclass
+class SweepSelection:
+    """The outcome of scoring + selecting over G config lanes."""
+
+    index: int  # winning lane (lanes ordered by descending λ)
+    metric: str
+    metrics: np.ndarray  # f64[G]; NaN = lane excluded
+    policy: str
+
+    @property
+    def best_value(self) -> float:
+        return float(self.metrics[self.index])
+
+    def to_json(self) -> dict:
+        return {
+            "index": self.index,
+            "metric": self.metric,
+            "policy": self.policy,
+            "best_value": self.best_value,
+            "values": [
+                None if np.isnan(v) else float(v) for v in self.metrics
+            ],
+        }
+
+
+def default_metric(task: str) -> str:
+    """ModelSelection.scala parity: AUC for binary classifiers, RMSE for
+    linear regression, data log-likelihood (poisson loss) for Poisson."""
+    from photon_ml_tpu.ops.losses import get_loss
+
+    task = get_loss(task).name
+    if task in ("logistic", "smoothed_hinge"):
+        return "auc"
+    if task == "squared":
+        return "rmse"
+    return "poisson_loss"
+
+
+@lru_cache(maxsize=16)
+def _sweep_evaluator(metric: str):
+    fn = EVALUATORS[metric]
+
+    def run(scores, labels, weights):
+        return jax.vmap(fn, in_axes=(0, None, None))(scores, labels, weights)
+
+    return instrumented_jit(run, name=f"sweep_eval_{metric}", multi_shape=True)
+
+
+def evaluate_sweep(
+    result, validation_data: GameDataset, metric: Optional[str] = None
+) -> tuple[str, np.ndarray]:
+    """Score EVERY config lane against the validation split on device.
+
+    ``result`` is a :class:`~photon_ml_tpu.sweep.runner.GameSweepResult`.
+    Returns ``(metric_name, values[G])`` — the [G, n] score matrix, the
+    vmapped evaluator, and the single host fetch are the whole round
+    trip. Sharded (grouped) evaluator specs are not vmappable over the
+    config axis and raise :class:`SweepSelectionError` naming the spec.
+    """
+    metric = metric or default_metric(result.task)
+    if metric not in EVALUATORS:
+        raise SweepSelectionError(
+            f"metric '{metric}' is not sweep-scorable (sharded/grouped "
+            f"evaluators need per-group state); pick one of "
+            f"{sorted(EVALUATORS)}"
+        )
+    scores = result.validation_scores(validation_data)  # [G, n_pad]
+    labels, weights, offsets = padded_validation_arrays(
+        validation_data, scores.shape[1]
+    )
+    values = _sweep_evaluator(metric)(
+        scores + offsets[None, :], labels, weights
+    )
+    fetched = np.asarray(
+        telemetry.sync_fetch(values, label=f"sweep_eval:{metric}"),
+        dtype=np.float64,
+    )
+    return metric, fetched
+
+
+def select_best(
+    metrics: np.ndarray,
+    metric_name: str,
+    policy: str = "best",
+    rel_tol: float = 0.01,
+) -> int:
+    """Pick the winning lane index from per-lane metric values.
+
+    Policies (lanes are ordered by DESCENDING λ, so lower index = more
+    regularized):
+
+    - ``"best"``: the best metric value; ties break toward the lower
+      index (the more regularized, simpler model).
+    - ``"parsimonious"``: the LOWEST-index lane within ``rel_tol``
+      (relative) of the best value — the one-stderr-rule analog that
+      prefers stronger regularization when the metric is flat.
+
+    NaN lanes are excluded (``sweep.nan_configs`` counter + warning);
+    all-NaN raises :class:`SweepSelectionError`.
+    """
+    metrics = np.asarray(metrics, np.float64)
+    valid = np.isfinite(metrics)
+    n_bad = int(np.sum(~valid))
+    if n_bad:
+        telemetry.counter("sweep.nan_configs").inc(n_bad)
+        logger.warning(
+            "sweep: %d of %d configs produced non-finite '%s' metrics; "
+            "excluded from selection",
+            n_bad, len(metrics), metric_name,
+        )
+    if not valid.any():
+        raise SweepSelectionError(
+            f"all {len(metrics)} sweep configs produced non-finite "
+            f"'{metric_name}' validation metrics — nothing to select "
+            "(check the validation split for empty/NaN columns)"
+        )
+    maximize = better_than(metric_name, 1.0, 0.0)
+    masked = np.where(valid, metrics, -np.inf if maximize else np.inf)
+    best_value = masked.max() if maximize else masked.min()
+    if policy == "best":
+        # np.argmax/argmin return the FIRST best index = most regularized
+        return int(masked.argmax() if maximize else masked.argmin())
+    if policy == "parsimonious":
+        span = abs(best_value) * rel_tol
+        ok = valid & (
+            (metrics >= best_value - span)
+            if maximize
+            else (metrics <= best_value + span)
+        )
+        return int(np.nonzero(ok)[0][0])
+    raise SweepSelectionError(
+        f"unknown selection policy '{policy}' (best|parsimonious)"
+    )
+
+
+def run_selection(
+    result,
+    validation_data: GameDataset,
+    metric: Optional[str] = None,
+    policy: str = "best",
+    rel_tol: float = 0.01,
+) -> SweepSelection:
+    """evaluate_sweep + select_best + per-config telemetry spans."""
+    metric_name, values = evaluate_sweep(result, validation_data, metric)
+    index = select_best(values, metric_name, policy=policy, rel_tol=rel_tol)
+    telemetry.gauge("sweep.selected_index").set(index)
+    telemetry.gauge("sweep.selected_metric").set(float(values[index]))
+    result.emit_config_spans(metrics=values, metric_name=metric_name)
+    return SweepSelection(
+        index=index, metric=metric_name, metrics=values, policy=policy
+    )
+
+
+def export_winner(
+    model,
+    index_maps,
+    registry_dir: str,
+    selection: Optional[SweepSelection] = None,
+    extra_metadata: Optional[dict] = None,
+) -> str:
+    """Publish the winning model as the next registry version — the exact
+    ``publish_version`` layout ``serving/registry.py`` hot-swaps from
+    (feature indexes first, metadata last, atomic rename). Returns the
+    published version path."""
+    from photon_ml_tpu.serving.registry import publish_version
+
+    meta = dict(extra_metadata or {})
+    if selection is not None:
+        meta["sweep_selection"] = selection.to_json()
+    path = publish_version(registry_dir, model, index_maps,
+                           extra_metadata=meta)
+    telemetry.counter("sweep.published_versions").inc()
+    return path

@@ -204,6 +204,14 @@ class Heartbeat:
             # devices (a balanced entity sharding keeps this near zero;
             # a lopsided one concentrates table bytes on few devices)
             line["hbm_device_spread_bytes"] = spread
+        sweep_total = metrics.gauge("sweep.configs_total").value
+        if sweep_total:
+            # mid-sweep liveness: how many of the G config lanes the
+            # batched executables have fully processed so far
+            line["sweep_configs_total"] = int(sweep_total)
+            line["sweep_configs_done"] = int(
+                metrics.gauge("sweep.configs_done").value or 0
+            )
         last_save = metrics.gauge("checkpoint.last_save_ts").value
         if last_save is not None:
             line["checkpoint_age_s"] = round(

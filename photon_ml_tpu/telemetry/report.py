@@ -403,6 +403,9 @@ class RunReport:
         dropped = counters.get("trace.dropped_spans")
         if dropped:
             out["dropped_spans"] = float(dropped)
+        sweep_metric = gauges.get("sweep.selected_metric")
+        if sweep_metric is not None:
+            out["sweep_selected_metric"] = float(sweep_metric)
         recompiles = counters.get("xla.recompiles")
         if recompiles:
             out["xla_recompiles"] = float(recompiles)
@@ -447,6 +450,106 @@ class RunReport:
             c["frozen"] = name in frozen
             c["consecutive_rollbacks"] = int(rollback_counts.get(name, 0))
         return sorted(agg.values(), key=lambda c: c["coordinate"])
+
+    def sweep_summary(self) -> Optional[dict[str, Any]]:
+        """Per-config convergence record of a hyperparameter sweep, from
+        the ``sweep_config`` spans the sweep runner emits (one per lane,
+        attrs: λs, iterations, convergence reason, final loss, validation
+        metric) plus the ``sweep.*`` counters/gauges. None when the run
+        swept nothing."""
+        configs = []
+        for s in self.spans:
+            if s.get("name") != "sweep_config":
+                continue
+            attrs = s.get("attrs") or {}
+            configs.append(
+                {
+                    "index": attrs.get("index"),
+                    "lambdas": {
+                        k: v
+                        for k, v in attrs.items()
+                        if k == "lambda" or k.startswith("lambda.")
+                    },
+                    "iterations": attrs.get("iterations"),
+                    "reason": attrs.get("reason"),
+                    "final_loss": attrs.get("final_loss"),
+                    "metric": attrs.get("metric"),
+                    "metric_name": attrs.get("metric_name"),
+                }
+            )
+        gauges = self.snapshot.get("gauges", {})
+        counters = self.snapshot.get("counters", {})
+        total = gauges.get("sweep.configs_total")
+        if not configs and not total:
+            return None
+        configs.sort(key=lambda c: (c["index"] is None, c["index"]))
+        out: dict[str, Any] = {"configs": configs}
+        if total is not None:
+            out["configs_total"] = int(total)
+            out["configs_done"] = int(gauges.get("sweep.configs_done") or 0)
+        if gauges.get("sweep.selected_index") is not None:
+            out["selected_index"] = int(gauges["sweep.selected_index"])
+            out["selected_metric"] = gauges.get("sweep.selected_metric")
+        for name in ("sweep.solves", "sweep.nan_configs",
+                     "sweep.published_versions"):
+            if name in counters:
+                out[name.split(".", 1)[1]] = counters[name]
+        return out
+
+    def _sweep_markdown(self) -> list[str]:
+        sweep = self.sweep_summary()
+        if sweep is None:
+            return []
+        out = ["## Hyperparameter sweep", ""]
+        if "configs_total" in sweep:
+            out.append(
+                f"- {sweep['configs_done']}/{sweep['configs_total']} "
+                "config(s) processed"
+            )
+        if "selected_index" in sweep:
+            out.append(
+                f"- selected config **#{sweep['selected_index']}** "
+                f"(metric {_fmt_or_unknown(sweep.get('selected_metric'))})"
+            )
+        if sweep.get("nan_configs"):
+            out.append(
+                f"- **{int(sweep['nan_configs'])} config(s) excluded** "
+                "(non-finite validation metric)"
+            )
+        configs = sweep["configs"]
+        if configs:
+            lam_keys: list[str] = []
+            for c in configs:
+                for k in c["lambdas"]:
+                    if k not in lam_keys:
+                        lam_keys.append(k)
+            metric_name = next(
+                (c["metric_name"] for c in configs if c.get("metric_name")),
+                "metric",
+            )
+            header = (
+                ["config"] + [f"`{k}`" for k in lam_keys]
+                + ["iterations", "reason", "final loss", str(metric_name)]
+            )
+            out += [
+                "",
+                "| " + " | ".join(header) + " |",
+                "|" + "---|" * len(header),
+            ]
+            for c in configs:
+                row = [str(c["index"])]
+                row += [
+                    _fmt_or_unknown(c["lambdas"].get(k)) for k in lam_keys
+                ]
+                row += [
+                    _fmt_or_unknown(c["iterations"]),
+                    str(c["reason"] or "?"),
+                    _fmt_or_unknown(c["final_loss"]),
+                    _fmt_or_unknown(c["metric"]),
+                ]
+                out.append("| " + " | ".join(row) + " |")
+        out.append("")
+        return out
 
     # -- device utilization (telemetry.xla) ----------------------------------
 
@@ -606,6 +709,7 @@ class RunReport:
             "phases": self.phase_tree().to_dict()["children"],
             "top_spans": self.top_spans(),
             "coordinates": self.coordinate_summary(),
+            "sweep": self.sweep_summary(),
             "device_utilization": self.device_utilization(),
             "counters": counters,
             "gauges": self.snapshot.get("gauges", {}),
@@ -672,6 +776,7 @@ class RunReport:
         lines += self._accounting_markdown()
         lines += self._memory_markdown()
         lines += self._coordinates_markdown()
+        lines += self._sweep_markdown()
         lines += self._heartbeat_markdown()
 
         dropped = self.snapshot.get("counters", {}).get("trace.dropped_spans")

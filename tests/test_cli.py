@@ -426,3 +426,223 @@ def test_train_parse_mesh_flag():
         parse_mesh_flag("batch=many")
     with pytest.raises(ValueError, match="no axes"):
         parse_mesh_flag(" , ")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: sweep-spec validation + the train --sweep path
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_flag_malformed_grids_are_typed_config_errors():
+    """Malformed --sweep grids raise SweepSpecError NAMING the offending
+    token — a typo must never silently train the default grid."""
+    from photon_ml_tpu.sweep.grid import SweepSpecError, parse_sweep_spec
+
+    for spec, fragment in (
+        ("lambda=", "lambda="),
+        ("lambda=10:1:log4", "inverted range"),
+        ("lambda=1:10:log0", "zero/negative point count"),
+        ("lambda=-0.5,1", "negative regularization"),
+        ("gamma=1", "unknown key"),
+    ):
+        with pytest.raises(SweepSpecError) as err:
+            parse_sweep_spec(spec)
+        assert fragment in str(err.value)
+        assert spec in str(err.value)  # the offending token, verbatim
+
+
+def test_parse_sweep_config_object_and_shorthand():
+    from photon_ml_tpu.cli.sweep import parse_sweep_config
+
+    parsed = parse_sweep_config("lambda=1,10")
+    assert parsed["grid"].default == (10.0, 1.0)
+    assert parsed["policy"] == "best"
+    parsed = parse_sweep_config(
+        {"grid": ["lambda=1:100:log3", "lambda.perUser=5"],
+         "metric": "rmse", "policy": "parsimonious", "rel_tol": 0.05}
+    )
+    assert parsed["grid"].size == 3
+    assert parsed["metric"] == "rmse"
+    assert parsed["rel_tol"] == 0.05
+    with pytest.raises(ValueError, match="unknown sweep config keys"):
+        parse_sweep_config({"grid": "lambda=1", "metrik": "auc"})
+    from photon_ml_tpu.sweep.grid import SweepSpecError
+
+    with pytest.raises(SweepSpecError, match="no lambda grid"):
+        parse_sweep_config({})
+    # the SweepGrid.to_json round-trip form is accepted back
+    parsed = parse_sweep_config({"grid": {"lambda": [1.0, 10.0]}})
+    assert parsed["grid"].default == (10.0, 1.0)
+
+
+def test_train_main_sweep_flags_require_grid(tmp_path):
+    from photon_ml_tpu.cli.train import main as train_main
+
+    cfg = tmp_path / "c.json"
+    cfg.write_text(json.dumps({"task": "logistic", "input": {},
+                               "coordinates": {}}))
+    with pytest.raises(SystemExit):
+        train_main(["--config", str(cfg), "--sweep-metric", "auc"])
+
+
+def test_sweep_without_validation_split_is_typed(tmp_path):
+    from photon_ml_tpu.cli.sweep import run_sweep_fit
+
+    with pytest.raises(ValueError, match="validation split"):
+        run_sweep_fit(None, {"grid": "lambda=1"}, None, None, None, None)
+
+
+@pytest.mark.slow
+def test_cli_train_sweep_end_to_end(avro_dataset):
+    """ISSUE 8: `cli train --sweep lambda=...` runs the vmapped sweep,
+    reports the per-config table, saves the winner under best/, and
+    publishes it into a registry a ModelRegistry can serve from."""
+    tmp, train_path, holdout_path = avro_dataset
+    config = {
+        "task": "logistic",
+        "input": {
+            "format": "avro",
+            "paths": [train_path],
+            "feature_shards": {"global": ["features"]},
+            "id_columns": ["userId"],
+        },
+        "validation": {"paths": [holdout_path]},
+        "coordinates": {
+            "fixed": {
+                "type": "fixed_effect",
+                "shard_name": "global",
+                "optimizer": {"regularization": "l2",
+                              "max_iterations": 30},
+            },
+            "perUser": {
+                "type": "random_effect",
+                "shard_name": "global",
+                "id_name": "userId",
+                "optimizer": {"regularization": "l2",
+                              "max_iterations": 30},
+            },
+        },
+        "num_iterations": 2,
+        "output_dir": str(tmp / "sweep_model"),
+    }
+    cfg_path = tmp / "train_sweep.json"
+    cfg_path.write_text(json.dumps(config))
+    registry_dir = tmp / "sweep_registry"
+
+    summary = _run_cli(
+        ["train", "--config", str(cfg_path),
+         "--sweep", "lambda=0.1:10:log4",
+         "--sweep-registry-dir", str(registry_dir)],
+        cwd=tmp,
+    )
+    sweep = summary["sweep"]
+    assert len(sweep["configs"]) == 4
+    assert sweep["metric"] == "auc"
+    assert 0 <= sweep["selected_index"] < 4
+    lams = [c["lambdas"]["fixed"] for c in sweep["configs"]]
+    assert lams == sorted(lams, reverse=True)  # descending path order
+    assert summary["best_metric"] == sweep["selected_metric"]
+    # winner + feature indexes on disk in the best/ layout
+    assert os.path.exists(tmp / "sweep_model" / "best" / "model-metadata.json")
+    assert os.path.isdir(
+        tmp / "sweep_model" / "best" / "feature-indexes" / "global"
+    )
+    # registry publish is complete and loadable
+    version_dir = sweep["published_version"]
+    assert os.path.basename(version_dir) == "v-00000001"
+    from photon_ml_tpu.serving import ModelRegistry
+
+    registry = ModelRegistry(str(registry_dir), warm=False,
+                             poll_interval=3600)
+    assert registry.refresh()
+    assert registry.current_version == "v-00000001"
+    registry.stop()
+
+
+@pytest.mark.slow
+def test_cli_sweep_subcommand(avro_dataset):
+    """`cli sweep` reruns selection over the same config/dataset without
+    the single-fit driver outputs."""
+    tmp, train_path, holdout_path = avro_dataset
+    config = {
+        "task": "logistic",
+        "input": {
+            "format": "avro",
+            "paths": [train_path],
+            "feature_shards": {"global": ["features"]},
+            "id_columns": ["userId"],
+        },
+        "validation": {"paths": [holdout_path]},
+        "coordinates": {
+            "fixed": {
+                "type": "fixed_effect",
+                "shard_name": "global",
+                "optimizer": {"regularization": "l2",
+                              "max_iterations": 20},
+            },
+        },
+        "num_iterations": 1,
+    }
+    cfg_path = tmp / "sweep_only.json"
+    cfg_path.write_text(json.dumps(config))
+    summary = _run_cli(
+        ["sweep", "--config", str(cfg_path),
+         "--sweep", "lambda=0.1,1,10",
+         "--sweep-policy", "parsimonious"],
+        cwd=tmp,
+    )
+    sweep = summary["sweep"]
+    assert sweep["policy"] == "parsimonious"
+    assert len(sweep["configs"]) == 3
+    assert all(c["metric"] is not None for c in sweep["configs"])
+
+
+def test_parse_sweep_config_mapping_form_is_validated():
+    """The JSON round-trip grid form goes through the same validators as
+    the string grammar — negative/NaN/empty lists must not sneak in."""
+    from photon_ml_tpu.cli.sweep import parse_sweep_config
+    from photon_ml_tpu.sweep.grid import SweepSpecError
+
+    with pytest.raises(SweepSpecError, match="negative"):
+        parse_sweep_config({"grid": {"lambda": [-1.0, 2.0]}})
+    with pytest.raises(SweepSpecError, match="empty grid"):
+        parse_sweep_config({"grid": {"lambda": []}})
+    with pytest.raises(SweepSpecError, match="not finite"):
+        parse_sweep_config({"grid": {"lambda.fixed": [float("nan")]}})
+    # valid values dedupe + sort descending like the string path
+    parsed = parse_sweep_config({"grid": {"lambda": [1.0, 10.0, 1.0]}})
+    assert parsed["grid"].default == (10.0, 1.0)
+
+
+def test_train_run_refuses_checkpoint_or_mesh_with_sweep(tmp_path):
+    """A checkpointed sweep would install GracefulStop (swallowing the
+    scheduler's SIGTERM) and then never save anything — refuse upfront."""
+    from photon_ml_tpu.cli.train import run
+
+    base = {
+        "task": "logistic",
+        "input": {"format": "libsvm", "paths": "unused"},
+        "coordinates": {"fixed": {"shard_name": "features"}},
+        "sweep": {"grid": "lambda=1"},
+    }
+    with pytest.raises(ValueError, match="checkpointing is not supported"):
+        run({**base, "checkpoint": {"dir": str(tmp_path / "ckpt")}})
+    with pytest.raises(ValueError, match="mesh training is not supported"):
+        run({**base, "mesh": {"batch": 2}})
+
+
+def test_merge_sweep_flags_shared_helper():
+    from photon_ml_tpu.cli.sweep import merge_sweep_flags
+
+    assert merge_sweep_flags({}) is None
+    merged = merge_sweep_flags(
+        {"sweep": "lambda=1"}, metric="rmse", registry_dir="r/"
+    )
+    assert merged == {"grid": "lambda=1", "metric": "rmse",
+                      "registry_dir": "r/"}
+    merged = merge_sweep_flags(
+        {"sweep": {"grid": "lambda=1", "policy": "best"}},
+        grid=["lambda=2"], policy="parsimonious",
+    )
+    assert merged["grid"] == ["lambda=2"]
+    assert merged["policy"] == "parsimonious"

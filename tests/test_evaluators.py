@@ -168,3 +168,73 @@ def test_sharded_auc_zero_weight_rows_inert(rng):
         jnp.asarray(s2), jnp.asarray(l2), jnp.asarray(w2),
         jnp.asarray(g2, jnp.int32), num_groups=2))
     assert np.isclose(base, padded, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8 satellite: degenerate inputs the sweep selector will hit.
+# Contract: every case either yields the evaluator's DOCUMENTED fallback
+# (tied scores -> mid-rank averaging; single-class -> 0.5; empty split ->
+# 0.5) or a non-finite value that sweep.select turns into a typed error /
+# lane exclusion — never a silent argmax over NaNs.
+# ---------------------------------------------------------------------------
+
+
+def test_auc_all_tied_scores_is_half():
+    """Every pair tied -> mid-rank averaging gives exactly 0.5."""
+    s = jnp.full((8,), 0.25)
+    labels = jnp.asarray([1, 0, 1, 0, 1, 0, 1, 0], jnp.float32)
+    assert float(auc(s, labels, jnp.ones(8))) == pytest.approx(0.5, abs=1e-7)
+
+
+def test_auc_tied_blocks_match_naive(rng):
+    """Heavily tied (3 distinct values) scores match the O(n^2) pair
+    count — the tie handling the selector relies on for coarse models."""
+    scores = rng.integers(0, 3, size=30).astype(float)
+    labels = (rng.random(30) > 0.5).astype(float)
+    w = rng.random(30) + 0.5
+    ours = float(auc(jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(w)))
+    assert np.isclose(ours, _naive_weighted_auc(scores, labels, w), atol=1e-6)
+
+
+def test_auc_empty_split_all_zero_weights_is_half():
+    """An all-padding (weight-0) validation split has no pair mass: the
+    documented fallback is 0.5, finite and selectable."""
+    s = jnp.asarray([0.1, 0.9])
+    labels = jnp.asarray([1.0, 0.0])
+    out = float(auc(s, labels, jnp.zeros(2)))
+    assert out == pytest.approx(0.5)
+
+
+def test_rmse_empty_split_is_finite_zero():
+    from photon_ml_tpu.evaluation import rmse as _rmse
+
+    out = float(_rmse(jnp.asarray([1.0, 2.0]), jnp.asarray([0.0, 0.0]),
+                      jnp.zeros(2)))
+    assert out == 0.0
+
+
+def test_nan_score_columns_propagate_to_nan_not_garbage():
+    """All-NaN score columns must surface as NaN metrics (which the sweep
+    selector excludes / errors on), never as a plausible finite value."""
+    from photon_ml_tpu.evaluation import logistic_loss
+
+    s = jnp.full((4,), jnp.nan)
+    labels = jnp.asarray([1.0, 0.0, 1.0, 0.0])
+    w = jnp.ones(4)
+    assert np.isnan(float(rmse(s, labels, w)))
+    assert np.isnan(float(logistic_loss(s, labels, w)))
+
+
+def test_selector_raises_on_all_nan_metric_column():
+    """End-to-end: NaN evaluator outputs become a typed selection error,
+    not a silent argmax (ISSUE 8 satellite acceptance)."""
+    from photon_ml_tpu.sweep.select import SweepSelectionError, select_best
+
+    with pytest.raises(SweepSelectionError, match="non-finite"):
+        select_best(np.asarray([np.nan, np.nan, np.nan]), "rmse")
+
+
+def test_selector_excludes_partial_nan_lanes():
+    from photon_ml_tpu.sweep.select import select_best
+
+    assert select_best(np.asarray([np.nan, 2.0, 3.0]), "rmse") == 1

@@ -610,3 +610,118 @@ def test_cli_report_bad_baseline(tmp_path):
         ["--trace", str(trace), "--compare", str(tmp_path / "missing.json")]
     )
     assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: sweep awareness — heartbeat fields + per-config report table
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_sweep_progress_fields():
+    """sweep_configs_done/total ride the heartbeat line while a sweep is
+    running, and are absent otherwise."""
+    line = Heartbeat(interval=60).beat()
+    assert "sweep_configs_total" not in line
+    telemetry.gauge("sweep.configs_total").set(16)
+    telemetry.gauge("sweep.configs_done").set(5)
+    line = Heartbeat(interval=60).beat()
+    assert line["sweep_configs_total"] == 16
+    assert line["sweep_configs_done"] == 5
+
+
+def test_report_sweep_table_round_trip(tmp_path):
+    """The sweep runner's sweep_config spans + sweep.* gauges render as a
+    per-config convergence table, round-tripping through the on-disk
+    trace/telemetry JSONL (the satellite acceptance)."""
+    trace_path = str(tmp_path / "sweep.trace.jsonl")
+    tele_path = str(tmp_path / "sweep.metrics.jsonl")
+    telemetry.configure(trace_out=trace_path)
+    telemetry.gauge("sweep.configs_total").set(3)
+    telemetry.gauge("sweep.configs_done").set(3)
+    telemetry.gauge("sweep.selected_index").set(1)
+    telemetry.gauge("sweep.selected_metric").set(0.81)
+    telemetry.counter("sweep.solves").inc(6)
+    for g, (lam, iters, reason, metric) in enumerate(
+        [(10.0, 12, "FunctionValuesConverged", 0.74),
+         (1.0, 20, "MaxIterations", 0.81),
+         (0.1, 18, "GradientConverged", None)]
+    ):
+        with telemetry.span(
+            "sweep_config", index=g, **{"lambda": lam},
+            iterations=iters, reason=reason, final_loss=100.0 + g,
+            metric=metric, metric_name="auc",
+        ):
+            pass
+    telemetry.flush_metrics(tele_path)
+
+    # live view
+    live = RunReport.from_live()
+    sweep = live.sweep_summary()
+    assert sweep["configs_total"] == 3
+    assert sweep["selected_index"] == 1
+    assert [c["index"] for c in sweep["configs"]] == [0, 1, 2]
+    assert sweep["configs"][1]["reason"] == "MaxIterations"
+    assert sweep["configs"][2]["metric"] is None
+    assert sweep["solves"] == 6
+
+    # disk round trip
+    telemetry.reset()  # close the sink; report reads files only
+    report = RunReport.load(trace=trace_path, telemetry=tele_path)
+    sweep2 = report.sweep_summary()
+    assert sweep2["configs"] == sweep["configs"]
+    assert report.key_metrics()["sweep_selected_metric"] == 0.81
+    md = report.to_markdown()
+    assert "## Hyperparameter sweep" in md
+    assert "selected config **#1**" in md
+    assert "| 0 | 10 | 12 | FunctionValuesConverged |" in md
+    doc = report.save_json(str(tmp_path / "r.json"))
+    assert doc["sweep"]["selected_index"] == 1
+
+
+def test_report_without_sweep_has_no_section():
+    report = RunReport.from_live()
+    assert report.sweep_summary() is None
+    assert "Hyperparameter sweep" not in report.to_markdown()
+
+
+def test_gate_sweep_ratio_is_lower_is_better(capsys):
+    """sweep_over_single_ratio regresses when it RISES (wall-time ratio),
+    unlike the rows/s metrics; and old baselines skip it with a note."""
+    import bench_suite
+
+    # ratio rose 2.0 -> 3.0: regression
+    rc = bench_suite.run_gate(
+        {"sweep_over_single_ratio": 3.0},
+        {"sweep_over_single_ratio": 2.0},
+        0.2,
+    )
+    assert rc == bench_suite.GATE_EXIT_CODE
+    assert "REGRESSED" in capsys.readouterr().err
+    # ratio dropped (sweep got faster): fine
+    rc = bench_suite.run_gate(
+        {"sweep_over_single_ratio": 1.5},
+        {"sweep_over_single_ratio": 2.0},
+        0.2,
+    )
+    assert rc == 0
+    # pre-sweep baseline: skip-with-note, gate still compares the rest
+    rc = bench_suite.run_gate(
+        {"sweep_over_single_ratio": 2.5,
+         "linreg_tron_1Mx10K_rows_per_sec_per_chip": 100.0},
+        {"linreg_tron_1Mx10K_rows_per_sec_per_chip": 95.0},
+        0.2,
+    )
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "sweep_over_single_ratio: new metric" in err
+
+    # overlap_factor likewise skips on baselines that predate it
+    rc = bench_suite.run_gate(
+        {"overlap_factor": 1.2,
+         "linreg_tron_1Mx10K_rows_per_sec_per_chip": 100.0},
+        {"linreg_tron_1Mx10K_rows_per_sec_per_chip": 95.0},
+        0.2,
+    )
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "overlap_factor: new metric" in err

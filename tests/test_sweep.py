@@ -1,0 +1,692 @@
+"""ISSUE 8: on-device hyperparameter sweeps — vmapped multi-λ training,
+warm-started regularization paths, best-model selection, and registry
+export.
+
+Acceptance paths covered here:
+- per-config loss PARITY of the batched sweep vs independent single fits
+  at the same λs (rtol 1e-6), and the selected model's validation metric
+  >= the best of those independent fits;
+- the sweep winner exported through publish_version is hot-swapped by a
+  live ModelRegistry and serves scores matching predict_mean to 1e-6;
+- xla.recompiles stays flat across the warmed sweep executable.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.evaluation.evaluators import EVALUATORS, better_than
+from photon_ml_tpu.game.dataset import build_game_dataset
+from photon_ml_tpu.game.estimator import (
+    FactoredRandomEffectConfig,
+    FixedEffectConfig,
+    GameConfig,
+    GameEstimator,
+    RandomEffectConfig,
+)
+from photon_ml_tpu.optim.factory import (
+    OptimizerConfig,
+    RegularizationContext,
+    RegularizationType,
+    solve,
+    split_reg_weights,
+)
+from photon_ml_tpu.sweep import (
+    SweepGrid,
+    SweepSelectionError,
+    SweepSpecError,
+    SweepUnsupportedError,
+    parse_sweep_spec,
+    run_selection,
+    select_best,
+    sweep_game,
+    sweep_glm,
+)
+from photon_ml_tpu.sweep.runner import path_warm_start
+from photon_ml_tpu.testing import generate_game_dataset, generate_glm_problem
+
+L2 = RegularizationContext(RegularizationType.L2)
+
+
+# ---------------------------------------------------------------------------
+# grid grammar
+# ---------------------------------------------------------------------------
+
+
+class TestGrid:
+    def test_log_range_descending(self):
+        grid = parse_sweep_spec("lambda=1e-4:1e2:log16")
+        assert grid.size == 16
+        lams = grid.default
+        assert lams[0] == pytest.approx(100.0)
+        assert lams[-1] == pytest.approx(1e-4)
+        assert all(a > b for a, b in zip(lams, lams[1:]))
+
+    def test_lin_range_and_explicit_list(self):
+        assert parse_sweep_spec("lambda=0:2:lin3").default == (2.0, 1.0, 0.0)
+        assert parse_sweep_spec("lambda=0.1,10,1").default == (10.0, 1.0, 0.1)
+
+    def test_per_coordinate_override_and_broadcast(self):
+        grid = parse_sweep_spec(
+            ["lambda=1:100:log3", "lambda.perUser=5"]
+        )
+        assert grid.size == 3
+        assert grid.for_coordinate("fixed") == grid.default
+        assert grid.for_coordinate("perUser") == (5.0, 5.0, 5.0)
+
+    def test_duplicates_removed(self):
+        assert parse_sweep_spec("lambda=1,1,2").default == (2.0, 1.0)
+
+    @pytest.mark.parametrize(
+        "spec, match",
+        [
+            ("lambda=", "empty grid"),
+            ("lambda", "expected"),
+            ("lambda=10:1:log4", "inverted range"),
+            ("lambda=1:10:log0", "zero/negative point count"),
+            ("lambda=1:10:lin-2", "zero/negative point count"),
+            ("lambda=-1,2", "negative regularization"),
+            ("lambda=1:10:geo4", "must be 'logN' or 'linN'"),
+            ("lambda=a,b", "not a number"),
+            ("lambda=0:10:log4", "log spacing needs lo > 0"),
+            ("gamma=1,2", "unknown key"),
+            ("lambda=1:10", "ranges are"),
+            ("lambda=nan", "not finite"),
+            ("lambda=inf", "not finite"),
+        ],
+    )
+    def test_malformed_specs_are_typed_and_name_the_token(self, spec, match):
+        with pytest.raises(SweepSpecError, match=match) as err:
+            parse_sweep_spec(spec)
+        # the offending token is in the message for log-grepping
+        assert spec.split("=")[0] in str(err.value)
+
+    def test_mismatched_lengths_rejected(self):
+        with pytest.raises(SweepSpecError, match="one config-axis length"):
+            parse_sweep_spec(["lambda=1,2,3", "lambda.fixed=1,2"])
+
+    def test_missing_default_for_coordinate(self):
+        grid = parse_sweep_spec("lambda.fixed=1,2")
+        with pytest.raises(SweepSpecError, match="no default"):
+            grid.for_coordinate("perUser")
+
+
+# ---------------------------------------------------------------------------
+# GLM sweep: parity + warm start + recompile discipline
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def glm_problem():
+    return generate_glm_problem("logistic", n=400, d=10, seed=11)
+
+
+class TestGlmSweep:
+    def test_16_lane_parity_with_independent_fits(self, glm_problem):
+        """ACCEPTANCE: per-config losses of the batched 16-λ sweep match
+        16 independent single fits at the same λs to 1e-6 (relative)."""
+        cfg = OptimizerConfig(
+            max_iterations=60, tolerance=1e-8, regularization=L2
+        )
+        lams = parse_sweep_spec("lambda=1e-3:1e2:log16").default
+        res = sweep_glm(
+            glm_problem.batch.device(), "logistic", lams, cfg,
+            warm_start=False,
+        )
+        sweep_vals = np.asarray(res.values)
+        single_vals = []
+        for g, lam in enumerate(res.lambdas):
+            ind = solve(
+                "logistic", glm_problem.batch,
+                dataclasses.replace(cfg, regularization_weight=lam),
+                jnp.zeros((10,), jnp.float32),
+            )
+            single_vals.append(float(ind.value))
+        np.testing.assert_allclose(
+            sweep_vals, single_vals, rtol=1e-6,
+            err_msg="batched sweep lanes diverge from independent fits",
+        )
+
+    def test_warm_start_refinement_never_worse(self, glm_problem):
+        cfg = OptimizerConfig(
+            max_iterations=25, tolerance=1e-9, regularization=L2
+        )
+        lams = parse_sweep_spec("lambda=1e-3:10:log8").default
+        cold = sweep_glm(
+            glm_problem.batch.device(), "logistic", lams, cfg,
+            warm_start=False,
+        )
+        warm = sweep_glm(
+            glm_problem.batch.device(), "logistic", lams, cfg,
+            warm_start=True,
+        )
+        assert warm.rounds == 2
+        # the warm refinement round can only improve (or tie) each lane
+        assert np.all(
+            np.asarray(warm.values) <= np.asarray(cold.values) + 1e-5
+        )
+
+    def test_lambdas_sorted_descending_whatever_the_input_order(
+        self, glm_problem
+    ):
+        cfg = OptimizerConfig(max_iterations=5, regularization=L2)
+        res = sweep_glm(
+            glm_problem.batch.device(), "logistic", (0.1, 10.0, 1.0), cfg,
+        )
+        assert res.lambdas == (10.0, 1.0, 0.1)
+        assert res.size == 3
+        assert len(res.reason_names()) == 3
+
+    def test_path_warm_start_masks_converged_lanes(self):
+        w = jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        # lane 1 converged (reason 3) keeps its own w; lanes 0/2 (reason 1
+        # = MaxIterations) take their more-regularized neighbor
+        reasons = jnp.asarray([1, 3, 1], jnp.int32)
+        out = np.asarray(path_warm_start(w, reasons))
+        np.testing.assert_allclose(out[0], [1.0, 1.0])  # lane 0: itself
+        np.testing.assert_allclose(out[1], [2.0, 2.0])  # converged: kept
+        np.testing.assert_allclose(out[2], [2.0, 2.0])  # from lane 1
+
+    def test_recompiles_flat_across_warmed_sweep(self, glm_problem):
+        """ACCEPTANCE: the G-config executable is multi_shape by design —
+        re-running the warmed sweep must not grow xla.recompiles."""
+        from photon_ml_tpu.telemetry import metrics
+
+        cfg = OptimizerConfig(max_iterations=8, regularization=L2)
+        lams = parse_sweep_spec("lambda=0.1:10:log4").default
+        batch = glm_problem.batch.device()
+        sweep_glm(batch, "logistic", lams, cfg, warm_start=False)  # warmup
+        before = metrics.peek_counter("xla.recompiles") or 0
+        sweep_glm(batch, "logistic", lams, cfg, warm_start=False)
+        after = metrics.peek_counter("xla.recompiles") or 0
+        assert after == before
+
+    def test_mesh_shards_config_axis_with_parity(self, glm_problem):
+        """A model-axis mesh partitions the config lanes across devices
+        (pad lanes included: G=3 on 8 devices) with results matching the
+        meshless sweep."""
+        import jax
+
+        if jax.device_count() < 2:
+            pytest.skip("needs a multi-device (virtual CPU) platform")
+        from photon_ml_tpu.parallel import make_mesh
+
+        cfg = OptimizerConfig(
+            max_iterations=20, tolerance=1e-8, regularization=L2
+        )
+        lams = (10.0, 1.0, 0.1)
+        batch = glm_problem.batch.device()
+        plain = sweep_glm(batch, "logistic", lams, cfg, warm_start=False)
+        mesh = make_mesh({"model": jax.device_count()})
+        sharded = sweep_glm(
+            batch, "logistic", lams, cfg, warm_start=False, mesh=mesh
+        )
+        assert sharded.size == 3
+        np.testing.assert_allclose(
+            np.asarray(sharded.values), np.asarray(plain.values), rtol=1e-5
+        )
+        # coefficients agree to convergence tolerance (sharded reductions
+        # reorder float sums, so trajectories differ at the last ulps)
+        np.testing.assert_allclose(
+            np.asarray(sharded.w), np.asarray(plain.w), atol=1e-3
+        )
+
+    def test_empty_grid_rejected(self, glm_problem):
+        cfg = OptimizerConfig(max_iterations=5)
+        with pytest.raises(ValueError, match="non-empty"):
+            sweep_glm(glm_problem.batch.device(), "logistic", (), cfg)
+
+    def test_split_reg_weights_shapes(self):
+        l2s, l1s = split_reg_weights(L2, (1.0, 0.5))
+        np.testing.assert_allclose(np.asarray(l2s), [1.0, 0.5])
+        np.testing.assert_allclose(np.asarray(l1s), [0.0, 0.0])
+        none = RegularizationContext(RegularizationType.NONE)
+        l2s, l1s = split_reg_weights(none, (1.0, 0.5, 2.0))
+        assert l2s.shape == l1s.shape == (3,)
+        np.testing.assert_allclose(np.asarray(l2s), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# GAME sweep
+# ---------------------------------------------------------------------------
+
+
+def _split_game_dataset(n_users=10, rows_per_user=16, fe_dim=6, re_dim=4,
+                        seed=5):
+    """One planted GLMix world split into interleaved train/validation
+    GameDatasets (every user appears in both)."""
+    data, truth = generate_game_dataset(
+        n_users=n_users, rows_per_user=rows_per_user, fe_dim=fe_dim,
+        re_dim=re_dim, seed=seed,
+    )
+    n = data.num_rows
+    val_mask = np.arange(n) % 4 == 3
+
+    def subset(mask):
+        from photon_ml_tpu.ops.sparse import SparseBatch
+
+        idx = np.nonzero(mask)[0]
+        return build_game_dataset(
+            response=data.response[idx],
+            feature_shards={
+                "global": SparseBatch.from_dense(
+                    truth["Xg"][idx], data.response[idx]
+                ),
+                "user": SparseBatch.from_dense(
+                    truth["Xu"][idx], data.response[idx]
+                ),
+            },
+            id_columns={"userId": truth["users"][idx]},
+        )
+
+    return subset(~val_mask), subset(val_mask), truth
+
+
+@pytest.fixture(scope="module")
+def game_split():
+    return _split_game_dataset()
+
+
+def _game_config(num_iterations=2, max_iterations=30):
+    return GameConfig(
+        task="logistic",
+        coordinates={
+            "fixed": FixedEffectConfig(
+                shard_name="global",
+                optimizer=OptimizerConfig(
+                    max_iterations=max_iterations, regularization=L2
+                ),
+            ),
+            "perUser": RandomEffectConfig(
+                shard_name="user",
+                id_name="userId",
+                optimizer=OptimizerConfig(
+                    max_iterations=max_iterations, regularization=L2
+                ),
+            ),
+        },
+        num_iterations=num_iterations,
+        evaluators=("auc",),
+    )
+
+
+class TestGameSweep:
+    def test_selected_beats_independent_single_fits(self, game_split):
+        """ACCEPTANCE: the sweep's selected validation metric is >= the
+        best of independent single fits run at the same λ lanes."""
+        from photon_ml_tpu.game.coordinate_descent import (
+            padded_validation_arrays,
+        )
+
+        train, val, _ = game_split
+        config = _game_config()
+        grid = parse_sweep_spec("lambda=0.03:30:log4")
+        # warm_start off: the acceptance compares the BATCHED executable
+        # against independent fits, so lanes must run the exact same
+        # cold-start CD schedule the single fits run
+        result = sweep_game(config, train, grid, warm_start=False)
+        selection = run_selection(result, val)
+        assert selection.metric == "auc"
+
+        best_single = None
+        for lam in grid.default:
+            cfg1 = GameConfig(
+                task="logistic",
+                coordinates={
+                    name: dataclasses.replace(
+                        c,
+                        optimizer=dataclasses.replace(
+                            c.optimizer, regularization_weight=lam
+                        ),
+                    )
+                    for name, c in config.coordinates.items()
+                },
+                num_iterations=config.num_iterations,
+            )
+            fit = GameEstimator(cfg1).fit(train)
+            # final-model metric through the same evaluator inputs the
+            # sweep selector uses (apples to apples)
+            scores = fit.model.score(val)
+            labels, weights, offsets = padded_validation_arrays(
+                val, scores.shape[0]
+            )
+            value = float(EVALUATORS["auc"](scores + offsets, labels, weights))
+            if best_single is None or better_than("auc", value, best_single):
+                best_single = value
+        assert selection.best_value >= best_single - 1e-6
+
+    def test_per_coordinate_lambdas_and_convergence(self, game_split):
+        train, _val, _ = game_split
+        grid = parse_sweep_spec(
+            ["lambda=0.1:10:log3", "lambda.perUser=1"]
+        )
+        result = sweep_game(_game_config(num_iterations=1), train, grid)
+        assert result.size == 3
+        assert result.lambdas["fixed"] == grid.default
+        assert result.lambdas["perUser"] == (1.0, 1.0, 1.0)
+        conv = result.convergence()
+        for name in ("fixed", "perUser"):
+            assert conv[name]["iterations"].shape == (3,)
+            assert np.all(conv[name]["values"] > 0)
+        assert [h["coordinate"] for h in result.history] == [
+            "fixed", "perUser",
+        ]
+
+    def test_winning_lane_matches_estimator_fit(self, game_split):
+        """A sweep lane's model is the same model a plain estimator fit
+        produces at that λ (same CD schedule, warm start excluded)."""
+        train, _val, _ = game_split
+        lam = 1.0
+        grid = SweepGrid(default=(lam,))
+        result = sweep_game(
+            _game_config(num_iterations=2), train, grid, warm_start=False
+        )
+        model = result.model_for(0)
+        cfg1 = GameConfig(
+            task="logistic",
+            coordinates={
+                name: dataclasses.replace(
+                    c,
+                    optimizer=dataclasses.replace(
+                        c.optimizer, regularization_weight=lam
+                    ),
+                )
+                for name, c in _game_config(2).coordinates.items()
+            },
+            num_iterations=2,
+        )
+        fit = GameEstimator(cfg1).fit(train)
+        # both ran 2 CD iterations to convergence tolerance; they agree up
+        # to that tolerance (bitwise lane parity is covered by the GLM
+        # parity test above — CD residual paths add tolerance-level noise)
+        np.testing.assert_allclose(
+            np.asarray(model.models["fixed"].coefficients),
+            np.asarray(fit.model.models["fixed"].coefficients),
+            atol=5e-3,
+        )
+        scores_sweep = np.asarray(model.score(train))
+        scores_fit = np.asarray(fit.model.score(train))
+        np.testing.assert_allclose(scores_sweep, scores_fit, atol=5e-3)
+
+    def test_validation_scores_match_per_lane_model_score(self, game_split):
+        """The on-device [G, n] validation scorer must agree with the
+        host model.score path for every lane — it feeds selection."""
+        train, val, _ = game_split
+        grid = parse_sweep_spec("lambda=0.1,1,10")
+        result = sweep_game(_game_config(num_iterations=1), train, grid)
+        all_scores = np.asarray(result.validation_scores(val))
+        for g in range(result.size):
+            model = result.model_for(g)
+            expected = np.asarray(model.score(val))
+            np.testing.assert_allclose(
+                all_scores[g], expected, atol=1e-5,
+                err_msg=f"lane {g} on-device validation scores diverge",
+            )
+
+    def test_unsupported_coordinates_are_typed(self, game_split):
+        train, _val, _ = game_split
+        config = GameConfig(
+            task="squared",
+            coordinates={
+                "mf": FactoredRandomEffectConfig(
+                    shard_name="user", id_name="userId", latent_dim=2
+                ),
+            },
+        )
+        with pytest.raises(SweepUnsupportedError, match="mf"):
+            sweep_game(config, train, SweepGrid(default=(1.0,)))
+
+    def test_down_sampling_rejected(self, game_split):
+        train, _val, _ = game_split
+        config = GameConfig(
+            task="logistic",
+            coordinates={
+                "fixed": FixedEffectConfig(
+                    shard_name="global",
+                    optimizer=OptimizerConfig(down_sampling_rate=0.5),
+                ),
+            },
+        )
+        with pytest.raises(SweepUnsupportedError, match="down-sampling"):
+            sweep_game(config, train, SweepGrid(default=(1.0,)))
+
+
+# ---------------------------------------------------------------------------
+# selection policies + degenerate metrics
+# ---------------------------------------------------------------------------
+
+
+class TestSelection:
+    def test_best_policy_prefers_more_regularized_on_tie(self):
+        metrics = np.asarray([0.7, 0.7, 0.6])
+        assert select_best(metrics, "auc") == 0
+
+    def test_minimizing_metrics_select_min(self):
+        metrics = np.asarray([3.0, 1.0, 2.0])
+        assert select_best(metrics, "rmse") == 1
+
+    def test_nan_lanes_excluded_with_counter(self):
+        from photon_ml_tpu.telemetry import metrics as tmetrics
+
+        before = tmetrics.peek_counter("sweep.nan_configs") or 0
+        values = np.asarray([np.nan, 0.8, 0.9])
+        assert select_best(values, "auc") == 2
+        assert (tmetrics.peek_counter("sweep.nan_configs") or 0) == before + 1
+
+    def test_all_nan_is_typed_error_not_silent_argmax(self):
+        with pytest.raises(SweepSelectionError, match="non-finite"):
+            select_best(np.asarray([np.nan, np.nan]), "auc")
+
+    def test_parsimonious_policy(self):
+        metrics = np.asarray([0.897, 0.899, 0.9])
+        # within 1% of the best -> the most regularized lane wins
+        assert select_best(metrics, "auc", policy="parsimonious") == 0
+        assert select_best(
+            metrics, "auc", policy="parsimonious", rel_tol=1e-5
+        ) == 2
+
+    def test_unknown_policy_typed(self):
+        with pytest.raises(SweepSelectionError, match="unknown selection"):
+            select_best(np.asarray([0.5]), "auc", policy="magic")
+
+    def test_sharded_metric_spec_rejected(self, game_split):
+        train, val, _ = game_split
+        result = sweep_game(
+            _game_config(num_iterations=1), train,
+            SweepGrid(default=(1.0,)),
+        )
+        with pytest.raises(SweepSelectionError, match="auc:queryid"):
+            run_selection(result, val, metric="auc:queryid")
+
+    def test_single_class_validation_degrades_to_half_auc(self, game_split):
+        """A single-class validation split must yield the evaluators'
+        documented 0.5 AUC fallback for every lane — selectable, never
+        NaN (the sweep then just picks lane 0 deterministically)."""
+        train, val, _ = game_split
+        one_class = build_game_dataset(
+            response=np.ones(val.num_rows),
+            feature_shards=dict(val.feature_shards),
+            id_columns=dict(val.id_columns),
+        )
+        result = sweep_game(
+            _game_config(num_iterations=1), train,
+            SweepGrid(default=(0.5, 5.0)),
+        )
+        selection = run_selection(result, one_class)
+        np.testing.assert_allclose(selection.metrics, 0.5, atol=1e-6)
+        assert selection.index == 0
+
+
+# ---------------------------------------------------------------------------
+# serving export e2e
+# ---------------------------------------------------------------------------
+
+
+class TestServingExport:
+    def test_winner_published_and_hot_swapped_by_live_registry(
+        self, game_split, tmp_path
+    ):
+        """ACCEPTANCE: sweep -> publish_version -> a LIVE ModelRegistry
+        hot-swaps to the winner and serves scores matching the winner's
+        predict_mean to 1e-6."""
+        from photon_ml_tpu.serving import ModelRegistry, publish_version
+        from photon_ml_tpu.sweep.select import export_winner
+
+        train, val, truth = game_split
+        index_maps = {
+            "global": [f"g{j}" for j in range(6)],
+            "user": [f"u{j}" for j in range(4)],
+        }
+        registry_dir = str(tmp_path / "registry")
+
+        result = sweep_game(
+            _game_config(num_iterations=2), train,
+            parse_sweep_spec("lambda=0.1:10:log3"),
+        )
+        selection = run_selection(result, val)
+        # v1: a deliberately-worse baseline model (a non-selected lane)
+        other = (selection.index + 1) % result.size
+        publish_version(
+            registry_dir, result.model_for(other), index_maps
+        )
+        registry = ModelRegistry(
+            registry_dir, max_batch=16, poll_interval=3600
+        ).start()
+        try:
+            assert registry.current_version == "v-00000001"
+            winner = result.model_for(selection.index)
+            path = export_winner(
+                winner, index_maps, registry_dir, selection=selection
+            )
+            assert path.endswith("v-00000002")
+            assert registry.refresh()  # the live watcher's poll step
+            assert registry.current_version == "v-00000002"
+
+            # served scores == winner.predict_mean on real rows
+            rows = []
+            take = np.arange(val.num_rows)[:24]
+            Xg, Xu = truth["Xg"], truth["Xu"]
+            val_idx = np.arange(len(truth["users"]))[
+                np.arange(len(truth["users"])) % 4 == 3
+            ]
+            for i in take:
+                src = val_idx[i]
+                rows.append(
+                    {
+                        "features": {
+                            "global": [
+                                [j, float(Xg[src, j])] for j in range(6)
+                            ],
+                            "user": [
+                                [j, float(Xu[src, j])] for j in range(4)
+                            ],
+                        },
+                        "ids": {"userId": int(truth["users"][src])},
+                    }
+                )
+            got = registry.engine.score_rows(rows)
+            expected = np.asarray(winner.predict_mean(val))[take]
+            np.testing.assert_allclose(got, expected, atol=1e-6)
+
+            # published metadata round-trips the selection record
+            from photon_ml_tpu.data.model_store import (
+                load_game_model_metadata,
+            )
+
+            meta = load_game_model_metadata(path)
+            sel = meta["extra"]["sweep_selection"]
+            assert sel["index"] == selection.index
+            assert sel["metric"] == "auc"
+        finally:
+            registry.stop()
+
+
+# ---------------------------------------------------------------------------
+# estimator surface
+# ---------------------------------------------------------------------------
+
+
+class TestFitSweep:
+    def test_fit_sweep_saves_best_and_publishes(self, game_split, tmp_path):
+        train, val, _ = game_split
+        est = GameEstimator(_game_config(num_iterations=1))
+        out = est.fit_sweep(
+            train,
+            val,
+            parse_sweep_spec("lambda=0.1,1"),
+            output_dir=str(tmp_path / "model"),
+            registry_dir=str(tmp_path / "registry"),
+            index_maps={
+                "global": [f"g{j}" for j in range(6)],
+                "user": [f"u{j}" for j in range(4)],
+            },
+        )
+        import os
+
+        from photon_ml_tpu.data.model_store import load_game_model
+
+        assert out.published_version is not None
+        best_dir = tmp_path / "model" / "best"
+        assert (best_dir / "model-metadata.json").exists()
+        loaded = load_game_model(str(best_dir))
+        np.testing.assert_allclose(
+            np.asarray(loaded.models["fixed"].coefficients),
+            np.asarray(out.model.models["fixed"].coefficients),
+            atol=1e-6,
+        )
+        assert os.path.isdir(
+            os.path.join(out.published_version, "feature-indexes", "global")
+        )
+
+    def test_fit_sweep_registry_requires_index_maps(self, game_split,
+                                                    tmp_path):
+        train, val, _ = game_split
+        est = GameEstimator(_game_config(num_iterations=1))
+        with pytest.raises(ValueError, match="index_maps"):
+            est.fit_sweep(
+                train, val, SweepGrid(default=(1.0,)),
+                registry_dir=str(tmp_path / "r"),
+            )
+
+
+# ---------------------------------------------------------------------------
+# evaluator sanity for the vmapped scorer
+# ---------------------------------------------------------------------------
+
+
+def test_vmapped_evaluators_match_scalar_path():
+    rng = np.random.default_rng(0)
+    scores = jnp.asarray(rng.normal(size=(3, 50)), jnp.float32)
+    labels = jnp.asarray((rng.random(50) > 0.5).astype(np.float32))
+    weights = jnp.ones((50,), jnp.float32)
+    from photon_ml_tpu.sweep.select import _sweep_evaluator
+
+    for metric in ("auc", "rmse", "logistic_loss"):
+        batched = np.asarray(_sweep_evaluator(metric)(scores, labels, weights))
+        for g in range(3):
+            single = float(EVALUATORS[metric](scores[g], labels, weights))
+            assert batched[g] == pytest.approx(single, rel=1e-6)
+
+
+def test_fit_sweep_threads_rel_tol_to_parsimonious_policy(game_split):
+    """rel_tol reaches selection: an enormous tolerance makes the
+    parsimonious policy pick the most regularized lane outright."""
+    train, val, _ = game_split
+    est = GameEstimator(_game_config(num_iterations=1))
+    out = est.fit_sweep(
+        train, val, parse_sweep_spec("lambda=0.01,0.1,1,10"),
+        policy="parsimonious", rel_tol=10.0,
+    )
+    assert out.selection.index == 0
+    assert out.selection.policy == "parsimonious"
+
+
+def test_convergence_is_fetched_once_and_cached(game_split):
+    train, _val, _ = game_split
+    result = sweep_game(
+        _game_config(num_iterations=1), train, SweepGrid(default=(1.0,))
+    )
+    first = result.convergence()
+    assert result.convergence() is first  # no second device fetch

@@ -59,6 +59,10 @@ L011_HOT_DIRS = (
     os.path.join("photon_ml_tpu", "parallel") + os.sep,
     os.path.join("photon_ml_tpu", "game") + os.sep,
     os.path.join("photon_ml_tpu", "ops") + os.sep,
+    # the sweep runner batches G solver configs into single executables;
+    # a bare jax.jit there hides exactly the multi-config warmup the
+    # recompile-storm gate needs multi_shape attribution for
+    os.path.join("photon_ml_tpu", "sweep") + os.sep,
 )
 L011_HOT_FILES = {
     os.path.join("photon_ml_tpu", "serving", "engine.py"),
