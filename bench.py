@@ -233,6 +233,7 @@ from bench_suite import SUITE_METRICS as _SUITE_METRICS
 #: Expected metric lines per sub-benchmark, so a budget-skipped script
 #: still emits one valid truncated line PER metric it would have printed.
 #: bench_suite's names come from its own module — one source of truth.
+from bench_ingest import INGEST_METRICS as _INGEST_METRICS
 from bench_multichip import MULTICHIP_METRICS as _MULTICHIP_METRICS
 from bench_overlap import OVERLAP_METRICS as _OVERLAP_METRICS
 from bench_sweep import SWEEP_METRICS as _SWEEP_METRICS
@@ -244,7 +245,7 @@ _SCRIPT_METRICS = {
     "bench_multichip.py": _MULTICHIP_METRICS,
     "bench_sweep.py": _SWEEP_METRICS,
     "bench_overlap.py": _OVERLAP_METRICS,
-    "bench_ingest.py": ("avro_ingest_rows_per_sec",),
+    "bench_ingest.py": _INGEST_METRICS,
     "bench_serving.py": ("serving_p50_ms", "serving_p99_ms",
                          "serving_rows_per_sec"),
     "bench_northstar.py": ("north_star_e2e",),

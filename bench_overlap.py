@@ -4,10 +4,14 @@ overlap number gets a per-round trajectory instead of living only in
 PERF_NOTES.md.
 
 Streams HOST numpy chunks through StreamingRandomEffectTrainer twice:
-with the one-chunk-ahead enqueue (prefetch=True: chunk i+1's H2D transfer
-overlaps chunk i's solve through JAX async dispatch) and fully
-synchronous (prefetch=False: block_until_ready between chunks). Reports
-both wall-clocks and the overlap factor as the ``overlap_factor`` metric.
+through the ingest pipeline's bounded double buffer (prefetch=True: a
+background feeder thread runs decode + the H2D ``device_put`` of chunk
+i+1 while chunk i's solve runs — ``photon_ml_tpu.ingest.double_buffered``,
+the same facility the out-of-core ChunkStream uploader uses) and fully
+synchronous (prefetch=False: a scalar fetch between chunks serializes
+feed and solve). Reports both wall-clocks and the overlap factor as the
+``overlap_factor`` metric — a factor > 1 proves the solve overlapped
+decode+upload instead of serializing behind them.
 
 Budget: ``PHOTON_BENCH_BUDGET_S`` is honored — a run starting past the
 deadline emits a valid ``{"metric": "overlap_factor", "truncated": true}``
@@ -91,7 +95,7 @@ def run_overlap(deadline=None) -> dict[str, float | None]:
     tables = {}
     for mode in (True, False):
         trainer = StreamingRandomEffectTrainer(
-            "logistic", cfg, prefetch=mode
+            "logistic", cfg, prefetch=mode, prefetch_depth=2
         )
         table = ShardedCoefficientTable(n_ent, k)
         trainer.train(table, chunks[:1])  # compile warm-up
@@ -114,12 +118,20 @@ def run_overlap(deadline=None) -> dict[str, float | None]:
                 "detail": {
                     "prefetch_s": round(results["prefetch"], 3),
                     "sync_s": round(results["sync"], 3),
+                    "via": "ingest.double_buffered",
+                    "prefetch_depth": 2,
                     "chunks": n_chunks,
                     "chunk_mb": round(chunk_mb, 1),
                     "entities": n_ent,
                     "dim": k,
                     "arms_identical": True,
                     "platform": jax.devices()[0].platform,
+                    # CPU backend: "device" compute and the feeder thread
+                    # share the same cores AND device_put is a memcpy, so
+                    # no overlap win is physically available — the run
+                    # proves mechanics (ordering, bounded queue, identical
+                    # tables), not the speedup
+                    "simulated": jax.devices()[0].platform == "cpu",
                 },
             }
         ),

@@ -406,6 +406,14 @@ def main(argv=None) -> int:
         "factor) and include overlap_factor in the gate; baselines that "
         "predate it skip with a note",
     )
+    parser.add_argument(
+        "--ingest",
+        action="store_true",
+        help="also run bench_ingest.py (one-shot reader + ingest "
+        "pipeline rows/s) and include both metrics in the gate; "
+        "baselines that predate ingest_pipeline_rows_per_sec skip it "
+        "with a note",
+    )
     args = parser.parse_args(argv)
     deadline = budget_deadline()
     results = run_suite(deadline=deadline)
@@ -421,6 +429,10 @@ def main(argv=None) -> int:
         from bench_overlap import run_overlap
 
         results.update(run_overlap(deadline=deadline))
+    if args.ingest:
+        from bench_ingest import run_ingest
+
+        results.update(run_ingest(deadline=deadline))
     if args.gate:
         return run_gate(
             results, load_gate_baseline(args.gate), args.gate_threshold

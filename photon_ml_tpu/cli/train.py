@@ -78,13 +78,37 @@ def read_input(
         paths = expand_input_paths(paths, date_range=dr,
                                    date_range_days_ago=dr_ago)
     if fmt == "avro":
-        from photon_ml_tpu.data.avro import read_game_dataset_from_avro
-
         shards = spec.pop("feature_shards", None)
         shards = {
             k: tuple(v) for k, v in (shards or {"features": ("features",)}).items()
         }
         add_intercept = bool(spec.pop("add_intercept", True))
+        ingest = spec.pop("ingest", None)
+        if ingest:
+            # out-of-core path: the threaded ingest pipeline streams the
+            # shard set through a bounded staging ring (parallel block
+            # decode, double-buffered upload) and assembles the feature
+            # payload DEVICE-side — the host never holds the whole COO.
+            # Arrays are bit-identical to the in-core reader's, so the
+            # fit matches the in-core fit exactly.
+            from photon_ml_tpu.ingest import (
+                IngestSpec,
+                read_game_dataset_streamed,
+            )
+
+            data, index_maps = read_game_dataset_streamed(
+                paths,
+                feature_shards=shards,
+                index_maps=index_maps,
+                id_columns=tuple(spec.pop("id_columns", ())),
+                add_intercept=add_intercept,
+                is_response_required=is_response_required,
+                spec=IngestSpec.from_config(ingest),
+                return_index_maps=True,
+            )
+            return data, index_maps
+        from photon_ml_tpu.data.avro import read_game_dataset_from_avro
+
         # ONE scan builds the index maps AND the dataset (a separate
         # index-build pass would decode the whole input twice — at
         # north-star scale that was the pipeline's dominant cost)
@@ -567,6 +591,20 @@ def main(argv=None) -> int:
         "ModelRegistry hot-swap (config sweep.registry_dir)",
     )
     parser.add_argument(
+        "--ingest-workers",
+        type=int,
+        help="read Avro input through the out-of-core ingest pipeline "
+        "with this many parallel block-decode workers (0 = one per host "
+        "core); enables config input.ingest with defaults when absent",
+    )
+    parser.add_argument(
+        "--prefetch-depth",
+        type=int,
+        help="how many device-ready chunks the ingest pipeline keeps "
+        "ahead of the solve (bounded double-buffer depth; config "
+        "input.ingest.prefetch_depth)",
+    )
+    parser.add_argument(
         "--checkpoint-dir",
         help="persist coordinate-descent state here after each "
         "(iteration, coordinate) step; SIGTERM/SIGINT then writes a final "
@@ -612,6 +650,16 @@ def main(argv=None) -> int:
                 "grid: pass --sweep lambda=... (or config sweep.grid)"
             )
         config["sweep"] = sweep_cfg
+    if args.ingest_workers is not None or args.prefetch_depth is not None:
+        inp = dict(config.get("input") or {})
+        ing = inp.get("ingest")
+        ing = dict(ing) if isinstance(ing, dict) else {}
+        if args.ingest_workers is not None:
+            ing["workers"] = args.ingest_workers
+        if args.prefetch_depth is not None:
+            ing["prefetch_depth"] = args.prefetch_depth
+        inp["ingest"] = ing
+        config["input"] = inp
     if args.trace_out:
         config["trace_out"] = args.trace_out
     if args.telemetry_out:

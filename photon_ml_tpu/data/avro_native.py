@@ -279,6 +279,48 @@ def _concat_strs(strs: Sequence[str]) -> tuple[np.ndarray, np.ndarray]:
     return blob, offs
 
 
+def index_map_blobs(
+    shard_names: Sequence[str],
+    index_maps: Optional[Mapping[str, Mapping[str, int]]],
+):
+    """Index maps -> the flat (feat_bytes, feat_offs, feat_ids,
+    shard_key_counts) arrays ``avro_parse`` consumes, or None when a map
+    is duck-typed (no ``.keys()``; the pure-Python reader handles those).
+    Shared by the one-shot reader below and the ingest pipeline's decode
+    workers (photon_ml_tpu.ingest.decode), which build the blobs ONCE and
+    reuse them across every chunk."""
+    if index_maps is None:
+        return (
+            np.zeros(0, np.uint8),
+            np.zeros(0, np.int64),
+            np.zeros(0, np.int64),
+            np.full(len(shard_names), -1, np.int64),
+        )
+    key_blobs, key_offs, key_ids, key_counts = [], [], [], []
+    byte_base = 0
+    for s in shard_names:
+        imap = index_maps[s]
+        try:
+            keys = list(imap.keys())
+        except (AttributeError, TypeError):
+            # duck-typed maps (e.g. MmapIndexMap) expose only get/len
+            return None
+        blob, offs = _concat_strs(keys)
+        key_blobs.append(blob)
+        # offsets address the CONCATENATED byte blob across shards
+        key_offs.append(offs + byte_base)
+        byte_base += len(blob)
+        key_ids.append(np.asarray([imap[k] for k in keys], np.int64))
+        key_counts.append(len(keys))
+    feat_bytes = np.concatenate(key_blobs) if key_blobs else np.zeros(
+        0, np.uint8
+    )
+    # per-shard offset runs are stored contiguously incl. +1 slots
+    feat_offs = np.concatenate(key_offs)
+    feat_ids = np.concatenate(key_ids) if key_ids else np.zeros(0, np.int64)
+    return feat_bytes, feat_offs, feat_ids, np.asarray(key_counts, np.int64)
+
+
 _proto_ready = False
 
 
@@ -443,40 +485,10 @@ def read_game_arrays_native(
         threads = int(os.environ.get("PHOTON_AVRO_THREADS", "0") or 0)
 
     shard_names = list(feature_shards)
-    if index_maps is not None:
-        key_blobs, key_offs, key_ids, key_counts = [], [], [], []
-        byte_base = 0
-        for s in shard_names:
-            imap = index_maps[s]
-            try:
-                keys = list(imap.keys())
-            except (AttributeError, TypeError):
-                # duck-typed maps (e.g. MmapIndexMap) expose only get/len;
-                # the Python reader handles them — fall back
-                return None
-            blob, offs = _concat_strs(keys)
-            key_blobs.append(blob)
-            # offsets address the CONCATENATED byte blob across shards
-            key_offs.append(offs + byte_base)
-            byte_base += len(blob)
-            key_ids.append(
-                np.asarray([imap[k] for k in keys], np.int64)
-            )
-            key_counts.append(len(keys))
-        feat_bytes = np.concatenate(key_blobs) if key_blobs else np.zeros(
-            0, np.uint8
-        )
-        # per-shard offset runs are stored contiguously incl. +1 slots
-        feat_offs = np.concatenate(key_offs)
-        feat_ids = np.concatenate(key_ids) if key_ids else np.zeros(
-            0, np.int64
-        )
-        shard_key_counts = np.asarray(key_counts, np.int64)
-    else:
-        feat_bytes = np.zeros(0, np.uint8)
-        feat_offs = np.zeros(0, np.int64)
-        feat_ids = np.zeros(0, np.int64)
-        shard_key_counts = np.full(len(shard_names), -1, np.int64)
+    blobs = index_map_blobs(shard_names, index_maps)
+    if blobs is None:
+        return None  # duck-typed maps: fall back to the Python reader
+    feat_bytes, feat_offs, feat_ids, shard_key_counts = blobs
 
     id_blob, id_offs = _concat_strs(list(id_columns))
 

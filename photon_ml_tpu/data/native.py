@@ -65,8 +65,16 @@ def _build() -> bool:
 
 
 def load_native() -> Optional[ctypes.CDLL]:
-    """The native library, building it on first use; None if unavailable."""
+    """The native library, building it on first use; None if unavailable.
+
+    ``PHOTON_NO_NATIVE=1`` hides the library even when it exists — the
+    supported way to force (and test) the pure-Python fallback paths;
+    checked before the load cache so toggling the env var mid-process
+    (e.g. a monkeypatch) takes effect immediately.
+    """
     global _lib, _load_attempted
+    if os.environ.get("PHOTON_NO_NATIVE"):
+        return None
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True

@@ -304,3 +304,192 @@ class GracefulStop:
     def __call__(self) -> bool:
         """Stop-predicate form, passed as ``should_stop=``."""
         return self.requested
+
+
+# ---------------------------------------------------------------------------
+# streamed-fit checkpointing (chunk-boundary granularity)
+# ---------------------------------------------------------------------------
+
+_CHUNK_RE = re.compile(r"^chunk-(\d{8})$")
+
+
+@dataclasses.dataclass
+class StreamCheckpointState:
+    """Everything a streamed random-effect fit needs to continue: the
+    NEXT chunk index to solve (the deterministic ingest planner replays
+    the same stream from that boundary) and the coefficient table rows
+    solved so far."""
+
+    next_chunk: int
+    coefficients: "object"  # np.ndarray [N, K]
+    variances: Optional["object"] = None
+
+
+class StreamingCheckpointManager:
+    """Atomic chunk-boundary checkpoints for streamed table fits.
+
+    Same durability contract as :class:`CheckpointManager` (assemble in a
+    ``.tmp-`` sibling, manifest written last, ``os.rename`` into place,
+    newest-valid restore past corrupt directories, keep-last-K
+    retention), but the unit of progress is a CHUNK of the deterministic
+    ingest stream, not an (iteration, coordinate) step — resume replays
+    from ``next_chunk`` and re-decodes exactly the rows the interrupted
+    run would have seen, in the same order (ingest.planner's determinism
+    contract).
+    """
+
+    def __init__(self, spec: CheckpointSpec):
+        import numpy as np  # local: keep module import light
+
+        self._np = np
+        self.spec = spec
+        os.makedirs(spec.directory, exist_ok=True)
+        if not spec.resume:
+            stale = self._chunk_dirs()
+            if stale:
+                logger.warning(
+                    "resume=False: clearing %d existing streaming "
+                    "checkpoint(s) under %s", len(stale), spec.directory,
+                )
+            for _c, path in stale:
+                shutil.rmtree(path, ignore_errors=True)
+
+    def should_save(self, chunk_index: int) -> bool:
+        return (chunk_index + 1) % self.spec.every == 0
+
+    def save(self, state: StreamCheckpointState) -> str:
+        np = self._np
+        name = f"chunk-{state.next_chunk:08d}"
+        final = os.path.join(self.spec.directory, name)
+        tmp = os.path.join(self.spec.directory, f".tmp-{name}")
+        with telemetry.span("checkpoint:save", next_chunk=state.next_chunk):
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            coeffs = np.asarray(state.coefficients)
+            np.save(os.path.join(tmp, "coefficients.npy"), coeffs)
+            if state.variances is not None:
+                np.save(
+                    os.path.join(tmp, "variances.npy"),
+                    np.asarray(state.variances),
+                )
+            # manifest LAST: its presence certifies the directory complete
+            atomic_write_json(
+                os.path.join(tmp, _MANIFEST_FILE),
+                {
+                    "format_version": _FORMAT_VERSION,
+                    "kind": "streaming",
+                    "next_chunk": int(state.next_chunk),
+                    "num_entities": int(coeffs.shape[0]),
+                    "dim": int(coeffs.shape[1]),
+                    "has_variances": state.variances is not None,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            fsync_dir(self.spec.directory)
+        telemetry.counter("checkpoint.saves").inc()
+        telemetry.gauge("checkpoint.last_save_ts").set(
+            telemetry.trace.TRACER.now()
+        )
+        self._apply_retention()
+        return final
+
+    def _apply_retention(self) -> None:
+        dirs = self._chunk_dirs()
+        for _c, path in dirs[: -self.spec.keep_last]:
+            shutil.rmtree(path, ignore_errors=True)
+        for name in os.listdir(self.spec.directory):
+            if name.startswith(".tmp-chunk-"):
+                shutil.rmtree(
+                    os.path.join(self.spec.directory, name),
+                    ignore_errors=True,
+                )
+
+    def _chunk_dirs(self) -> list[tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.spec.directory):
+            m = _CHUNK_RE.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.spec.directory, name)))
+        return sorted(out)
+
+    def _load(self, path: str) -> StreamCheckpointState:
+        import json
+
+        np = self._np
+        manifest_path = os.path.join(path, _MANIFEST_FILE)
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            raise CheckpointError(
+                f"{path}: incomplete checkpoint (no manifest)"
+            ) from None
+        except ValueError as e:
+            raise CheckpointError(
+                f"{manifest_path}: corrupt manifest ({e})"
+            ) from None
+        if manifest.get("format_version") != _FORMAT_VERSION:
+            raise CheckpointError(
+                f"{manifest_path}: unsupported format_version "
+                f"{manifest.get('format_version')!r}"
+            )
+        if manifest.get("kind") != "streaming":
+            raise CheckpointError(
+                f"{manifest_path}: not a streaming checkpoint "
+                f"(kind={manifest.get('kind')!r})"
+            )
+        try:
+            coeffs = np.load(os.path.join(path, "coefficients.npy"))
+        except (OSError, ValueError) as e:
+            raise CheckpointError(
+                f"{path}: unreadable coefficients ({e})"
+            ) from None
+        if coeffs.shape != (
+            int(manifest["num_entities"]), int(manifest["dim"])
+        ):
+            raise CheckpointError(
+                f"{path}: coefficient shape {coeffs.shape} does not match "
+                "its manifest"
+            )
+        variances = None
+        if manifest.get("has_variances"):
+            try:
+                variances = np.load(os.path.join(path, "variances.npy"))
+            except (OSError, ValueError) as e:
+                raise CheckpointError(
+                    f"{path}: unreadable variances ({e})"
+                ) from None
+        return StreamCheckpointState(
+            next_chunk=int(manifest["next_chunk"]),
+            coefficients=coeffs,
+            variances=variances,
+        )
+
+    def restore(self) -> Optional[StreamCheckpointState]:
+        """Newest VALID streaming checkpoint, or None; corrupt/partial
+        directories are skipped with a warning (``checkpoint.corrupt``)."""
+        if not self.spec.resume:
+            return None
+        with telemetry.span("checkpoint:restore"):
+            for _c, path in reversed(self._chunk_dirs()):
+                try:
+                    state = self._load(path)
+                except (CheckpointError, ValueError, OSError) as e:
+                    telemetry.counter("checkpoint.corrupt").inc()
+                    logger.warning(
+                        "skipping corrupt checkpoint %s: %s", path, e
+                    )
+                    continue
+                telemetry.counter("checkpoint.restores").inc()
+                logger.info(
+                    "resuming streamed fit from %s (next chunk %d)",
+                    path, state.next_chunk,
+                )
+                return state
+        return None

@@ -232,6 +232,7 @@ class StreamingRandomEffectTrainer:
         axis: Optional[str] = None,
         compute_variances: bool = False,
         prefetch: bool = True,
+        prefetch_depth: int = 1,
         guard: Optional[GuardSpec] = None,
         feed_retries: int = 2,
     ):
@@ -252,10 +253,16 @@ class StreamingRandomEffectTrainer:
         self.config = config
         self.mesh = mesh
         self.compute_variances = compute_variances
-        # one-chunk-ahead enqueue (H2D transfer of chunk i+1 overlaps chunk
-        # i's solve via async dispatch); False = fully synchronous, the
-        # control arm for measuring the overlap win (bench_overlap.py)
+        # chunk feeding runs through ingest.double_buffered: a background
+        # feeder thread prepares (decodes/uploads) up to ``prefetch_depth``
+        # chunks ahead of the solve behind a bounded queue — host-side feed
+        # work AND the H2D transfer overlap the solve. False = fully
+        # synchronous, the control arm for measuring the overlap win
+        # (bench_overlap.py)
         self.prefetch = prefetch
+        if prefetch_depth < 1:
+            raise ValueError("prefetch_depth must be >= 1")
+        self.prefetch_depth = int(prefetch_depth)
         # per-chunk divergence guard (optim.guard). NOTE: the health check is
         # one scalar fetch per chunk, which serializes the chunk pipeline —
         # enable it for robustness, not for peak-throughput benches.
@@ -471,15 +478,61 @@ class StreamingRandomEffectTrainer:
             reasons=res.reason,
         )
 
+    def _after_chunk(
+        self,
+        chunk_index: int,
+        table: ShardedCoefficientTable,
+        variance_table: Optional[ShardedCoefficientTable],
+        checkpointer,
+        should_stop,
+        final: bool,
+    ) -> None:
+        """Chunk-boundary bookkeeping: periodic checkpoint, and the
+        graceful-preemption handshake (save-then-raise on a stop
+        request — the deterministic ingest order makes ``next_chunk``
+        sufficient resume state)."""
+        if checkpointer is None:
+            if should_stop is not None and should_stop():
+                from photon_ml_tpu.game.checkpoint import TrainingInterrupted
+
+                raise TrainingInterrupted(chunk_index, None)
+            return
+        from photon_ml_tpu.game.checkpoint import (
+            StreamCheckpointState,
+            TrainingInterrupted,
+        )
+
+        stop = should_stop is not None and should_stop()
+        path = None
+        if stop or (not final and checkpointer.should_save(chunk_index)):
+            _, rows = table.local_shard()
+            var_rows = None
+            if variance_table is not None:
+                _, var_rows = variance_table.local_shard()
+            path = checkpointer.save(
+                StreamCheckpointState(
+                    next_chunk=chunk_index + 1,
+                    coefficients=rows,
+                    variances=var_rows,
+                )
+            )
+        if stop:
+            raise TrainingInterrupted(chunk_index, path)
+
     def train(
         self,
         table: ShardedCoefficientTable,
         chunks: Iterable[tuple[int, DenseBatch | Callable[[], DenseBatch]]],
         variance_table: Optional[ShardedCoefficientTable] = None,
         with_tracker: bool = False,
+        should_stop: Optional[Callable[[], bool]] = None,
+        checkpointer=None,
+        start_chunk: int = 0,
     ) -> StreamingTrainStats:
-        """Solve every chunk into ``table``; chunk i+1's data is enqueued
-        BEFORE chunk i's solve result is consumed (async-dispatch overlap).
+        """Solve every chunk into ``table``; feeding (decode + host->device
+        upload) runs ``prefetch_depth`` chunks ahead of the solve in a
+        background thread (``ingest.double_buffered`` — the trainer is a
+        CONSUMER of the pipeline, not an ingestion implementation).
 
         ``variance_table``: required when ``compute_variances``; receives
         the per-coefficient Hessian-diagonal-inverse variances
@@ -487,33 +540,55 @@ class StreamingRandomEffectTrainer:
         ``with_tracker``: also return the full per-entity
         RandomEffectOptimizationTracker (costs one extra packed
         device->host fetch of 3 x total_entities values).
+
+        Fault tolerance: with a ``checkpointer``
+        (:class:`~photon_ml_tpu.game.checkpoint.StreamingCheckpointManager`)
+        the table is snapshotted every ``every`` chunk boundaries, and a
+        ``should_stop`` request (e.g. :class:`GracefulStop` on SIGTERM)
+        finishes the current chunk, saves a final checkpoint, and raises
+        ``TrainingInterrupted``. Resume by restoring the table and passing
+        the restored ``next_chunk`` as ``start_chunk`` — chunk ordering is
+        deterministic, so the replayed stream is exactly the remainder.
         """
         if self.compute_variances and variance_table is None:
             raise ValueError(
                 "compute_variances=True needs a variance_table"
             )
+        if start_chunk < 0:
+            raise ValueError("start_chunk must be >= 0")
         results: list[ChunkResult] = []
+        chunk_iter = iter(chunks)
+        if start_chunk:
+            # replay: skip already-solved chunks WITHOUT feeding them
+            import itertools
+
+            chunk_iter = itertools.islice(chunk_iter, start_chunk, None)
+        index = start_chunk - 1
         if self.prefetch:
-            it = iter(chunks)
-            pending = None
-            for start, source in it:
-                nxt = (start, self._feed(source))
-                if pending is not None:
-                    results.append(
-                        self._solve(
-                            table, *pending, variance_table=variance_table
-                        )
-                    )
-                pending = nxt
-            if pending is not None:
+            from photon_ml_tpu.ingest.prefetch import double_buffered
+
+            for (start, _source), batch in double_buffered(
+                chunk_iter,
+                lambda item: self._feed(item[1]),
+                depth=self.prefetch_depth,
+                name="streaming_chunk",
+            ):
+                index += 1
                 results.append(
-                    self._solve(table, *pending, variance_table=variance_table)
+                    self._solve(
+                        table, start, batch, variance_table=variance_table
+                    )
+                )
+                self._after_chunk(
+                    index, table, variance_table, checkpointer,
+                    should_stop, final=False,
                 )
         else:
             # control arm: serialize transfer and compute completely — a
             # 1-element fetch is the only true sync through the tunnel
             # (block_until_ready is a no-op there, tools/check.py L007)
-            for start, source in chunks:
+            for start, source in chunk_iter:
+                index += 1
                 results.append(
                     self._solve(
                         table,
@@ -525,6 +600,26 @@ class StreamingRandomEffectTrainer:
                 telemetry.sync_fetch(
                     table.coefficients[start, 0], label="streaming_sync"
                 )
+                self._after_chunk(
+                    index, table, variance_table, checkpointer,
+                    should_stop, final=False,
+                )
+        if checkpointer is not None and results:
+            # terminal checkpoint: a crash AFTER the stream finishes must
+            # not replay the tail chunks
+            from photon_ml_tpu.game.checkpoint import StreamCheckpointState
+
+            _, rows = table.local_shard()
+            var_rows = None
+            if variance_table is not None:
+                _, var_rows = variance_table.local_shard()
+            checkpointer.save(
+                StreamCheckpointState(
+                    next_chunk=index + 1,
+                    coefficients=rows,
+                    variances=var_rows,
+                )
+            )
         if not results:
             return StreamingTrainStats(0, 0, 0, 0.0, 0.0)
         # ONE device->host fetch for the scalar summaries

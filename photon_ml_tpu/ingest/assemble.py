@@ -1,0 +1,195 @@
+"""Out-of-core GameDataset assembly from a :class:`ChunkStream`.
+
+The host only ever holds the staging ring; the feature payload (COO
+values/rows/cols — the bytes that dwarf everything else) accumulates
+DEVICE-side, written chunk-by-chunk into growable HBM buffers with
+donated ``dynamic_update_slice`` programs and trimmed to the exact nnz at
+the end. Because chunks arrive in deterministic plan order and each
+chunk's padded tail is overwritten by its successor (capacities are
+monotone along the stream), the assembled arrays are BIT-IDENTICAL to
+what the one-shot in-core reader produces — an out-of-core fit matches
+the in-core fit's loss because it trains on the same arrays.
+
+Row scalars (response/offset/weight, exact f64) and id-column codes are
+tiny (tens of bytes/row vs the feature payload) and stay host-side, which
+is what GameDataset wants anyway.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.ingest.pipeline import ChunkStream, IngestSpec
+from photon_ml_tpu.ops.sparse import SparseBatch
+
+
+@lru_cache(maxsize=2)
+def _chunk_writer(donate: bool):
+    def write(bv, br, bc, v, r, c, off, base):
+        bv = jax.lax.dynamic_update_slice(bv, v, (off,))
+        br = jax.lax.dynamic_update_slice(br, r + base, (off,))
+        bc = jax.lax.dynamic_update_slice(bc, c, (off,))
+        return bv, br, bc
+
+    # multi_shape: buffer sizes step geometrically and chunk capacities
+    # may step once after a growth — a small, by-design signature set
+    return telemetry.instrumented_jit(
+        write,
+        name="ingest_assemble_write",
+        multi_shape=True,
+        donate_argnums=(0, 1, 2) if donate else (),
+    )
+
+
+class ShardAssembler:
+    """Accumulate one feature shard's COO on device, chunk by chunk."""
+
+    def __init__(self, num_features: int, initial_nnz: int,
+                 donate: bool = True):
+        self.num_features = int(num_features)
+        cap = max(int(initial_nnz), 1)
+        self._v = jnp.zeros(cap, jnp.float32)
+        self._r = jnp.zeros(cap, jnp.int32)
+        self._c = jnp.zeros(cap, jnp.int32)
+        self._nnz = 0
+        self._donate = donate
+
+    def _ensure(self, need: int) -> None:
+        cap = self._v.shape[0]
+        if need <= cap:
+            return
+        new_cap = max(cap * 2, need)
+        extra = new_cap - cap
+        # growth is rare (geometric) — the eager concatenate's copy is
+        # acceptable off the critical path
+        self._v = jnp.concatenate([self._v, jnp.zeros(extra, jnp.float32)])
+        self._r = jnp.concatenate([self._r, jnp.zeros(extra, jnp.int32)])
+        self._c = jnp.concatenate([self._c, jnp.zeros(extra, jnp.int32)])
+
+    def add(self, batch: SparseBatch, nnz_used: int, row_start: int) -> None:
+        """Write one chunk's padded arrays at the running nnz offset; the
+        padded tail is overwritten by the next chunk (or trimmed)."""
+        self._ensure(self._nnz + batch.nnz)
+        self._v, self._r, self._c = _chunk_writer(self._donate)(
+            self._v, self._r, self._c,
+            batch.values, batch.rows, batch.cols,
+            jnp.int32(self._nnz), jnp.int32(row_start),
+        )
+        self._nnz += int(nnz_used)
+
+    def finish(self, labels: np.ndarray) -> SparseBatch:
+        """Trim to the exact nnz and attach the row scalars with the
+        in-core reader's ``from_coo`` contract: labels real, per-shard
+        offsets/weights at their defaults (zeros/ones) — the REAL
+        offset/weight columns live on the GameDataset and are attached
+        by ``batch_for`` at solve time, identically for both readers."""
+        total = self._nnz
+        n = len(labels)
+        return SparseBatch(
+            values=self._v[:total],
+            rows=self._r[:total],
+            cols=self._c[:total],
+            labels=labels.astype(np.float32),
+            offsets=np.zeros(n, np.float32),
+            weights=np.ones(n, np.float32),
+            num_features=self.num_features,
+        )
+
+
+def read_game_dataset_streamed(
+    paths,
+    feature_shards: Optional[Mapping[str, Sequence[str]]] = None,
+    index_maps: Optional[Mapping] = None,
+    id_columns: Sequence[str] = (),
+    add_intercept: bool = True,
+    is_response_required: bool = True,
+    spec: Optional[IngestSpec] = None,
+    placement=None,
+    return_index_maps: bool = False,
+):
+    """The out-of-core counterpart of ``read_game_dataset_from_avro``.
+
+    Streams the shard set through a :class:`ChunkStream` (parallel block
+    decode into the staging ring, double-buffered upload) and assembles a
+    GameDataset whose feature payload lives on DEVICE; arrays are
+    bit-identical to the in-core reader's. ``index_maps`` are built with
+    the cheap vocab-only scan when absent (an out-of-core stream cannot
+    discover the feature space as it goes).
+    """
+    from photon_ml_tpu.data.avro import (
+        _as_paths,
+        build_index_maps_from_avro,
+    )
+    from photon_ml_tpu.game.dataset import GameDataset, IdColumn
+
+    feature_shards = dict(feature_shards or {"features": ("features",)})
+    file_list = _as_paths(paths)
+    if index_maps is None:
+        index_maps = build_index_maps_from_avro(
+            file_list, feature_shards, add_intercept=add_intercept
+        )
+    stream = ChunkStream(
+        file_list,
+        feature_shards=feature_shards,
+        index_maps=index_maps,
+        id_columns=id_columns,
+        add_intercept=add_intercept,
+        is_response_required=is_response_required,
+        spec=spec,
+        placement=placement,
+    )
+    n = stream.total_rows
+    if n == 0:
+        stream.close()
+        raise ValueError(f"no records in {file_list}")
+    labels = np.empty(n, np.float64)
+    offsets = np.empty(n, np.float64)
+    weights = np.empty(n, np.float64)
+    codes = {c: np.empty(n, np.int64) for c in id_columns}
+    est = n * (spec.nnz_per_row_hint if spec else 32)
+    asms = {
+        name: ShardAssembler(len(index_maps[name]), est)
+        for name in feature_shards
+    }
+    with telemetry.span(
+        "ingest_assemble", rows=n, chunks=len(stream.plans)
+    ), stream:
+        for chunk in stream:
+            sl = slice(chunk.row_start, chunk.row_start + chunk.rows)
+            labels[sl] = chunk.labels
+            offsets[sl] = chunk.offsets
+            weights[sl] = chunk.weights
+            for col in id_columns:
+                codes[col][sl] = chunk.id_codes[col]
+            for name, asm in asms.items():
+                asm.add(
+                    chunk.shards[name], chunk.nnz_used[name],
+                    chunk.row_start,
+                )
+    shards = {name: asm.finish(labels) for name, asm in asms.items()}
+    # id codes: sort the stream-global vocab and rank-remap, exactly like
+    # the in-core reader (models score via searchsorted over sorted vocab)
+    id_cols = {}
+    for col in id_columns:
+        vocab = stream.id_vocabulary(col)
+        order = np.argsort(vocab)
+        rank = np.empty(len(order), np.int64)
+        rank[order] = np.arange(len(order))
+        raw = codes[col]
+        id_cols[col] = IdColumn(
+            codes=rank[raw] if len(raw) else raw, vocab=vocab[order]
+        )
+    ds = GameDataset(
+        response=labels,
+        offset=offsets,
+        weight=weights,
+        feature_shards=shards,
+        id_columns=id_cols,
+    )
+    return (ds, index_maps) if return_index_maps else ds

@@ -1,0 +1,181 @@
+"""Pre-allocated staging buffers for decode workers.
+
+A decode worker writes a chunk DIRECTLY into the padded
+:class:`~photon_ml_tpu.ops.sparse.SparseBatch` layout the solvers consume
+(f32 values, i32 rows/cols, f32 labels/offsets/weights), plus the f64/i64
+scratch views the native decoder fills — no per-chunk allocation and no
+COO->padded rebuild on the critical path. Buffers live in a bounded ring:
+decode blocks when the consumer stops draining (backpressure), and the
+ring size IS the pipeline's host-resident budget.
+
+Capacity: row capacity is fixed by the plan (``chunk_rows`` + the largest
+block's worth of slack); nnz capacity starts at ``rows_cap *
+nnz_per_row_hint`` and grows geometrically when a chunk overflows it
+(``ingest.buffer_growths`` counts these). Growth is coordinated by the
+pipeline so every buffer converges to one stream-global capacity — chunk
+batches keep ONE jit signature and the device assembler's sequential
+overwrite stays exact.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.ingest.errors import IngestStall, PipelineClosed
+from photon_ml_tpu.ingest.planner import ChunkPlan
+
+
+class ShardStage:
+    """Per-feature-shard staging arrays of one buffer."""
+
+    __slots__ = (
+        "raw_cap", "nnz_cap", "values", "rows", "cols",
+        "scratch_vals", "scratch_rows", "scratch_cols", "nnz_used",
+    )
+
+    def __init__(self, raw_cap: int, rows_cap: int, intercept: bool):
+        self.nnz_used = 0
+        self._alloc(raw_cap, rows_cap, intercept)
+
+    def _alloc(self, raw_cap: int, rows_cap: int, intercept: bool) -> None:
+        self.raw_cap = int(raw_cap)
+        # final layout holds raw nnz + one optional intercept nnz per row
+        self.nnz_cap = self.raw_cap + (rows_cap if intercept else 0)
+        self.values = np.zeros(self.nnz_cap, np.float32)
+        self.rows = np.full(self.nnz_cap, rows_cap - 1, np.int32)
+        self.cols = np.zeros(self.nnz_cap, np.int32)
+        self.scratch_vals = np.empty(self.raw_cap, np.float64)
+        self.scratch_rows = np.empty(self.raw_cap, np.int64)
+        self.scratch_cols = np.empty(self.raw_cap, np.int64)
+
+    def grow(
+        self, raw_cap: int, rows_cap: int, intercept: bool,
+        preserve: int = 0,
+    ) -> None:
+        """Reallocate to ``raw_cap``, keeping the first ``preserve``
+        scratch entries (the python decoder grows MID-fill)."""
+        if raw_cap <= self.raw_cap:
+            return
+        old = (self.scratch_vals, self.scratch_rows, self.scratch_cols)
+        self._alloc(raw_cap, rows_cap, intercept)
+        if preserve:
+            self.scratch_vals[:preserve] = old[0][:preserve]
+            self.scratch_rows[:preserve] = old[1][:preserve]
+            self.scratch_cols[:preserve] = old[2][:preserve]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            a.nbytes
+            for a in (self.values, self.rows, self.cols, self.scratch_vals,
+                      self.scratch_rows, self.scratch_cols)
+        )
+
+
+class StagingBuffer:
+    """One ring slot: padded batch arrays + decoder scratch for a chunk."""
+
+    def __init__(
+        self,
+        rows_cap: int,
+        raw_nnz_cap: int,
+        n_shards: int,
+        n_id_columns: int,
+        intercept: bool,
+    ):
+        self.rows_cap = int(rows_cap)
+        self.intercept = bool(intercept)
+        self.shards = [
+            ShardStage(raw_nnz_cap, rows_cap, intercept)
+            for _ in range(n_shards)
+        ]
+        self.labels = np.zeros(rows_cap, np.float32)
+        self.offsets = np.zeros(rows_cap, np.float32)
+        self.weights = np.zeros(rows_cap, np.float32)
+        self.scratch_labels = np.empty(rows_cap, np.float64)
+        self.scratch_offsets = np.empty(rows_cap, np.float64)
+        self.scratch_weights = np.empty(rows_cap, np.float64)
+        self.label_seen = np.empty(rows_cap, np.uint8)
+        self.id_codes = np.empty((n_id_columns, rows_cap), np.int64)
+        # -- fill state (set by the decode worker, read downstream) --------
+        self.plan: Optional[ChunkPlan] = None
+        self.rows_used = 0
+        self.id_vocabs: list[np.ndarray] = []
+
+    @property
+    def nbytes(self) -> int:
+        return (
+            sum(s.nbytes for s in self.shards)
+            + self.labels.nbytes * 3
+            + self.scratch_labels.nbytes * 3
+            + self.label_seen.nbytes
+            + self.id_codes.nbytes
+        )
+
+    def reset_rows(self, n: int) -> None:
+        """Start a fill of ``n`` rows: clear the padded row region so a
+        previous chunk's tail can never leak into this one (padded rows
+        MUST have weight 0 — the loss-parity invariant)."""
+        self.rows_used = int(n)
+        self.labels[n:] = 0.0
+        self.offsets[n:] = 0.0
+        self.weights[n:] = 0.0
+
+
+class BufferRing:
+    """Bounded free-list of staging buffers with a condition variable.
+
+    ``acquire`` blocks until a buffer is free — this is the backpressure
+    edge between decode and the consumer — and raises a typed
+    :class:`IngestStall` after ``stall_timeout_s`` so a wedged pipeline
+    fails loudly instead of hanging a training job forever.
+    """
+
+    def __init__(self, buffers: Sequence[StagingBuffer],
+                 stall_timeout_s: float):
+        self._cv = threading.Condition()
+        self._free: deque[StagingBuffer] = deque(buffers)
+        self._all = tuple(buffers)
+        self._closed = False
+        self._stall_timeout_s = float(stall_timeout_s)
+        telemetry.gauge("ingest.staging_bytes").set(self.nbytes)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(b.nbytes for b in self._all)
+
+    @property
+    def capacity(self) -> int:
+        return len(self._all)
+
+    def acquire(self) -> StagingBuffer:
+        with self._cv:
+            waited = self._cv.wait_for(
+                lambda: self._free or self._closed,
+                timeout=self._stall_timeout_s,
+            )
+            if self._closed:
+                raise PipelineClosed("buffer ring closed")
+            if not waited:
+                telemetry.counter("ingest.stalls").inc()
+                raise IngestStall(
+                    "decode", self._stall_timeout_s,
+                    "no free staging buffer (consumer not draining?)",
+                )
+            return self._free.popleft()
+
+    def release(self, buf: StagingBuffer) -> None:
+        with self._cv:
+            buf.plan = None
+            self._free.append(buf)
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()

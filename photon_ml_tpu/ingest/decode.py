@@ -1,0 +1,396 @@
+"""Per-chunk block-range decoding into staging buffers.
+
+One chunk = a run of whole Avro blocks inside one file
+(:class:`~photon_ml_tpu.ingest.planner.ChunkPlan`). The worker reads
+exactly those bytes, decodes them with the native C++ interpreter
+(``native/avro_decode.cpp`` via :mod:`photon_ml_tpu.data.avro_native`)
+when available and with the pure-Python schema walker otherwise, and
+writes the result DIRECTLY into a pre-allocated
+:class:`~photon_ml_tpu.ingest.buffers.StagingBuffer` in padded
+SparseBatch layout. Both paths produce bit-identical arrays — the
+pipeline degrades to Python decode workers, it never crashes for lack of
+a toolchain (set ``PHOTON_NO_NATIVE=1`` to force the fallback).
+
+The finalize step is shared: label presence check, f64->f32 casts into
+the padded layout, and the sorted per-row intercept interleave — the
+same O(nnz) merge the one-shot reader uses, so a streamed dataset is
+byte-for-byte the in-core dataset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import zlib
+from typing import Callable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import INTERCEPT_KEY, feature_key
+from photon_ml_tpu.ingest.buffers import StagingBuffer
+from photon_ml_tpu.ingest.errors import ChunkDecodeError
+from photon_ml_tpu.ingest.planner import ChunkPlan, FileMeta
+
+#: grow callback: (buffer, shard index, needed raw nnz, preserve) -> None;
+#: ``preserve`` is how many already-written scratch entries must survive
+#: the reallocation (the python decoder grows mid-fill)
+GrowFn = Callable[[StagingBuffer, int, int, int], None]
+
+
+@dataclasses.dataclass
+class DecodeContext:
+    """Everything a decode worker needs, built ONCE per stream.
+
+    ``use_native`` is decided up front for the whole stream (native
+    library present, every file's schema compiles to a program, index
+    maps enumerable) so chunk decode is branch-free; either path fills
+    the same staging layout.
+    """
+
+    metas: Mapping[str, FileMeta]
+    shard_names: tuple[str, ...]
+    feature_shards: Mapping[str, tuple[str, ...]]
+    index_maps: Mapping[str, Mapping[str, int]]
+    id_columns: tuple[str, ...]
+    add_intercept: bool
+    is_response_required: bool
+    intercept_cols: tuple[int, ...]  # per shard; -1 = no intercept slot
+    use_native: bool
+    # native-path artifacts (None on the python path)
+    programs: Optional[Mapping[str, np.ndarray]] = None  # path -> program
+    feat_bytes: Optional[np.ndarray] = None
+    feat_offs: Optional[np.ndarray] = None
+    feat_ids: Optional[np.ndarray] = None
+    shard_key_counts: Optional[np.ndarray] = None
+    id_blob: Optional[np.ndarray] = None
+    id_offs: Optional[np.ndarray] = None
+    # python-path artifacts
+    schemas: Optional[Mapping[str, dict]] = None  # path -> parsed schema
+    named: Optional[Mapping[str, dict]] = None  # path -> named-type table
+
+
+def build_decode_context(
+    metas: Sequence[FileMeta],
+    feature_shards: Mapping[str, Sequence[str]],
+    index_maps: Mapping[str, Mapping[str, int]],
+    id_columns: Sequence[str] = (),
+    add_intercept: bool = True,
+    is_response_required: bool = True,
+) -> DecodeContext:
+    from photon_ml_tpu.data.avro_native import (
+        _concat_strs,
+        _lib,
+        compile_program,
+        index_map_blobs,
+    )
+
+    shard_names = tuple(feature_shards)
+    feature_shards = {s: tuple(feature_shards[s]) for s in shard_names}
+    intercept_cols = tuple(
+        index_maps[s].get(INTERCEPT_KEY) if add_intercept else -1
+        for s in shard_names
+    )
+    ctx = DecodeContext(
+        metas={m.path: m for m in metas},
+        shard_names=shard_names,
+        feature_shards=feature_shards,
+        index_maps=dict(index_maps),
+        id_columns=tuple(id_columns),
+        add_intercept=bool(add_intercept),
+        is_response_required=bool(is_response_required),
+        intercept_cols=intercept_cols,
+        use_native=False,
+    )
+
+    lib = _lib()
+    blobs = index_map_blobs(list(shard_names), index_maps) if lib else None
+    programs: dict[str, np.ndarray] = {}
+    if lib is not None and blobs is not None:
+        prog_cache: dict[str, Optional[np.ndarray]] = {}
+        for m in metas:
+            prog = prog_cache.get(m.schema_json)
+            if prog is None and m.schema_json not in prog_cache:
+                prog = compile_program(
+                    json.loads(m.schema_json), feature_shards, id_columns
+                )
+                prog_cache[m.schema_json] = prog
+            if prog is None:
+                programs = {}
+                break
+            programs[m.path] = prog
+    if programs:
+        id_blob, id_offs = _concat_strs(list(id_columns))
+        ctx.use_native = True
+        ctx.programs = programs
+        (ctx.feat_bytes, ctx.feat_offs, ctx.feat_ids,
+         ctx.shard_key_counts) = blobs
+        ctx.id_blob, ctx.id_offs = id_blob, id_offs
+    else:
+        from photon_ml_tpu.data.avro import _collect_named
+
+        schemas: dict[str, dict] = {}
+        named: dict[str, dict] = {}
+        for m in metas:
+            schema = json.loads(m.schema_json)
+            schemas[m.path] = schema
+            table: dict = {}
+            _collect_named(schema, table)
+            named[m.path] = table
+        ctx.schemas = schemas
+        ctx.named = named
+    return ctx
+
+
+def _read_range(plan: ChunkPlan) -> bytes:
+    with open(plan.path, "rb") as f:
+        f.seek(plan.byte_start)
+        raw = f.read(plan.nbytes)
+    if len(raw) != plan.nbytes:
+        raise ChunkDecodeError(
+            plan.path, plan.index,
+            f"short read ({len(raw)}/{plan.nbytes} bytes) — file changed "
+            "since planning?",
+        )
+    return raw
+
+
+def decode_chunk(
+    ctx: DecodeContext, plan: ChunkPlan, buf: StagingBuffer, grow: GrowFn
+) -> None:
+    """Decode ``plan``'s byte range into ``buf`` (padded, finalized)."""
+    raw = _read_range(plan)
+    if ctx.use_native:
+        raw_nnz = _decode_native(ctx, plan, raw, buf, grow)
+    else:
+        raw_nnz = _decode_python(ctx, plan, raw, buf, grow)
+    _finalize(ctx, plan, buf, raw_nnz)
+    buf.plan = plan
+
+
+# ---------------------------------------------------------------------------
+# native path
+# ---------------------------------------------------------------------------
+
+
+def _decode_native(
+    ctx: DecodeContext, plan: ChunkPlan, raw: bytes, buf: StagingBuffer,
+    grow: GrowFn,
+) -> list[int]:
+    from photon_ml_tpu.data.avro_native import _decode_vocab, _lib
+
+    lib = _lib()
+    meta = ctx.metas[plan.path]
+    data = np.frombuffer(raw, np.uint8)
+    sync = np.frombuffer(meta.sync, np.uint8)
+    handle = lib.avro_parse(
+        data, len(data), 0, sync,
+        1 if meta.codec == "deflate" else 0,
+        ctx.programs[plan.path], len(ctx.programs[plan.path]),
+        len(ctx.shard_names),
+        ctx.feat_bytes, ctx.feat_offs, ctx.feat_ids, ctx.shard_key_counts,
+        len(ctx.id_columns), ctx.id_blob, ctx.id_offs,
+        1,  # parallelism lives ACROSS workers; one thread per chunk
+    )
+    if not handle:
+        raise ChunkDecodeError(
+            plan.path, plan.index, lib.avro_last_error().decode()
+        )
+    try:
+        n = int(lib.avro_rows(handle))
+        if n != plan.n_rows:
+            raise ChunkDecodeError(
+                plan.path, plan.index,
+                f"decoded {n} rows but the plan promised {plan.n_rows}",
+            )
+        buf.reset_rows(n)
+        lib.avro_fill_scalars(
+            handle, buf.scratch_labels, buf.scratch_offsets,
+            buf.scratch_weights, buf.label_seen,
+        )
+        raw_nnz: list[int] = []
+        for si in range(len(ctx.shard_names)):
+            nnz = int(lib.avro_shard_nnz(handle, si))
+            if nnz > buf.shards[si].raw_cap:
+                grow(buf, si, nnz, 0)
+            st = buf.shards[si]
+            lib.avro_fill_coo(
+                handle, si, st.scratch_vals[:nnz], st.scratch_rows[:nnz],
+                st.scratch_cols[:nnz],
+            )
+            raw_nnz.append(nnz)
+        buf.id_vocabs = []
+        for ci in range(len(ctx.id_columns)):
+            codes = buf.id_codes[ci][:n]
+            nb = lib.avro_id_vocab_bytes(handle, ci)
+            nv = lib.avro_id_vocab_size(handle, ci)
+            blob = np.empty(nb, np.uint8)
+            offs = np.empty(nv + 1, np.int64)
+            lib.avro_fill_ids(handle, ci, codes, blob, offs)
+            if np.any(codes < 0):
+                bad = int(np.argmax(codes < 0))
+                raise ChunkDecodeError(
+                    plan.path, plan.index,
+                    f"record {bad} lacks id column "
+                    f"'{ctx.id_columns[ci]}' (top-level field or "
+                    "metadataMap entry)",
+                )
+            buf.id_vocabs.append(_decode_vocab(blob, offs))
+    finally:
+        lib.avro_free(handle)
+    return raw_nnz
+
+
+# ---------------------------------------------------------------------------
+# pure-python fallback path
+# ---------------------------------------------------------------------------
+
+
+def _decode_python(
+    ctx: DecodeContext, plan: ChunkPlan, raw: bytes, buf: StagingBuffer,
+    grow: GrowFn,
+) -> list[int]:
+    from photon_ml_tpu.data.avro import _Reader, _decode
+
+    meta = ctx.metas[plan.path]
+    schema = ctx.schemas[plan.path]
+    named = ctx.named[plan.path]
+    imaps = [ctx.index_maps[s] for s in ctx.shard_names]
+    bags = [ctx.feature_shards[s] for s in ctx.shard_names]
+
+    buf.reset_rows(plan.n_rows)
+    cursors = [0] * len(ctx.shard_names)
+    interns: list[dict] = [{} for _ in ctx.id_columns]
+    row = 0
+    r = _Reader(raw)
+    while r.pos < len(raw):
+        n_block = r.read_long()
+        size = r.read_long()
+        payload = r.read_fixed(size)
+        if meta.codec == "deflate":
+            payload = zlib.decompress(payload, -15)
+        if r.read_fixed(16) != meta.sync:
+            raise ChunkDecodeError(
+                plan.path, plan.index, "sync marker mismatch (corrupt block)"
+            )
+        br = _Reader(payload)
+        for _ in range(n_block):
+            if row >= plan.n_rows:
+                raise ChunkDecodeError(
+                    plan.path, plan.index,
+                    f"more rows than the plan's {plan.n_rows}",
+                )
+            rec = _decode(br, schema, named)
+            label = rec.get("label")
+            buf.label_seen[row] = 0 if label is None else 1
+            buf.scratch_labels[row] = 0.0 if label is None else float(label)
+            off = rec.get("offset")
+            buf.scratch_offsets[row] = 0.0 if off is None else float(off)
+            wgt = rec.get("weight")  # explicit 0.0 weights must survive
+            buf.scratch_weights[row] = 1.0 if wgt is None else float(wgt)
+            meta_map = rec.get("metadataMap") or {}
+            for ci, c in enumerate(ctx.id_columns):
+                v = rec.get(c)
+                if v is None:  # absent/null top-level field -> metadataMap
+                    v = meta_map.get(c)
+                if v is None:
+                    raise ChunkDecodeError(
+                        plan.path, plan.index,
+                        f"record {row} lacks id column '{c}' (top-level "
+                        "field or metadataMap entry)",
+                    )
+                table = interns[ci]
+                code = table.get(v)
+                if code is None:
+                    code = len(table)
+                    table[v] = code
+                buf.id_codes[ci, row] = code
+            for si, shard_bags in enumerate(bags):
+                st = buf.shards[si]
+                cur = cursors[si]
+                imap = imaps[si]
+                for bag in shard_bags:
+                    for f in rec.get(bag) or ():
+                        idx = imap.get(feature_key(f["name"], f["term"]))
+                        if idx >= 0:
+                            if cur >= st.raw_cap:
+                                grow(buf, si, cur + 1, cur)
+                                st = buf.shards[si]
+                            st.scratch_vals[cur] = float(f["value"])
+                            st.scratch_rows[cur] = row
+                            st.scratch_cols[cur] = idx
+                            cur += 1
+                cursors[si] = cur
+            row += 1
+    if row != plan.n_rows:
+        raise ChunkDecodeError(
+            plan.path, plan.index,
+            f"decoded {row} rows but the plan promised {plan.n_rows}",
+        )
+    buf.id_vocabs = [
+        np.asarray(list(table)) for table in interns
+    ]
+    return cursors
+
+
+# ---------------------------------------------------------------------------
+# shared finalize: casts + intercept interleave into the padded layout
+# ---------------------------------------------------------------------------
+
+
+def _interleave_intercept_into(
+    vals: np.ndarray, rws: np.ndarray, cls: np.ndarray, nnz: int, n: int,
+    icept: int, out_v: np.ndarray, out_r: np.ndarray, out_c: np.ndarray,
+) -> int:
+    """The one-shot reader's O(nnz) sorted intercept merge, writing into
+    pre-allocated f32/i32 output arrays: one intercept nnz lands right
+    after each row's features, so the result STAYS row-sorted."""
+    dest = np.arange(nnz) + rws[:nnz]
+    out_v[dest] = vals[:nnz]
+    out_r[dest] = rws[:nnz]
+    out_c[dest] = cls[:nnz]
+    idest = (
+        np.searchsorted(rws[:nnz], np.arange(n), side="right") + np.arange(n)
+    )
+    out_v[idest] = 1.0
+    out_r[idest] = np.arange(n)
+    out_c[idest] = icept
+    return nnz + n
+
+
+def _finalize(
+    ctx: DecodeContext, plan: ChunkPlan, buf: StagingBuffer,
+    raw_nnz: Sequence[int],
+) -> None:
+    n = plan.n_rows
+    if ctx.is_response_required:
+        missing = buf.label_seen[:n] == 0
+        if np.any(missing):
+            bad = int(np.argmax(missing))
+            raise ChunkDecodeError(
+                plan.path, plan.index,
+                f"record {bad} of the chunk (global row "
+                f"{plan.row_start + bad}) has no label",
+            )
+    # f64 scratch -> padded f32 layout (casting assignment, no alloc)
+    buf.labels[:n] = buf.scratch_labels[:n]
+    buf.offsets[:n] = buf.scratch_offsets[:n]
+    buf.weights[:n] = buf.scratch_weights[:n]
+    for si, nnz in enumerate(raw_nnz):
+        st = buf.shards[si]
+        icept = ctx.intercept_cols[si]
+        if icept >= 0:
+            used = _interleave_intercept_into(
+                st.scratch_vals, st.scratch_rows, st.scratch_cols, nnz, n,
+                icept, st.values, st.rows, st.cols,
+            )
+        else:
+            st.values[:nnz] = st.scratch_vals[:nnz]
+            st.rows[:nnz] = st.scratch_rows[:nnz]
+            st.cols[:nnz] = st.scratch_cols[:nnz]
+            used = nnz
+        # padded tail: value 0 (inert everywhere), rows at the last padded
+        # row (keeps `rows` non-decreasing), col 0 — SparseBatch convention
+        st.values[used:] = 0.0
+        st.rows[used:] = buf.rows_cap - 1
+        st.cols[used:] = 0
+        st.nnz_used = used

@@ -205,6 +205,13 @@ class MetricsRegistry:
                 g = self._gauges[name] = Gauge(name, self._lock)
             return g
 
+    def peek_gauge(self, name: str) -> Optional[float]:
+        """A gauge's value WITHOUT registering it (same "absence stays
+        unknown" contract as :meth:`peek_counter`)."""
+        with self._lock:
+            g = self._gauges.get(name)
+            return None if g is None else g.value
+
     def histogram(self, name: str) -> Histogram:
         with self._lock:
             h = self._histograms.get(name)
@@ -255,6 +262,7 @@ REGISTRY = MetricsRegistry()
 counter = REGISTRY.counter
 peek_counter = REGISTRY.peek_counter
 gauge = REGISTRY.gauge
+peek_gauge = REGISTRY.peek_gauge
 histogram = REGISTRY.histogram
 snapshot = REGISTRY.snapshot
 flush_jsonl = REGISTRY.flush_jsonl

@@ -84,6 +84,7 @@ class Heartbeat:
         self._last_flops = 0.0
         self._last_xla_bytes = 0.0
         self._last_comms = 0.0
+        self._last_ingest_rows = 0.0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -103,6 +104,9 @@ class Heartbeat:
                 metrics.peek_counter("xla.bytes_total") or 0.0
             )
             self._last_comms = metrics.peek_counter("comms.bytes_total") or 0.0
+            self._last_ingest_rows = (
+                metrics.peek_counter("ingest.rows") or 0.0
+            )
         self._thread = threading.Thread(
             target=self._run, name="photon-heartbeat", daemon=True
         )
@@ -178,6 +182,15 @@ class Heartbeat:
             d_comms = comms - self._last_comms
             self._last_flops, self._last_xla_bytes = flops, xla_bytes
             self._last_comms = comms
+            # ingest pipeline liveness (peek: absence stays "unknown")
+            ingest_rows = metrics.peek_counter("ingest.rows")
+            d_ingest = (
+                None
+                if ingest_rows is None
+                else ingest_rows - self._last_ingest_rows
+            )
+            if ingest_rows is not None:
+                self._last_ingest_rows = ingest_rows
             sink = self.jsonl_path
 
         # everything below reads device/metrics state, not heartbeat
@@ -198,6 +211,20 @@ class Heartbeat:
             line["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
             if "bytes_limit" in stats:
                 line["hbm_bytes_limit"] = int(stats["bytes_limit"])
+        if d_ingest is not None:
+            # how fast data is entering the device vs how often the solve
+            # had to wait for it — the live form of the RunReport
+            # "Ingestion" section
+            line["ingest_rows_per_s"] = round(d_ingest / dt, 1)
+            depth = metrics.peek_gauge("ingest.queue_depth")
+            if depth is not None:
+                line["ingest_queue_depth"] = int(depth)
+            stalls = metrics.peek_counter("ingest.stalls")
+            if stalls:
+                line["ingest_stalls"] = int(stalls)
+            waits = metrics.peek_counter("ingest.solve_waits")
+            if waits:
+                line["ingest_solve_waits"] = int(waits)
         spread = memory.device_spread_bytes()
         if spread is not None:
             # shard imbalance signal: max-min HBM in use across the mesh

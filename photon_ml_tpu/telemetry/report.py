@@ -409,6 +409,9 @@ class RunReport:
         recompiles = counters.get("xla.recompiles")
         if recompiles:
             out["xla_recompiles"] = float(recompiles)
+        ingest_rate = gauges.get("ingest.rows_per_sec")
+        if ingest_rate is not None:
+            out["ingest_rows_per_sec"] = float(ingest_rate)
         du = self.device_utilization()
         if du is not None and du.get("mfu") is not None:
             out["mfu"] = float(du["mfu"])
@@ -711,6 +714,7 @@ class RunReport:
             "coordinates": self.coordinate_summary(),
             "sweep": self.sweep_summary(),
             "device_utilization": self.device_utilization(),
+            "ingestion": self.ingestion_summary(),
             "counters": counters,
             "gauges": self.snapshot.get("gauges", {}),
             "histograms": self.snapshot.get("histograms", {}),
@@ -774,6 +778,7 @@ class RunReport:
 
         lines += self._device_utilization_markdown()
         lines += self._accounting_markdown()
+        lines += self._ingestion_markdown()
         lines += self._memory_markdown()
         lines += self._coordinates_markdown()
         lines += self._sweep_markdown()
@@ -917,6 +922,84 @@ class RunReport:
         ]
         for name, value, extra in rows:
             out.append(f"| `{name}` | {_fmt(value)} | {extra} |")
+        out.append("")
+        return out
+
+    def ingestion_summary(self) -> Optional[dict[str, Any]]:
+        """Ingest-pipeline accounting, or None when no stream ran.
+
+        The headline is ``solve_waits``/``solve_wait_seconds``: whether
+        (and for how long) the SOLVE ever waited on data after warm-up —
+        zero means the decode/upload/solve overlap fully hid ingestion;
+        a large fraction of the chunks means the fit is ingest-bound and
+        needs more decode workers or deeper prefetch.
+        """
+        c = self.snapshot.get("counters", {})
+        g = self.snapshot.get("gauges", {})
+        h = self.snapshot.get("histograms", {})
+        if "ingest.chunks" not in c and "ingest.rows" not in c:
+            return None
+        wait = h.get("ingest.solve_wait_s") or {}
+        out: dict[str, Any] = {
+            "rows": c.get("ingest.rows"),
+            "chunks": c.get("ingest.chunks"),
+            "rows_per_sec": g.get("ingest.rows_per_sec"),
+            "stalls": c.get("ingest.stalls", 0),
+            "buffer_growths": c.get("ingest.buffer_growths", 0),
+            "solve_waits": c.get("ingest.solve_waits", 0),
+            "solve_wait_seconds": (
+                round(wait["mean"] * wait["count"], 6)
+                if wait.get("count") and wait.get("mean") is not None
+                else 0.0
+            ),
+            "staging_bytes": g.get("ingest.staging_bytes"),
+            "queue_depth_last": g.get("ingest.queue_depth"),
+        }
+        return out
+
+    def _ingestion_markdown(self) -> list[str]:
+        ing = self.ingestion_summary()
+        if ing is None:
+            return []
+        out = ["## Ingestion", ""]
+        rows = ing.get("rows")
+        if rows is not None:
+            rate = ing.get("rows_per_sec")
+            out.append(
+                f"- streamed {int(rows)} rows in "
+                f"{int(ing.get('chunks') or 0)} chunks"
+                + (f" ({rate:,.0f} rows/s end-to-end)" if rate else "")
+            )
+        if ing.get("staging_bytes") is not None:
+            out.append(
+                "- host staging ring: "
+                f"{_fmt_bytes(ing['staging_bytes'])} resident"
+            )
+        waits = int(ing.get("solve_waits") or 0)
+        if waits:
+            out.append(
+                f"- **the solve waited on data {waits} time(s)** "
+                f"({ing['solve_wait_seconds']:.3f} s total) — the fit is "
+                "(partly) ingest-bound; add decode workers or prefetch "
+                "depth"
+            )
+        else:
+            out.append(
+                "- the solve never waited on data after warm-up — "
+                "decode + upload fully overlapped the compute"
+            )
+        stalls = int(ing.get("stalls") or 0)
+        if stalls:
+            out.append(
+                f"- **{stalls} pipeline stall(s)** (`ingest.stalls`) — "
+                "a stage hit its stall timeout"
+            )
+        growths = int(ing.get("buffer_growths") or 0)
+        if growths:
+            out.append(
+                f"- {growths} staging-buffer growth(s) — raise "
+                "`nnz_per_row_hint` to pre-size the ring exactly"
+            )
         out.append("")
         return out
 

@@ -373,3 +373,55 @@ def test_native_reader_missing_id_raises(tmp_path, rng):
     ])
     with pytest.raises(KeyError, match="userId"):
         A.read_game_dataset_from_avro(path, id_columns=("userId",))
+
+
+def test_env_toggle_hides_native_and_fallback_matches(
+    tmp_path, rng, monkeypatch
+):
+    """PHOTON_NO_NATIVE=1 is the supported way to force the pure-Python
+    reader: the native library must vanish immediately (no load-cache
+    staleness) and read_game_dataset_from_avro must produce identical
+    arrays through the fallback path."""
+    from photon_ml_tpu.data import avro as A
+    from photon_ml_tpu.data.native import load_native
+
+    def recs():
+        for i in range(120):
+            yield {
+                "uid": str(i),
+                "label": float(i % 2),
+                "features": [
+                    {"name": f"f{rng.integers(0, 25)}", "term": "",
+                     "value": float(rng.normal())}
+                    for _ in range(3)
+                ],
+                "metadataMap": {"userId": str(i % 7)},
+                "weight": None,
+                "offset": 0.5 if i % 4 == 0 else None,
+            }
+
+    path = str(tmp_path / "toggle.avro")
+    write_avro(path, TRAINING_EXAMPLE_AVRO, recs())
+    if load_native() is None:
+        pytest.skip("native toolchain unavailable")
+    ds_native, maps = A.read_game_dataset_from_avro(
+        path, id_columns=("userId",), return_index_maps=True
+    )
+
+    monkeypatch.setenv("PHOTON_NO_NATIVE", "1")
+    assert load_native() is None  # hidden immediately, not after a restart
+    ds_py = A.read_game_dataset_from_avro(
+        path, index_maps=maps, id_columns=("userId",)
+    )
+    np.testing.assert_array_equal(ds_py.response, ds_native.response)
+    np.testing.assert_array_equal(ds_py.offset, ds_native.offset)
+    np.testing.assert_array_equal(ds_py.weight, ds_native.weight)
+    a = ds_py.shard("features").to_dense()
+    b = ds_native.shard("features").to_dense()
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        ds_py.id_columns["userId"].codes, ds_native.id_columns["userId"].codes
+    )
+
+    monkeypatch.delenv("PHOTON_NO_NATIVE")
+    assert load_native() is not None  # and back, same process

@@ -1017,3 +1017,66 @@ class TestSweepGateRegistration:
             "photon_ml_tpu.sweep.select._sweep_evaluator.run",
         ):
             assert expected in roots, sorted(roots)
+
+
+def _ingest_stream_tree(worker_stmt: str, public_stmt: str) -> dict:
+    """A ChunkStream-shaped fixture: decode worker threads + a public
+    iterator API sharing pipeline state — the exact class shape the new
+    ingest subsystem introduces; L015 must cover it from day one."""
+    return {
+        "photon_ml_tpu/__init__.py": "",
+        "photon_ml_tpu/ingest/__init__.py": "",
+        "photon_ml_tpu/ingest/pipeline.py": (
+            "import threading\n\n\n"
+            "class ChunkStream:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._queue_depth = 0\n"
+            "        self._threads = []\n\n"
+            "    def start(self):\n"
+            "        t = threading.Thread(target=self._decode_loop)\n"
+            "        self._threads.append(t)\n"
+            "        t.start()\n\n"
+            "    def _decode_loop(self):\n"
+            f"        {worker_stmt}\n\n"
+            "    def __next__(self):\n"
+            f"        {public_stmt}\n"
+        ),
+    }
+
+
+class TestLockDisciplineIngestL015:
+    def test_unlocked_decode_worker_attr_flagged(self, tmp_path):
+        """An attribute written by both a decode worker thread and the
+        ChunkStream public iterator without a lock is an L015 finding
+        naming the attribute and both sides."""
+        res = analyze(
+            tmp_path,
+            _ingest_stream_tree(
+                "self._queue_depth += 1", "self._queue_depth -= 1"
+            ),
+        )
+        assert codes(res.findings) == ["L015"]
+        f = res.findings[0]
+        assert "`self._queue_depth`" in f.message
+        assert "ChunkStream" in f.message
+        assert "_decode_loop" in f.message
+
+    def test_locked_both_sides_clean(self, tmp_path):
+        res = analyze(
+            tmp_path,
+            _ingest_stream_tree(
+                "with self._lock:\n            self._queue_depth += 1",
+                "with self._lock:\n            self._queue_depth -= 1",
+            ),
+        )
+        assert res.findings == []
+
+    def test_real_ingest_package_is_in_scope(self):
+        """The shipped photon_ml_tpu/ingest/ package must be inside the
+        L011 hot scope (which seeds the interprocedural jit pass) so its
+        device programs stay accounted."""
+        from tools.analysis import local
+
+        rel = os.path.join("photon_ml_tpu", "ingest", "pipeline.py")
+        assert local.is_l011_hot(rel)

@@ -402,3 +402,84 @@ def test_cli_checkpoint_and_guard_config_parsing():
     assert _parse_guard_spec({"guard": {"max_retries": 5}}).max_retries == 5
     with pytest.raises(ValueError, match="unknown guard"):
         _parse_guard_spec({"guard": {"retries": 5}})
+
+
+# ---------------------------------------------------------------------------
+# StreamingCheckpointManager (chunk-boundary checkpoints, ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_manager_restore_falls_back_past_corrupt(tmp_path):
+    import numpy as np
+
+    from photon_ml_tpu.game.checkpoint import (
+        StreamCheckpointState,
+        StreamingCheckpointManager,
+    )
+
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(directory=str(tmp_path), keep_last=5)
+    )
+    for next_chunk in (1, 2, 3):
+        mgr.save(
+            StreamCheckpointState(
+                next_chunk=next_chunk,
+                coefficients=np.full((4, 3), float(next_chunk)),
+            )
+        )
+    # corrupt the newest: truncate its manifest
+    newest = tmp_path / "chunk-00000003" / "manifest.json"
+    newest.write_text("{not json")
+    state = mgr.restore()
+    assert state is not None and state.next_chunk == 2
+    np.testing.assert_array_equal(
+        state.coefficients, np.full((4, 3), 2.0)
+    )
+
+
+def test_streaming_manager_retention_and_fresh_fit(tmp_path):
+    import numpy as np
+
+    from photon_ml_tpu.game.checkpoint import (
+        StreamCheckpointState,
+        StreamingCheckpointManager,
+    )
+
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(directory=str(tmp_path), keep_last=2)
+    )
+    for next_chunk in range(1, 6):
+        mgr.save(
+            StreamCheckpointState(
+                next_chunk=next_chunk, coefficients=np.zeros((2, 2))
+            )
+        )
+    kept = sorted(p.name for p in tmp_path.iterdir())
+    assert kept == ["chunk-00000004", "chunk-00000005"]
+    # resume=False clears the directory for a fresh fit
+    fresh = StreamingCheckpointManager(
+        CheckpointSpec(directory=str(tmp_path), resume=False)
+    )
+    assert fresh.restore() is None
+    assert not any(p.name.startswith("chunk-") for p in tmp_path.iterdir())
+
+
+def test_streaming_manager_rejects_shape_mismatch(tmp_path):
+    import json
+
+    import numpy as np
+
+    from photon_ml_tpu.game.checkpoint import (
+        StreamCheckpointState,
+        StreamingCheckpointManager,
+    )
+
+    mgr = StreamingCheckpointManager(CheckpointSpec(directory=str(tmp_path)))
+    mgr.save(
+        StreamCheckpointState(next_chunk=1, coefficients=np.zeros((4, 3)))
+    )
+    manifest = tmp_path / "chunk-00000001" / "manifest.json"
+    doc = json.loads(manifest.read_text())
+    doc["dim"] = 999  # lie about the shape
+    manifest.write_text(json.dumps(doc))
+    assert mgr.restore() is None  # skipped as corrupt, no newer fallback

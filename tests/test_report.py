@@ -725,3 +725,62 @@ def test_gate_sweep_ratio_is_lower_is_better(capsys):
     err = capsys.readouterr().err
     assert rc == 0
     assert "overlap_factor: new metric" in err
+
+
+def test_report_ingestion_section_round_trip():
+    """The RunReport "Ingestion" section answers the one operational
+    question: did the solve ever wait on data?"""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    telemetry.metrics.counter("ingest.rows").inc(120_000)
+    telemetry.metrics.counter("ingest.chunks").inc(12)
+    telemetry.metrics.gauge("ingest.rows_per_sec").set(1.2e6)
+    telemetry.metrics.gauge("ingest.staging_bytes").set(64 * 2**20)
+    live = RunReport.from_live()
+    ing = live.ingestion_summary()
+    assert ing["rows"] == 120_000
+    assert ing["chunks"] == 12
+    assert ing["solve_waits"] == 0
+    md = live.to_markdown()
+    assert "## Ingestion" in md
+    assert "never waited on data" in md
+    assert live.key_metrics()["ingest_rows_per_sec"] == 1.2e6
+    assert live.to_json()["ingestion"]["rows"] == 120_000
+
+    # now the ingest-bound variant
+    telemetry.metrics.counter("ingest.solve_waits").inc(5)
+    telemetry.metrics.histogram("ingest.solve_wait_s").observe_many(
+        [0.1] * 5
+    )
+    md2 = RunReport.from_live().to_markdown()
+    assert "waited on data 5 time(s)" in md2
+
+
+def test_report_without_ingest_has_no_section():
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    live = RunReport.from_live()
+    assert live.ingestion_summary() is None
+    assert "## Ingestion" not in live.to_markdown()
+    assert "ingest_rows_per_sec" not in live.key_metrics()
+
+
+def test_heartbeat_ingest_fields():
+    """Heartbeats surface live ingest throughput — and only when an
+    ingest pipeline actually ran (absence stays unknown, never zero)."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry.progress import Heartbeat
+
+    hb = Heartbeat(interval=60)
+    line = hb.beat()
+    assert "ingest_rows_per_s" not in line  # no pipeline: no field
+    telemetry.metrics.counter("ingest.rows").inc(50_000)
+    telemetry.metrics.gauge("ingest.queue_depth").set(2)
+    line = hb.beat()
+    assert line["ingest_rows_per_s"] > 0
+    assert line["ingest_queue_depth"] == 2
+    assert "ingest_stalls" not in line  # zero stalls: field omitted
+    telemetry.metrics.counter("ingest.stalls").inc()
+    line = hb.beat()
+    assert line["ingest_stalls"] == 1

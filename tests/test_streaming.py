@@ -419,3 +419,135 @@ def test_streaming_prefetch_arms_match(rng):
         return table.to_numpy()
 
     np.testing.assert_allclose(run(True), run(False), atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# chunk-boundary checkpointing + graceful preemption (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def _stream_fit_chunks(rng, n_ent=16, rows=8, k=4, n_chunks=4):
+    X, y = _chunked_entities(rng, n_ent=n_ent, rows=rows, k=k)
+    per = n_ent // n_chunks
+
+    def host_chunk(lo, hi):
+        return DenseBatch(
+            x=X[lo:hi].astype(np.float32),
+            labels=y[lo:hi].astype(np.float32),
+            offsets=np.zeros((hi - lo, rows), np.float32),
+            weights=np.ones((hi - lo, rows), np.float32),
+        )
+
+    return [
+        (i * per, host_chunk(i * per, (i + 1) * per))
+        for i in range(n_chunks)
+    ], (n_ent, k)
+
+
+def test_streaming_checkpoint_roundtrip_and_resume(rng, tmp_path):
+    """A streamed fit checkpoints at chunk boundaries; a resumed fit
+    (restore table + start_chunk) reproduces the uninterrupted result
+    exactly — the deterministic chunk order replays the same stream."""
+    from photon_ml_tpu.game.checkpoint import (
+        CheckpointSpec,
+        StreamingCheckpointManager,
+    )
+
+    chunks, (n_ent, k) = _stream_fit_chunks(rng)
+    trainer = StreamingRandomEffectTrainer("logistic", _CFG)
+
+    # uninterrupted reference
+    ref = ShardedCoefficientTable(n_ent, k)
+    trainer.train(ref, chunks)
+    expected = ref.to_numpy()
+
+    # first run: solve only the first two chunks, checkpoint each
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(directory=str(tmp_path / "ckpt"), every=1)
+    )
+    table = ShardedCoefficientTable(n_ent, k)
+    trainer.train(table, chunks[:2], checkpointer=mgr)
+    state = mgr.restore()
+    assert state is not None and state.next_chunk == 2
+
+    # resume into a FRESH process-analog: new table seeded from the
+    # checkpoint, stream replayed from the next chunk boundary
+    table2 = ShardedCoefficientTable(n_ent, k)
+    table2.write_chunk(0, jnp.asarray(state.coefficients))
+    trainer.train(
+        table2, chunks, checkpointer=mgr, start_chunk=state.next_chunk
+    )
+    np.testing.assert_array_equal(table2.to_numpy(), expected)
+
+
+def test_streaming_sigterm_checkpoints_and_resume_replays(rng, tmp_path):
+    """SIGTERM mid-stream: the trainer finishes the in-flight chunk,
+    writes a final checkpoint, raises TrainingInterrupted; the resumed
+    run replays from the next chunk boundary and matches the
+    uninterrupted fit exactly."""
+    import signal
+
+    from photon_ml_tpu.game.checkpoint import (
+        CheckpointSpec,
+        GracefulStop,
+        StreamingCheckpointManager,
+        TrainingInterrupted,
+    )
+
+    chunks, (n_ent, k) = _stream_fit_chunks(rng)
+    trainer = StreamingRandomEffectTrainer("logistic", _CFG,
+                                           prefetch=False)
+    ref = ShardedCoefficientTable(n_ent, k)
+    trainer.train(ref, chunks)
+    expected = ref.to_numpy()
+
+    # chunk 1's source raises SIGTERM while "decoding" — the preemption
+    # arrives mid-stream, not between runs
+    fired = {}
+
+    def preempting_source(batch=chunks[1][1]):
+        if not fired.get("yes"):
+            fired["yes"] = True
+            signal.raise_signal(signal.SIGTERM)
+        return jax.tree.map(jnp.asarray, batch)
+
+    preempt_chunks = [chunks[0], (chunks[1][0], preempting_source),
+                      *chunks[2:]]
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(directory=str(tmp_path / "ckpt"), every=10)
+    )
+    table = ShardedCoefficientTable(n_ent, k)
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        stop = GracefulStop().install(signums=(signal.SIGTERM,))
+        with pytest.raises(TrainingInterrupted) as ei:
+            trainer.train(
+                table, preempt_chunks, should_stop=stop, checkpointer=mgr
+            )
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    # the in-flight chunk was finished and certified before exiting
+    assert ei.value.checkpoint_path is not None
+    state = mgr.restore()
+    assert state is not None
+    assert state.next_chunk == ei.value.step + 1
+    assert 0 < state.next_chunk < len(chunks)  # genuinely mid-stream
+
+    table2 = ShardedCoefficientTable(n_ent, k)
+    table2.write_chunk(0, jnp.asarray(state.coefficients))
+    trainer2 = StreamingRandomEffectTrainer("logistic", _CFG)
+    trainer2.train(table2, chunks, start_chunk=state.next_chunk)
+    np.testing.assert_array_equal(table2.to_numpy(), expected)
+
+
+def test_streaming_stop_without_checkpointer_still_interrupts(rng):
+    chunks, (n_ent, k) = _stream_fit_chunks(rng)
+    from photon_ml_tpu.game.checkpoint import TrainingInterrupted
+
+    trainer = StreamingRandomEffectTrainer("logistic", _CFG,
+                                           prefetch=False)
+    table = ShardedCoefficientTable(n_ent, k)
+    with pytest.raises(TrainingInterrupted) as ei:
+        trainer.train(table, chunks, should_stop=lambda: True)
+    assert ei.value.checkpoint_path is None
+    assert ei.value.step == 0  # stopped at the first boundary

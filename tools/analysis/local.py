@@ -63,6 +63,11 @@ L011_HOT_DIRS = (
     # a bare jax.jit there hides exactly the multi-config warmup the
     # recompile-storm gate needs multi_shape attribution for
     os.path.join("photon_ml_tpu", "sweep") + os.sep,
+    # the ingest pipeline's assembler writes every chunk through donated
+    # device programs, and its uploader feeds every training batch — a
+    # bare jax.jit there (and any sync reachable from it, L013) would be
+    # invisible on exactly the path the overlap benches gate
+    os.path.join("photon_ml_tpu", "ingest") + os.sep,
 )
 L011_HOT_FILES = {
     os.path.join("photon_ml_tpu", "serving", "engine.py"),
