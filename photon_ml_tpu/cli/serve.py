@@ -70,6 +70,11 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     setup_logging()
+    from photon_ml_tpu import faults
+
+    # a serving process with an armed fault plan WILL fail requests on
+    # purpose — say so at startup, loudly
+    faults.warn_if_armed()
     from photon_ml_tpu.serving import (
         ModelRegistry,
         ScoringEngine,

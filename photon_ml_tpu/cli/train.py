@@ -33,7 +33,7 @@ from typing import Mapping, Optional
 
 import numpy as np
 
-from photon_ml_tpu import telemetry
+from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.config import parse_game_config
 from photon_ml_tpu.game.checkpoint import (
     CheckpointSpec,
@@ -358,6 +358,9 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     with ``"interrupted": true`` in the summary (graceful preemption). The
     ``guard`` object (on by default) retries diverging solves with
     escalating L2 damping and rolls back solves that stay divergent."""
+    # an armed PHOTON_FAULT_PLAN must be LOUD: this run will fail on
+    # purpose (chaos harness subprocesses arm themselves this way)
+    faults.warn_if_armed()
     game_config = parse_game_config(config)
     output_dir = output_dir or config.get("output_dir")
     trace_out = config.get("trace_out")

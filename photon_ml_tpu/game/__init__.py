@@ -3,7 +3,10 @@ from photon_ml_tpu.game.checkpoint import (  # noqa: F401
     CheckpointManager,
     CheckpointSpec,
     CheckpointState,
+    ElasticRestore,
     GracefulStop,
+    StreamCheckpointState,
+    StreamingCheckpointManager,
     TrainingInterrupted,
 )
 from photon_ml_tpu.game.coordinate_descent import (  # noqa: F401

@@ -39,7 +39,7 @@ import shutil
 import signal
 from typing import Optional
 
-from photon_ml_tpu import telemetry
+from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.game.models import GameModel
 from photon_ml_tpu.utils.atomic import atomic_write_json, fsync_dir
 
@@ -47,7 +47,35 @@ logger = logging.getLogger("photon_ml_tpu.game.checkpoint")
 
 _MANIFEST_FILE = "manifest.json"
 _FORMAT_VERSION = 1
+#: streaming manifests: 2 = per-shard payload files + sharding/env record
+#: (elastic restore); 1 = the legacy single coefficients.npy
+_STREAM_FORMAT_VERSION = 2
 _STEP_RE = re.compile(r"^step-(\d{8})$")
+
+# The atomic-write protocol's crash seams, one per phase — the crash
+# matrix (tools/chaos.py) kills a fit at each and asserts resume
+# reproduces the uninterrupted model. Shared by the step and streaming
+# managers: the protocol is identical.
+_FP_SAVE_BEFORE_TMP = faults.register_point(
+    "checkpoint.save.before_tmp", write_path=True,
+    description="before the .tmp- sibling is assembled (no trace on disk)",
+)
+_FP_SAVE_BEFORE_MANIFEST = faults.register_point(
+    "checkpoint.save.before_manifest", write_path=True,
+    description="payload written, manifest absent (tmp dir incomplete)",
+)
+_FP_SAVE_BEFORE_RENAME = faults.register_point(
+    "checkpoint.save.before_rename", write_path=True,
+    description="tmp dir complete but not yet renamed into place",
+)
+_FP_SAVE_AFTER_RENAME = faults.register_point(
+    "checkpoint.save.after_rename", write_path=True,
+    description="checkpoint durable; retention/fsync not yet run",
+)
+_FP_MANIFEST_READ = faults.register_point(
+    "checkpoint.manifest.read",
+    description="manifest open/parse during restore (corrupt-skip path)",
+)
 
 
 class CheckpointError(RuntimeError):
@@ -143,12 +171,14 @@ class CheckpointManager:
             self.spec.directory, f".tmp-{_step_dirname(state.step)}"
         )
         with telemetry.span("checkpoint:save", step=state.step):
+            faults.fault_point(_FP_SAVE_BEFORE_TMP)
             if os.path.exists(tmp):
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
             save_game_model(state.model, os.path.join(tmp, "model"))
             if state.best_model is not None:
                 save_game_model(state.best_model, os.path.join(tmp, "best"))
+            faults.fault_point(_FP_SAVE_BEFORE_MANIFEST)
             # the manifest lands LAST: its presence certifies completeness
             atomic_write_json(
                 os.path.join(tmp, _MANIFEST_FILE),
@@ -165,9 +195,11 @@ class CheckpointManager:
                 indent=2,
                 sort_keys=True,
             )
+            faults.fault_point(_FP_SAVE_BEFORE_RENAME)
             if os.path.exists(final):  # re-save of a step (resume overlap)
                 shutil.rmtree(final)
             os.rename(tmp, final)
+            faults.fault_point(_FP_SAVE_AFTER_RENAME)
             fsync_dir(self.spec.directory)
         telemetry.counter("checkpoint.saves").inc()
         telemetry.gauge("checkpoint.last_step").set(state.step)
@@ -214,6 +246,7 @@ class CheckpointManager:
         try:
             import json
 
+            faults.fault_point(_FP_MANIFEST_READ)
             with open(manifest_path) as f:
                 manifest = json.load(f)
         except FileNotFoundError:
@@ -275,14 +308,18 @@ class GracefulStop:
 
     The first signal requests a graceful stop: the training loop finishes
     its current step, writes a final checkpoint, and raises
-    :class:`TrainingInterrupted`. A second signal restores the previous
-    handler's behavior by re-raising KeyboardInterrupt immediately (an
-    operator mashing Ctrl-C still wins).
+    :class:`TrainingInterrupted`. A REPEATED signal is the escape hatch:
+    the process hard-exits with ``hard_exit_code`` (default 75, the same
+    "incomplete, restart me" code the graceful path uses) instead of
+    blocking behind a slow final-checkpoint write — a scheduler that
+    escalates SIGTERM gets its worker back immediately, and the
+    half-written ``.tmp-`` directory is skipped by the next restore.
     """
 
-    def __init__(self):
+    def __init__(self, hard_exit_code: int = 75):
         self.requested = False
         self.signum: Optional[int] = None
+        self.hard_exit_code = hard_exit_code
         self._installed = False
 
     def install(self, signums=(signal.SIGTERM, signal.SIGINT)) -> "GracefulStop":
@@ -293,7 +330,23 @@ class GracefulStop:
 
     def _handle(self, signum, frame):
         if self.requested:
-            raise KeyboardInterrupt
+            # ASYNC-SIGNAL-SAFE path only: the process is very possibly
+            # wedged behind the slow save this escape hatch exists for,
+            # and logger.warning/logging.shutdown can block on a handler
+            # lock held by a stuck background thread — which would turn
+            # "hard exit now" back into the hang we're escaping. A raw
+            # write(2) and _exit are the whole budget.
+            try:
+                os.write(
+                    2,
+                    b"second signal during graceful stop: hard exit "
+                    + str(self.hard_exit_code).encode()
+                    + b" (in-flight checkpoint write abandoned; its .tmp "
+                    b"directory is skipped on restore)\n",
+                )
+            except OSError:
+                pass
+            os._exit(self.hard_exit_code)
         self.requested = True
         self.signum = signum
         logger.warning(
@@ -317,12 +370,90 @@ _CHUNK_RE = re.compile(r"^chunk-(\d{8})$")
 class StreamCheckpointState:
     """Everything a streamed random-effect fit needs to continue: the
     NEXT chunk index to solve (the deterministic ingest planner replays
-    the same stream from that boundary) and the coefficient table rows
-    solved so far."""
+    the same stream from that boundary) and the coefficient table solved
+    so far.
+
+    ``coefficients``/``variances`` may be host numpy arrays OR device
+    ``jax.Array``s (possibly entity-sharded across a mesh) — pass the
+    table's live device array and the manager saves it SHARD BY SHARD,
+    never assembling the full table on the host."""
 
     next_chunk: int
-    coefficients: "object"  # np.ndarray [N, K]
+    coefficients: "object"  # np.ndarray or jax.Array, [N, K]
     variances: Optional["object"] = None
+
+
+@dataclasses.dataclass
+class ElasticRestore:
+    """A streaming checkpoint re-placed for THIS run's device topology.
+
+    ``coefficients``/``variances`` are device arrays placed via
+    ``parallel.sharding.place_entity_rows`` for whatever mesh the caller
+    passed — which need not match the mesh that wrote the checkpoint
+    (``elastic`` is True when it didn't: a mesh-shrunken resume after
+    device loss, or a single-device debug restore of a sharded run)."""
+
+    next_chunk: int
+    coefficients: "object"
+    variances: Optional["object"]
+    saved_sharding: Optional[dict]  # the writing run's manifest record
+    saved_env: Optional[dict]
+    elastic: bool
+
+
+def _environment_record() -> dict:
+    """The decode/topology environment a streaming checkpoint was written
+    under — recorded so a restore under a DIFFERENT environment (native
+    decoder toggled, fewer devices after a failure) can report the delta
+    instead of failing mysteriously."""
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        device_count = int(jax.device_count())
+    except Exception:  # pragma: no cover - jax always present in-tree
+        backend, device_count = "unknown", 0
+    return {
+        "no_native": os.environ.get("PHOTON_NO_NATIVE") == "1",
+        "backend": backend,
+        "device_count": device_count,
+    }
+
+
+def _entity_shard_parts(array) -> list:
+    """``(row_start, part)`` per DISTINCT addressable row range of an
+    entity-leading array, sorted by row start. ``part`` is a
+    ``jax.Array`` shard (``.data``) or the array itself (host/unsharded)
+    — callers fetch one part at a time, so peak host residency during a
+    sharded save is ONE shard, not the table."""
+    shards = getattr(array, "addressable_shards", None)
+    if not shards:
+        return [(0, array)]
+    by_start: dict[int, object] = {}
+    for s in shards:
+        lo = s.index[0].start or 0
+        # replicated placements repeat every range on every device;
+        # one copy per distinct range is the whole array
+        by_start.setdefault(int(lo), s)
+    return [(lo, by_start[lo]) for lo in sorted(by_start)]
+
+
+def _sharding_record(array) -> Optional[dict]:
+    """JSON-safe record of a device array's NamedSharding (mesh axis
+    sizes + partition spec), or None for host/unsharded arrays."""
+    sharding = getattr(array, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    spec = getattr(sharding, "spec", None)
+    if mesh is None or spec is None:
+        return None
+    try:
+        axes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    except (TypeError, ValueError):
+        return None
+    return {
+        "mesh_axes": axes,
+        "spec": [None if s is None else str(s) for s in spec],
+    }
 
 
 class StreamingCheckpointManager:
@@ -336,6 +467,16 @@ class StreamingCheckpointManager:
     from ``next_chunk`` and re-decodes exactly the rows the interrupted
     run would have seen, in the same order (ingest.planner's determinism
     contract).
+
+    **Sharding-aware**: a mesh-sharded coefficient table is saved one
+    payload file PER addressable shard (``coefficients-NNNN.npy``,
+    fetched one shard at a time — the 40 GB entity-sharded table from the
+    ``game_10B`` regime never exists on the host), and the manifest
+    records each file's row range plus the writing run's mesh shape,
+    partition spec, and environment. Restore is **elastic**:
+    :meth:`restore_placed` re-slices the entity axis onto ANY target mesh
+    (or none), so losing devices means a mesh-shrunken resume instead of
+    a dead run.
     """
 
     def __init__(self, spec: CheckpointSpec):
@@ -357,39 +498,73 @@ class StreamingCheckpointManager:
     def should_save(self, chunk_index: int) -> bool:
         return (chunk_index + 1) % self.spec.every == 0
 
-    def save(self, state: StreamCheckpointState) -> str:
+    def _write_entity_array(self, tmp: str, prefix: str, array) -> list[dict]:
+        """Write ``array`` as one .npy per distinct shard row range;
+        returns the manifest shard descriptors. Per-shard host fetches
+        only — counted so the no-full-gather property is assertable."""
         np = self._np
+        descriptors = []
+        max_bytes = 0
+        for i, (row_start, part) in enumerate(_entity_shard_parts(array)):
+            data = np.asarray(getattr(part, "data", part))
+            fname = f"{prefix}-{i:04d}.npy"
+            np.save(os.path.join(tmp, fname), data)
+            descriptors.append(
+                {
+                    "file": fname,
+                    "row_start": int(row_start),
+                    "rows": int(data.shape[0]),
+                }
+            )
+            telemetry.counter("checkpoint.shard_saves").inc()
+            max_bytes = max(max_bytes, int(data.nbytes))
+        # the largest single host fetch this save performed — a sharded
+        # table must stay at table_bytes / n_shards (the telemetry check
+        # the no-host-gather acceptance rides on)
+        telemetry.gauge("checkpoint.max_shard_fetch_bytes").set(max_bytes)
+        return descriptors
+
+    def save(self, state: StreamCheckpointState) -> str:
         name = f"chunk-{state.next_chunk:08d}"
         final = os.path.join(self.spec.directory, name)
         tmp = os.path.join(self.spec.directory, f".tmp-{name}")
+        coeffs = state.coefficients
+        num_entities, dim = (int(d) for d in coeffs.shape)
         with telemetry.span("checkpoint:save", next_chunk=state.next_chunk):
+            faults.fault_point(_FP_SAVE_BEFORE_TMP)
             if os.path.exists(tmp):
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
-            coeffs = np.asarray(state.coefficients)
-            np.save(os.path.join(tmp, "coefficients.npy"), coeffs)
+            shard_files = self._write_entity_array(tmp, "coefficients", coeffs)
+            variance_files = None
             if state.variances is not None:
-                np.save(
-                    os.path.join(tmp, "variances.npy"),
-                    np.asarray(state.variances),
+                variance_files = self._write_entity_array(
+                    tmp, "variances", state.variances
                 )
+            faults.fault_point(_FP_SAVE_BEFORE_MANIFEST)
             # manifest LAST: its presence certifies the directory complete
             atomic_write_json(
                 os.path.join(tmp, _MANIFEST_FILE),
                 {
-                    "format_version": _FORMAT_VERSION,
+                    "format_version": _STREAM_FORMAT_VERSION,
                     "kind": "streaming",
                     "next_chunk": int(state.next_chunk),
-                    "num_entities": int(coeffs.shape[0]),
-                    "dim": int(coeffs.shape[1]),
-                    "has_variances": state.variances is not None,
+                    "num_entities": num_entities,
+                    "dim": dim,
+                    "dtype": str(getattr(coeffs, "dtype", "float32")),
+                    "shards": shard_files,
+                    "variance_shards": variance_files,
+                    "sharding": _sharding_record(coeffs),
+                    "env": _environment_record(),
                 },
                 indent=2,
                 sort_keys=True,
             )
+            faults.fault_point(_FP_SAVE_BEFORE_RENAME)
             if os.path.exists(final):
                 shutil.rmtree(final)
             os.rename(tmp, final)
+            faults.fault_point(_FP_SAVE_AFTER_RENAME)
             fsync_dir(self.spec.directory)
         telemetry.counter("checkpoint.saves").inc()
         telemetry.gauge("checkpoint.last_save_ts").set(
@@ -418,12 +593,12 @@ class StreamingCheckpointManager:
                             os.path.join(self.spec.directory, name)))
         return sorted(out)
 
-    def _load(self, path: str) -> StreamCheckpointState:
+    def _read_manifest(self, path: str) -> dict:
         import json
 
-        np = self._np
         manifest_path = os.path.join(path, _MANIFEST_FILE)
         try:
+            faults.fault_point(_FP_MANIFEST_READ)
             with open(manifest_path) as f:
                 manifest = json.load(f)
         except FileNotFoundError:
@@ -434,46 +609,118 @@ class StreamingCheckpointManager:
             raise CheckpointError(
                 f"{manifest_path}: corrupt manifest ({e})"
             ) from None
-        if manifest.get("format_version") != _FORMAT_VERSION:
+        version = manifest.get("format_version")
+        if version not in (1, _STREAM_FORMAT_VERSION):
             raise CheckpointError(
-                f"{manifest_path}: unsupported format_version "
-                f"{manifest.get('format_version')!r}"
+                f"{manifest_path}: unsupported format_version {version!r}"
             )
         if manifest.get("kind") != "streaming":
             raise CheckpointError(
                 f"{manifest_path}: not a streaming checkpoint "
                 f"(kind={manifest.get('kind')!r})"
             )
-        try:
-            coeffs = np.load(os.path.join(path, "coefficients.npy"))
-        except (OSError, ValueError) as e:
+        return manifest
+
+    def _shard_descriptors(
+        self, path: str, manifest: dict, prefix: str
+    ) -> Optional[list[dict]]:
+        """Validated (file, row_start, rows) descriptors covering exactly
+        [0, num_entities), for v2 manifests; v1 synthesizes the single
+        legacy file. None when the payload is absent (variances)."""
+        n = int(manifest["num_entities"])
+        if manifest.get("format_version") == 1:
+            legacy = {"coefficients": "coefficients.npy",
+                      "variances": "variances.npy"}[prefix]
+            if prefix == "variances" and not manifest.get("has_variances"):
+                return None
+            return [{"file": legacy, "row_start": 0, "rows": n}]
+        key = "shards" if prefix == "coefficients" else "variance_shards"
+        descriptors = manifest.get(key)
+        if descriptors is None:
+            if prefix == "variances":
+                return None
+            raise CheckpointError(f"{path}: manifest lists no shards")
+        cursor = 0
+        for d in descriptors:
+            if int(d["row_start"]) != cursor:
+                raise CheckpointError(
+                    f"{path}: shard rows are not contiguous at "
+                    f"{d['row_start']} (expected {cursor})"
+                )
+            cursor += int(d["rows"])
+        if cursor != n:
             raise CheckpointError(
-                f"{path}: unreadable coefficients ({e})"
-            ) from None
-        if coeffs.shape != (
-            int(manifest["num_entities"]), int(manifest["dim"])
-        ):
-            raise CheckpointError(
-                f"{path}: coefficient shape {coeffs.shape} does not match "
-                "its manifest"
+                f"{path}: shards cover {cursor} rows but the manifest "
+                f"promises {n} entities"
             )
-        variances = None
-        if manifest.get("has_variances"):
+        return descriptors
+
+    def _row_reader(self, path: str, manifest: dict, prefix: str):
+        """A ``read_rows(lo, hi)`` over the (memory-mapped) shard files —
+        the lazy source ``parallel.sharding.place_entity_rows`` re-slices
+        for elastic placement. Shape/readability validated up front so a
+        corrupt directory is skippable before any placement happens."""
+        np = self._np
+        descriptors = self._shard_descriptors(path, manifest, prefix)
+        if descriptors is None:
+            return None
+        dim = int(manifest["dim"])
+        files = []
+        for d in descriptors:
+            fpath = os.path.join(path, d["file"])
             try:
-                variances = np.load(os.path.join(path, "variances.npy"))
+                arr = np.load(fpath, mmap_mode="r")
             except (OSError, ValueError) as e:
                 raise CheckpointError(
-                    f"{path}: unreadable variances ({e})"
+                    f"{fpath}: unreadable shard ({e})"
                 ) from None
+            if arr.shape != (int(d["rows"]), dim):
+                raise CheckpointError(
+                    f"{fpath}: shard shape {arr.shape} does not match its "
+                    f"manifest entry ({d['rows']}, {dim})"
+                )
+            files.append((int(d["row_start"]), int(d["rows"]), arr))
+
+        def read_rows(lo: int, hi: int):
+            pieces = [
+                arr[max(lo - start, 0): hi - start]
+                for start, rows, arr in files
+                if start < hi and start + rows > lo
+            ]
+            if len(pieces) == 1:
+                return np.asarray(pieces[0])
+            return np.concatenate([np.asarray(p) for p in pieces], axis=0)
+
+        return read_rows
+
+    def _load(self, path: str) -> StreamCheckpointState:
+        manifest = self._read_manifest(path)
+        np = self._np
+        n = int(manifest["num_entities"])
+        read_coeffs = self._row_reader(path, manifest, "coefficients")
+        read_vars = self._row_reader(path, manifest, "variances")
+        # owned copies, never memory-mapped views: a single-shard read is
+        # a view of the np.load(mmap_mode="r") file, and handing that to
+        # a caller who device_puts it zero-copy would alias the mapping
+        # (the place_entity_rows aliasing lesson — restore() callers by
+        # contract hold the whole table, so the copy is what they expect)
         return StreamCheckpointState(
             next_chunk=int(manifest["next_chunk"]),
-            coefficients=coeffs,
-            variances=variances,
+            coefficients=np.array(read_coeffs(0, n), copy=True),
+            variances=(
+                None if read_vars is None
+                else np.array(read_vars(0, n), copy=True)
+            ),
         )
 
     def restore(self) -> Optional[StreamCheckpointState]:
         """Newest VALID streaming checkpoint, or None; corrupt/partial
-        directories are skipped with a warning (``checkpoint.corrupt``)."""
+        directories are skipped with a warning (``checkpoint.corrupt``).
+
+        NOTE: materializes the FULL table on the host — fine for tables
+        that fit one process; sharded-only regimes use
+        :meth:`restore_placed`, which re-places shard files straight onto
+        the target mesh."""
         if not self.spec.resume:
             return None
         with telemetry.span("checkpoint:restore"):
@@ -493,3 +740,112 @@ class StreamingCheckpointManager:
                 )
                 return state
         return None
+
+    def restore_placed(
+        self, mesh=None, axis: Optional[str] = None
+    ) -> Optional[ElasticRestore]:
+        """Newest valid checkpoint, ELASTICALLY placed for ``mesh``.
+
+        The entity axis is re-sliced onto the target mesh's model axis
+        via ``parallel.sharding.place_entity_rows`` (per-device shard
+        reads over memory-mapped files — no full host materialization),
+        so a checkpoint written on ``model=8`` restores onto ``model=4``
+        or a single device: device loss degrades to a mesh-shrunken
+        resume. Falls back past corrupt directories exactly like
+        :meth:`restore`. Counts ``recovery.elastic_resumes`` when the
+        target topology differs from the writing run's."""
+        from photon_ml_tpu.parallel import sharding as psharding
+
+        if not self.spec.resume:
+            return None
+        with telemetry.span("checkpoint:restore", elastic=True):
+            for _c, path in reversed(self._chunk_dirs()):
+                try:
+                    manifest = self._read_manifest(path)
+                    read_coeffs = self._row_reader(
+                        path, manifest, "coefficients"
+                    )
+                    read_vars = self._row_reader(path, manifest, "variances")
+                    n = int(manifest["num_entities"])
+                    dim = int(manifest["dim"])
+                    dtype = manifest.get("dtype", "float32")
+                    coeffs = psharding.place_entity_rows(
+                        read_coeffs, n, (dim,), dtype, mesh=mesh, axis=axis
+                    )
+                    variances = None
+                    if read_vars is not None:
+                        variances = psharding.place_entity_rows(
+                            read_vars, n, (dim,), dtype, mesh=mesh, axis=axis
+                        )
+                except psharding.ElasticPlacementError:
+                    # a TOPOLOGY mismatch, not corruption: every older
+                    # checkpoint of this fit would fail identically, and
+                    # skipping them would silently discard valid training
+                    # progress behind a configuration error
+                    raise
+                except (CheckpointError, ValueError, OSError) as e:
+                    telemetry.counter("checkpoint.corrupt").inc()
+                    logger.warning(
+                        "skipping corrupt checkpoint %s: %s", path, e
+                    )
+                    continue
+                saved_sharding = manifest.get("sharding")
+                saved_env = manifest.get("env")
+                elastic = self._note_topology_delta(
+                    path, saved_sharding, saved_env, mesh, axis
+                )
+                telemetry.counter("checkpoint.restores").inc()
+                logger.info(
+                    "resuming streamed fit from %s (next chunk %d, "
+                    "elastic=%s)", path, int(manifest["next_chunk"]), elastic,
+                )
+                return ElasticRestore(
+                    next_chunk=int(manifest["next_chunk"]),
+                    coefficients=coeffs,
+                    variances=variances,
+                    saved_sharding=saved_sharding,
+                    saved_env=saved_env,
+                    elastic=elastic,
+                )
+        return None
+
+    def _note_topology_delta(
+        self, path, saved_sharding, saved_env, mesh, axis
+    ) -> bool:
+        """Compare the writing run's recorded topology/environment with
+        THIS restore's target; log the delta and count elastic resumes."""
+        from photon_ml_tpu.parallel import sharding as psharding
+
+        if mesh is None:
+            target_shards = 1
+        else:
+            resolved = axis or psharding.model_axis(mesh)
+            target_shards = (
+                psharding.axis_size(mesh, resolved) if resolved else 1
+            )
+        saved_shards = 1
+        if saved_sharding:
+            spec = [s for s in (saved_sharding.get("spec") or []) if s]
+            axes = saved_sharding.get("mesh_axes") or {}
+            if spec:
+                saved_shards = int(axes.get(spec[0], 1))
+        elastic = target_shards != saved_shards
+        if elastic:
+            telemetry.counter("recovery.elastic_resumes").inc()
+            logger.warning(
+                "elastic resume: %s was written across %d shard(s), "
+                "restoring across %d", path, saved_shards, target_shards,
+            )
+        env_now = _environment_record()
+        if saved_env and saved_env != env_now:
+            deltas = {
+                k: (saved_env.get(k), env_now.get(k))
+                for k in set(saved_env) | set(env_now)
+                if saved_env.get(k) != env_now.get(k)
+            }
+            logger.warning(
+                "restore environment differs from the writing run's "
+                "(%s: saved vs now %s) — shard files are "
+                "environment-independent, continuing", path, deltas,
+            )
+        return elastic

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu import telemetry
+from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.telemetry import memory as telemetry_memory
 from photon_ml_tpu.evaluation import EVALUATORS, better_than, sharded_auc, sharded_precision_at_k
 from photon_ml_tpu.evaluation.evaluators import parse_evaluator
@@ -36,9 +36,21 @@ from photon_ml_tpu.game.checkpoint import (
 )
 from photon_ml_tpu.game.dataset import GameDataset
 from photon_ml_tpu.game.models import GameModel
-from photon_ml_tpu.optim.guard import GuardSpec, model_is_finite
+from photon_ml_tpu.optim.guard import (
+    FP_SOLVE_HEALTH,
+    GuardSpec,
+    model_is_finite,
+)
 
 logger = logging.getLogger("photon_ml_tpu.game")
+
+# Injection seam between a completed (iteration, coordinate) step and its
+# checkpoint/stop handling — an injected raise here must leave the last
+# step's checkpoint intact and resumable.
+_FP_STEP_BOUNDARY = faults.register_point(
+    "cd.step.boundary",
+    description="after a CD step completes, before checkpoint/stop logic",
+)
 
 
 @dataclasses.dataclass
@@ -176,6 +188,9 @@ def _guarded_update(coord, model, residual, guard: GuardSpec, name: str):
         health = getattr(coord, "last_health", None)
         if health is None:
             health = model_is_finite(new_model)
+        # injection seam: a `nan` rule marks THIS solve diverged,
+        # exercising the damped-retry/rollback path deterministically
+        health = faults.corrupt_health(FP_SOLVE_HEALTH, health)
         if bool(telemetry.sync_fetch(health, label=f"guard:{name}")):
             return new_model, attempt, False
         telemetry.counter("solves.diverged").inc()
@@ -354,6 +369,7 @@ def run_coordinate_descent(
                 else:
                     consecutive_rollbacks[name] = 0
 
+                faults.fault_point(_FP_STEP_BOUNDARY)
                 stop = should_stop is not None and should_stop()
                 if checkpoint is not None and (
                     stop or checkpoint.should_save(step)

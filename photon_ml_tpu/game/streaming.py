@@ -44,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from photon_ml_tpu import telemetry
+from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.parallel import sharding as psharding
 from photon_ml_tpu.telemetry.xla import record_collective
 from photon_ml_tpu.telemetry import memory as telemetry_memory
@@ -56,6 +56,20 @@ from photon_ml_tpu.optim.guard import GuardSpec, damped_objective, solve_health
 Array = jax.Array
 
 logger = logging.getLogger("photon_ml_tpu.game.streaming")
+
+# fault-injection seams (photon_ml_tpu.faults): a chunk solve whose result
+# can be NaN-poisoned on demand (drives the guard's damped-retry/rollback
+# machinery deterministically) and the chunk boundary where checkpoint +
+# stop handling runs (an injected raise here must leave a resumable
+# directory behind)
+_FP_SOLVE_RESULT = faults.register_point(
+    "streaming.solve.result",
+    description="chunk solve output (nan action poisons w for the guard)",
+)
+_FP_CHUNK_BOUNDARY = faults.register_point(
+    "streaming.chunk.boundary",
+    description="between a chunk solve and its checkpoint/stop handling",
+)
 
 
 @lru_cache(maxsize=16)
@@ -130,6 +144,38 @@ class ShardedCoefficientTable:
                 out_shardings=self.sharding,
             )()
 
+    @classmethod
+    def from_coefficients(
+        cls,
+        coefficients: Array,
+        mesh: Optional[Mesh] = None,
+        axis: Optional[str] = None,
+    ) -> "ShardedCoefficientTable":
+        """Wrap an ALREADY-PLACED [N, K] device array (e.g. an elastic
+        checkpoint restore via
+        ``StreamingCheckpointManager.restore_placed``) without the zero
+        init + overwrite a construct-then-write resume would pay."""
+        table = cls.__new__(cls)
+        table.num_entities = int(coefficients.shape[0])
+        table.dim = int(coefficients.shape[1])
+        table.mesh = mesh
+        if mesh is None:
+            table.axis = axis
+            table.sharding = None
+        else:
+            table.sharding = psharding.entity_sharding(mesh, axis)
+            table.axis = table.sharding.spec[0]
+            n_dev = psharding.axis_size(mesh, table.axis)
+            if table.num_entities % n_dev:
+                raise ValueError(
+                    f"num_entities={table.num_entities} must divide over "
+                    f"the {n_dev}-device '{table.axis}' axis"
+                )
+            if coefficients.sharding != table.sharding:
+                coefficients = jax.device_put(coefficients, table.sharding)
+        table.coefficients = coefficients
+        return table
+
     @property
     def nbytes(self) -> int:
         return self.num_entities * self.dim * self.coefficients.dtype.itemsize
@@ -154,23 +200,13 @@ class ShardedCoefficientTable:
         return _read_chunk(self.coefficients, jnp.int32(start), size)
 
     def to_numpy(self) -> np.ndarray:
-        """Full table on the host; multi-process this all-gathers, so use
-        it for models/summaries, or prefer :meth:`local_shard` at scale."""
+        """Full table on the host — models/summaries/tests only. At
+        scale the table never belongs on the host: checkpointing hands
+        ``coefficients`` to ``StreamingCheckpointManager``, which saves
+        one addressable shard at a time."""
         from photon_ml_tpu.parallel.multihost import gather_to_host
 
         return gather_to_host(self.coefficients)
-
-    def local_shard(self) -> tuple[int, np.ndarray]:
-        """(global row offset, rows) of THIS process's table shard —
-        per-host checkpointing without ever assembling the global table."""
-        if self.sharding is None:
-            return 0, np.asarray(self.coefficients)
-        shards = sorted(
-            self.coefficients.addressable_shards,
-            key=lambda s: s.index[0].start or 0,
-        )
-        lo = shards[0].index[0].start or 0
-        return int(lo), np.concatenate([np.asarray(s.data) for s in shards])
 
 
 @dataclasses.dataclass
@@ -426,11 +462,14 @@ class StreamingRandomEffectTrainer:
                         obj, self._guard.damping_for(attempt)
                     )
                 res, var = self._solver(obj, batch, w0, self._l1, cons)
+                # injection seam: a `nan` rule here poisons the solve
+                # result, driving the guard's retry/rollback path on demand
+                w = faults.corrupt_array(_FP_SOLVE_RESULT, res.w)
                 if self._guard is None:
                     break
                 ok = bool(
                     telemetry.sync_fetch(
-                        solve_health(res, res.w), label="streaming_guard"
+                        solve_health(res, w), label="streaming_guard"
                     )
                 )
                 if ok:
@@ -450,7 +489,7 @@ class StreamingRandomEffectTrainer:
                     break
                 attempt += 1
             if not rolled_back:
-                table.write_chunk(start, res.w)
+                table.write_chunk(start, w)
         telemetry.counter("streaming_chunks").inc()
         telemetry.counter("streaming_entities").inc(int(size))
         # heartbeat rate sources: streamed example-rows and the chunk's
@@ -490,7 +529,14 @@ class StreamingRandomEffectTrainer:
         """Chunk-boundary bookkeeping: periodic checkpoint, and the
         graceful-preemption handshake (save-then-raise on a stop
         request — the deterministic ingest order makes ``next_chunk``
-        sufficient resume state)."""
+        sufficient resume state).
+
+        Checkpoints receive the LIVE device arrays: the manager saves a
+        sharded table one addressable shard at a time, so no chunk
+        boundary ever assembles the full table on the host (the old
+        ``local_shard()`` gather was a host-OOM time bomb at the
+        ``game_10B`` 40 GB-table scale)."""
+        faults.fault_point(_FP_CHUNK_BOUNDARY)
         if checkpointer is None:
             if should_stop is not None and should_stop():
                 from photon_ml_tpu.game.checkpoint import TrainingInterrupted
@@ -505,15 +551,14 @@ class StreamingRandomEffectTrainer:
         stop = should_stop is not None and should_stop()
         path = None
         if stop or (not final and checkpointer.should_save(chunk_index)):
-            _, rows = table.local_shard()
-            var_rows = None
-            if variance_table is not None:
-                _, var_rows = variance_table.local_shard()
             path = checkpointer.save(
                 StreamCheckpointState(
                     next_chunk=chunk_index + 1,
-                    coefficients=rows,
-                    variances=var_rows,
+                    coefficients=table.coefficients,
+                    variances=(
+                        None if variance_table is None
+                        else variance_table.coefficients
+                    ),
                 )
             )
         if stop:
@@ -606,18 +651,18 @@ class StreamingRandomEffectTrainer:
                 )
         if checkpointer is not None and results:
             # terminal checkpoint: a crash AFTER the stream finishes must
-            # not replay the tail chunks
+            # not replay the tail chunks (sharded per-shard save — no
+            # host gather, same as the boundary saves)
             from photon_ml_tpu.game.checkpoint import StreamCheckpointState
 
-            _, rows = table.local_shard()
-            var_rows = None
-            if variance_table is not None:
-                _, var_rows = variance_table.local_shard()
             checkpointer.save(
                 StreamCheckpointState(
                     next_chunk=index + 1,
-                    coefficients=rows,
-                    variances=var_rows,
+                    coefficients=table.coefficients,
+                    variances=(
+                        None if variance_table is None
+                        else variance_table.coefficients
+                    ),
                 )
             )
         if not results:

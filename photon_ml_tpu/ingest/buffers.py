@@ -25,9 +25,17 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from photon_ml_tpu import telemetry
+from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.ingest.errors import IngestStall, PipelineClosed
 from photon_ml_tpu.ingest.planner import ChunkPlan
+
+# Injection seam on the staging-ring hand-off: a firing rule here is a
+# decode worker failing BETWEEN chunks (buffer acquired but never filled
+# is impossible — the fault fires before the pop).
+_FP_RING_ACQUIRE = faults.register_point(
+    "ingest.ring.acquire",
+    description="staging-ring buffer acquisition by a decode worker",
+)
 
 
 class ShardStage:
@@ -154,6 +162,7 @@ class BufferRing:
         return len(self._all)
 
     def acquire(self) -> StagingBuffer:
+        faults.fault_point(_FP_RING_ACQUIRE)
         with self._cv:
             waited = self._cv.wait_for(
                 lambda: self._free or self._closed,

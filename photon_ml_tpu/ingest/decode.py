@@ -26,10 +26,19 @@ from typing import Callable, Mapping, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu import faults
 from photon_ml_tpu.data.index_map import INTERCEPT_KEY, feature_key
 from photon_ml_tpu.ingest.buffers import StagingBuffer
 from photon_ml_tpu.ingest.errors import ChunkDecodeError
 from photon_ml_tpu.ingest.planner import ChunkPlan, FileMeta
+
+# Injection seam on the chunk file read — an `io` rule here raises an
+# InjectedIOError (an OSError), exactly the transient flaky-read shape the
+# pipeline's bounded per-chunk retry exists for.
+_FP_DECODE_READ = faults.register_point(
+    "ingest.decode.read",
+    description="chunk byte-range read (io action = transient flaky read)",
+)
 
 #: grow callback: (buffer, shard index, needed raw nnz, preserve) -> None;
 #: ``preserve`` is how many already-written scratch entries must survive
@@ -142,6 +151,7 @@ def build_decode_context(
 
 
 def _read_range(plan: ChunkPlan) -> bytes:
+    faults.fault_point(_FP_DECODE_READ)
     with open(plan.path, "rb") as f:
         f.seek(plan.byte_start)
         raw = f.read(plan.nbytes)

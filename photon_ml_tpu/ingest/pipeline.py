@@ -27,6 +27,7 @@ the SOLVE waited on data after warm-up — the number the RunReport
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import queue
 import threading
@@ -36,7 +37,7 @@ from typing import Mapping, Optional, Sequence
 import jax
 import numpy as np
 
-from photon_ml_tpu import telemetry
+from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.ingest.buffers import BufferRing, StagingBuffer
 from photon_ml_tpu.ingest.decode import (
     DecodeContext,
@@ -44,6 +45,7 @@ from photon_ml_tpu.ingest.decode import (
     decode_chunk,
 )
 from photon_ml_tpu.ingest.errors import (
+    ChunkDecodeError,
     IngestConfigError,
     IngestStall,
     PipelineClosed,
@@ -52,6 +54,14 @@ from photon_ml_tpu.ingest.planner import ChunkPlan, plan_chunks
 from photon_ml_tpu.ops.sparse import SparseBatch
 
 _END = object()
+
+# Injection seam on the uploader's per-chunk device_put: a firing rule is
+# the uploader thread dying mid-stream (the consumer must surface it as a
+# typed error, not a silent hang).
+_FP_UPLOAD_CHUNK = faults.register_point(
+    "ingest.upload.chunk",
+    description="uploader device_put of one device-ready chunk",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,6 +75,11 @@ class IngestSpec:
     ``resident_budget_mb`` caps the HOST-resident staging memory: the
     ring shrinks to fit (never below 2 slots — below that the pipeline
     cannot overlap, and the spec is rejected with the sizing math).
+    ``read_retries`` bounds how many times ONE chunk's decode is retried
+    after a transient ``OSError`` (flaky network filesystem read) before
+    the error propagates and kills the stream; retries back off
+    ``retry_backoff_s * 2**attempt`` and are surfaced in
+    :class:`IngestStats` / ``ingest.read_retries``.
     """
 
     workers: int = 0
@@ -74,10 +89,16 @@ class IngestSpec:
     ring_slots: int = 0
     resident_budget_mb: Optional[float] = None
     stall_timeout_s: float = 600.0
+    read_retries: int = 2
+    retry_backoff_s: float = 0.05
 
     def __post_init__(self):
         if self.workers < 0:
             raise IngestConfigError("ingest workers must be >= 0")
+        if self.read_retries < 0:
+            raise IngestConfigError("read_retries must be >= 0")
+        if self.retry_backoff_s < 0:
+            raise IngestConfigError("retry_backoff_s must be >= 0")
         if self.prefetch_depth < 1:
             raise IngestConfigError("prefetch_depth must be >= 1")
         if self.chunk_rows < 1:
@@ -159,6 +180,10 @@ class IngestStats:
     buffer_growths: int = 0
     staging_bytes: int = 0
     rows_per_sec: float = 0.0
+    #: transient-read retries that succeeded on a later attempt — a
+    #: nonzero value means the storage layer flaked and the bounded
+    #: retry absorbed it (RunReport "Ingestion" surfaces this)
+    read_retries: int = 0
 
 
 class ChunkStream:
@@ -316,6 +341,40 @@ class ChunkStream:
         buf.shards[si].grow(target, self.rows_cap, self._intercept,
                             preserve=preserve)
 
+    def _decode_with_retry(self, plan: ChunkPlan, buf: StagingBuffer) -> None:
+        """One chunk's decode, retried past transient ``OSError``s.
+
+        A flaky read from a network filesystem must not kill the whole
+        stream on its first occurrence: up to ``spec.read_retries``
+        re-reads with exponential backoff, each starting the chunk over
+        (``decode_chunk`` re-initializes the buffer, so a partial first
+        attempt leaves no residue). Deterministic failures — a
+        :class:`ChunkDecodeError` from corrupt bytes or a schema
+        violation — propagate immediately: re-reading corrupt data
+        produces the same corrupt data."""
+        for attempt in range(self.spec.read_retries + 1):
+            try:
+                decode_chunk(self._ctx, plan, buf, self._grow)
+                return
+            except ChunkDecodeError:
+                raise
+            except OSError as e:
+                if attempt >= self.spec.read_retries:
+                    raise
+                telemetry.counter("ingest.read_retries").inc()
+                with self._lock:
+                    self._stats.read_retries += 1
+                delay = self.spec.retry_backoff_s * (2 ** attempt)
+                logging.getLogger("photon_ml_tpu.ingest").warning(
+                    "transient read failure on chunk %d of %s (attempt "
+                    "%d/%d, retrying in %.2fs): %s", plan.index, plan.path,
+                    attempt + 1, self.spec.read_retries + 1, delay, e,
+                )
+                if self._stop.wait(delay):
+                    raise PipelineClosed(
+                        "stream closed during a read-retry backoff"
+                    ) from None
+
     def _next_plan(self) -> Optional[ChunkPlan]:
         with self._lock:
             if self._work_i >= len(self._todo):
@@ -341,7 +400,7 @@ class ChunkStream:
                     "ingest_decode", chunk=plan.index, rows=plan.n_rows,
                     bytes=plan.nbytes,
                 ):
-                    decode_chunk(self._ctx, plan, buf, self._grow)
+                    self._decode_with_retry(plan, buf)
                 with self._cv:
                     self._pending[plan.index] = buf
                     self._cv.notify_all()
@@ -486,6 +545,7 @@ class ChunkStream:
                 with telemetry.span(
                     "ingest_upload", chunk=plan.index, rows=plan.n_rows
                 ):
+                    faults.fault_point(_FP_UPLOAD_CHUNK)
                     chunk = self._upload_one(plan, buf)
                 self._ring.release(buf)
                 telemetry.counter("ingest.rows").inc(chunk.rows)

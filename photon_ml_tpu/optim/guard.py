@@ -29,7 +29,20 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu import faults
+
 Array = jax.Array
+
+#: Injection seam for the coordinate-descent guard: the HOST-side health
+#: verdict of a solve (a ``nan`` rule flips it to diverged, driving the
+#: damped-retry/rollback/freeze machinery deterministically). Applied by
+#: the host training loops (coordinate_descent._guarded_update) — never
+#: inside a traced function, where a trace-time plan lookup would bake
+#: one decision into the compiled program.
+FP_SOLVE_HEALTH = faults.register_point(
+    "guard.solve_health",
+    description="host-side solve health verdict (nan action => diverged)",
+)
 
 # Relative slack for the loss-regression check: warm-started re-solves may
 # end epsilon above f_0 from padding/reduction-order noise; only a real

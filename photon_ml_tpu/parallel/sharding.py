@@ -112,6 +112,105 @@ def place_replicated(tree, mesh: Mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
+class ElasticPlacementError(ValueError):
+    """The TARGET topology cannot hold this table (entity count does not
+    divide over the mesh's model axis) — a configuration error, distinct
+    from checkpoint corruption: restore must surface it, never skip past
+    valid checkpoints because of it."""
+
+
+def place_entity_rows(
+    read_rows,
+    num_entities: int,
+    tail_shape: tuple,
+    dtype,
+    mesh: Optional[Mesh] = None,
+    axis: Optional[str] = None,
+):
+    """Build an entity-sharded ``[E, *tail_shape]`` array from a
+    row-range reader WITHOUT materializing the full table on any host.
+
+    ``read_rows(lo, hi)`` returns host rows ``[lo, hi)`` (e.g. slices of
+    memory-mapped checkpoint shard files). With a mesh, each device's
+    shard is requested independently through
+    ``jax.make_array_from_callback`` — peak host residency is one device
+    shard, which is what makes ELASTIC checkpoint restore (written on an
+    8-device mesh, restored onto 4, or 1) safe for tables that only fit
+    sharded. Without a mesh the whole range is read and placed on the
+    default device (the caller asserted it fits).
+
+    This is the restore-side complement of :func:`entity_sharding`:
+    row ranges re-slice over whatever model axis the TARGET mesh has, so
+    a checkpoint's provenance mesh never constrains where it can resume.
+    """
+    shape = (int(num_entities),) + tuple(int(d) for d in tail_shape)
+    # Two aliasing hazards on this path, both host-copy lessons from the
+    # ingest uploader. (1) ``read_rows`` serves views of MEMORY-MAPPED
+    # checkpoint files, and CPU device_put MAY zero-copy an aligned host
+    # array — so every placement gets a fresh owned ndarray, never a
+    # mapped view. (2) Even that owned copy is only BORROWED by jax:
+    # device_put/make_array_from_callback keep the numpy buffer rather
+    # than copying into an XLA-owned allocation. A downstream DONATED
+    # update (ShardedCoefficientTable chunk writes) then aliases borrowed
+    # memory that is freed when the donated input dies — one device's
+    # shard turns into freed-heap garbage, timing-dependent (reproduced
+    # under the warm persistent compile cache). ``_owned_copy`` launders
+    # the result through a non-donating jitted copy, whose outputs XLA
+    # allocates and owns, before anything can donate it.
+    if mesh is None:
+        import jax.numpy as jnp
+
+        return _owned_copy(
+            jnp.asarray(
+                np.array(read_rows(0, shape[0]), dtype=dtype, copy=True)
+            )
+        )
+    sharding = entity_sharding(mesh, axis)
+    if shape[0] % axis_size(mesh, sharding.spec[0]):
+        raise ElasticPlacementError(
+            f"num_entities={shape[0]} must divide over the "
+            f"{axis_size(mesh, sharding.spec[0])}-device "
+            f"'{sharding.spec[0]}' axis to re-place elastically"
+        )
+
+    def callback(index):
+        row_slice = index[0]
+        lo = row_slice.start or 0
+        hi = shape[0] if row_slice.stop is None else row_slice.stop
+        chunk = np.asarray(read_rows(lo, hi))
+        return np.array(
+            chunk[(slice(None),) + tuple(index[1:])], dtype=dtype,
+            copy=True,
+        )
+
+    return _owned_copy(
+        jax.make_array_from_callback(shape, sharding, callback)
+    )
+
+
+def _owned_copy(array):
+    """Copy ``array`` into buffers XLA allocated and owns (sharding
+    preserved — the copy is per-device, no cross-device traffic). Without
+    donation an executable's outputs can never alias its inputs, so the
+    result is safe to hand to donating updates no matter where the input
+    buffers came from."""
+    from photon_ml_tpu import telemetry  # lazy: keep sharding importable solo
+
+    global _OWNED_COPY_JIT
+    if _OWNED_COPY_JIT is None:
+        import jax.numpy as jnp
+
+        # multi_shape: one executable per (table shape, sharding) by
+        # design — placements are once-per-restore, not hot
+        _OWNED_COPY_JIT = telemetry.instrumented_jit(
+            jnp.copy, name="place_entity_rows_copy", multi_shape=True
+        )
+    return _OWNED_COPY_JIT(array)
+
+
+_OWNED_COPY_JIT = None
+
+
 # ---------------------------------------------------------------------------
 # batch placement: flat (non-stacked) designs onto the batch axis
 # ---------------------------------------------------------------------------

@@ -29,11 +29,20 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Mapping, Sequence, Tuple
 
-from photon_ml_tpu import telemetry
+from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.serving.engine import BadRequest
 
 #: scorer contract: flat request rows -> (scores aligned to rows, version)
 Scorer = Callable[[Sequence[Mapping]], Tuple[Sequence[float], str]]
+
+# Injection seam on the batched device dispatch: a `raise` rule here is
+# delivered to every rider of the batch as a scoring failure (callers see
+# the typed error, the dispatcher survives); an `exit` rule is the serving
+# process dying mid-request.
+_FP_DISPATCH = faults.register_point(
+    "serving.dispatch",
+    description="micro-batched scoring dispatch (one engine call)",
+)
 
 
 class Overloaded(RuntimeError):
@@ -187,6 +196,7 @@ class MicroBatcher:
         flat = [r for u in units for r in u.rows]
         telemetry.histogram("serving.batch_size").observe(len(flat))
         try:
+            faults.fault_point(_FP_DISPATCH)
             scores, version = self._scorer(flat)
         except Exception as e:  # noqa: BLE001 — failure belongs to callers
             if len(units) == 1:

@@ -27,7 +27,10 @@ only then swaps the engine reference — in-flight requests finish on the
 old engine, which the swap never mutates.
 
 Telemetry: ``serving.model_swaps`` counter, ``serving.model_version``
-gauge, ``serving.skipped_versions`` counter.
+gauge, ``serving.skipped_versions`` and ``serving.version_retries``
+counters (the latter = transient-IO load retries; see
+:meth:`ModelRegistry._load_version` for the transient/deterministic
+failure split).
 """
 
 from __future__ import annotations
@@ -39,11 +42,23 @@ import shutil
 import threading
 from typing import Mapping, Optional
 
-from photon_ml_tpu import telemetry
+from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.serving.engine import ScoringEngine
 from photon_ml_tpu.utils.atomic import fsync_dir
 
 logger = logging.getLogger("photon_ml_tpu.serving.registry")
+
+# Injection seams: the background poll tick and the version load itself.
+# An `io` rule at the load point is exactly the transient flaky-read shape
+# the bounded retry absorbs (InjectedIOError IS an OSError).
+_FP_REGISTRY_POLL = faults.register_point(
+    "serving.registry.poll",
+    description="background registry poll tick (refresh entry)",
+)
+_FP_REGISTRY_LOAD = faults.register_point(
+    "serving.registry.load",
+    description="one version's engine load (io action = transient read)",
+)
 
 _VERSION_RE = re.compile(r"^v-(\d{8})$")
 _METADATA_FILE = "model-metadata.json"
@@ -122,20 +137,30 @@ class ModelRegistry:
         max_row_nnz: int = 128,
         poll_interval: float = 2.0,
         warm: bool = True,
+        load_retries: int = 2,
+        retry_backoff_s: float = 0.1,
     ):
         self.directory = directory
         self.max_batch = max_batch
         self.max_row_nnz = max_row_nnz
         self.poll_interval = poll_interval
         self.warm = warm
+        # transient-IO retry budget per version load (a half-synced NFS
+        # dir, a flaky read): retries back off retry_backoff_s * 2**k and
+        # count serving.version_retries
+        self.load_retries = load_retries
+        self.retry_backoff_s = retry_backoff_s
         self._engine: Optional[ScoringEngine] = None
         self._version = -1
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # (path -> mtime) of versions that failed to load: a persistently
-        # corrupt newest version is skipped silently on later polls instead
-        # of re-reading/re-warning every interval; retried when it changes
+        # (path -> mtime) of versions that failed DETERMINISTIC validation
+        # (bad metadata, unservable model type): a persistently corrupt
+        # newest version is skipped silently on later polls instead of
+        # re-reading/re-warning every interval; retried when it changes.
+        # Transient IO errors are deliberately NOT recorded here — one
+        # flaky read must not mark a good version skipped forever.
         self._skipped: dict[str, float] = {}
 
     @property
@@ -172,27 +197,8 @@ class ModelRegistry:
                 mtime = -1.0
             if self._skipped.get(path) == mtime:
                 continue  # known-bad and unchanged since the last attempt
-            try:
-                engine = ScoringEngine.load(
-                    path,
-                    max_batch=self.max_batch,
-                    max_row_nnz=self.max_row_nnz,
-                    version=version_dirname(version),
-                )
-                if self.warm:
-                    engine.warmup()
-            except (ValueError, OSError, TypeError, KeyError) as e:
-                # ModelLoadError is a ValueError; OSError covers a
-                # half-deleted directory; TypeError an unservable model.
-                # _skipped is shared with concurrent refresh() callers
-                # (start() on the main thread vs the poll loop), so its
-                # writes take the lock like every other registry mutation
-                # (lint L015)
-                with self._lock:
-                    self._skipped[path] = mtime
-                telemetry.counter("serving.skipped_versions").inc()
-                logger.warning("skipping unusable model version %s: %s",
-                               path, e)
+            engine = self._load_version(version, path, mtime)
+            if engine is None:
                 continue
             with self._lock:
                 self._skipped.pop(path, None)
@@ -209,6 +215,70 @@ class ModelRegistry:
             )
             return True
         return False
+
+    def _load_version(
+        self, version: int, path: str, mtime: float
+    ) -> Optional[ScoringEngine]:
+        """Load + warm one version, or None when it must be skipped.
+
+        Failure handling distinguishes the two shapes a read can fail:
+
+        - **transient IO** (``OSError``: a half-synced network dir, a
+          flaky read) — retried up to ``load_retries`` times with
+          exponential backoff (``serving.version_retries``); if it STILL
+          fails, the version is skipped for this refresh only — the next
+          poll retries from scratch, because one bad read must not pin a
+          good version as skipped-by-mtime forever.
+        - **deterministic validation** (``ValueError``/``TypeError``/
+          ``KeyError``: corrupt metadata, unservable model type) — pinned
+          in ``_skipped`` by mtime so later polls stop re-reading it
+          until the directory changes.
+        """
+        last_transient: Optional[OSError] = None
+        for attempt in range(self.load_retries + 1):
+            try:
+                faults.fault_point(_FP_REGISTRY_LOAD)
+                engine = ScoringEngine.load(
+                    path,
+                    max_batch=self.max_batch,
+                    max_row_nnz=self.max_row_nnz,
+                    version=version_dirname(version),
+                )
+                if self.warm:
+                    engine.warmup()
+                return engine
+            except OSError as e:
+                last_transient = e
+                if attempt < self.load_retries:
+                    delay = self.retry_backoff_s * (2 ** attempt)
+                    telemetry.counter("serving.version_retries").inc()
+                    logger.warning(
+                        "transient error loading model version %s "
+                        "(attempt %d/%d, retrying in %.2fs): %s", path,
+                        attempt + 1, self.load_retries + 1, delay, e,
+                    )
+                    if self._stop.wait(delay):
+                        return None  # shutting down mid-backoff
+            except (ValueError, TypeError, KeyError) as e:
+                # ModelLoadError is a ValueError; TypeError an unservable
+                # model. _skipped is shared with concurrent refresh()
+                # callers (start() on the main thread vs the poll loop),
+                # so its writes take the lock like every other registry
+                # mutation (lint L015)
+                with self._lock:
+                    self._skipped[path] = mtime
+                telemetry.counter("serving.skipped_versions").inc()
+                logger.warning(
+                    "skipping unusable model version %s: %s", path, e
+                )
+                return None
+        telemetry.counter("serving.skipped_versions").inc()
+        logger.warning(
+            "model version %s still unreadable after %d attempt(s) — "
+            "skipped for THIS refresh, retried next poll: %s", path,
+            self.load_retries + 1, last_transient,
+        )
+        return None
 
     # -- background watcher --------------------------------------------------
 
@@ -238,6 +308,7 @@ class ModelRegistry:
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
             try:
+                faults.fault_point(_FP_REGISTRY_POLL)
                 self.refresh()
             except Exception:  # noqa: BLE001 — the watcher must survive
                 logger.exception("model registry refresh failed")

@@ -715,6 +715,7 @@ class RunReport:
             "sweep": self.sweep_summary(),
             "device_utilization": self.device_utilization(),
             "ingestion": self.ingestion_summary(),
+            "recovery": self.recovery_summary(),
             "counters": counters,
             "gauges": self.snapshot.get("gauges", {}),
             "histograms": self.snapshot.get("histograms", {}),
@@ -779,6 +780,7 @@ class RunReport:
         lines += self._device_utilization_markdown()
         lines += self._accounting_markdown()
         lines += self._ingestion_markdown()
+        lines += self._recovery_markdown()
         lines += self._memory_markdown()
         lines += self._coordinates_markdown()
         lines += self._sweep_markdown()
@@ -946,6 +948,7 @@ class RunReport:
             "rows_per_sec": g.get("ingest.rows_per_sec"),
             "stalls": c.get("ingest.stalls", 0),
             "buffer_growths": c.get("ingest.buffer_growths", 0),
+            "read_retries": c.get("ingest.read_retries", 0),
             "solve_waits": c.get("ingest.solve_waits", 0),
             "solve_wait_seconds": (
                 round(wait["mean"] * wait["count"], 6)
@@ -999,6 +1002,120 @@ class RunReport:
             out.append(
                 f"- {growths} staging-buffer growth(s) — raise "
                 "`nnz_per_row_hint` to pre-size the ring exactly"
+            )
+        retries = int(ing.get("read_retries") or 0)
+        if retries:
+            out.append(
+                f"- {retries} transient read failure(s) absorbed by the "
+                "per-chunk retry (`ingest.read_retries`) — the storage "
+                "layer flaked but the stream survived"
+            )
+        out.append("")
+        return out
+
+    def recovery_summary(self) -> Optional[dict[str, Any]]:
+        """Fault-tolerance accounting, or None when the run exercised no
+        recovery machinery at all (no checkpoints, no retries, no
+        injections — the common healthy case).
+
+        The section exists so "the run recovered" is an auditable
+        statement: how many checkpoints were written (and with how many
+        per-shard saves — ``max_shard_fetch_bytes`` proves a sharded save
+        never assembled the table on the host), whether restore fell back
+        past corrupt directories, whether a resume was ELASTIC (restored
+        onto a different device topology than the one that saved), and
+        how many transient-IO retries the ingest/serving paths absorbed.
+        ``faults.injected`` is nonzero only under deliberate fault
+        injection (tools/chaos.py or an armed ``PHOTON_FAULT_PLAN``) —
+        loud in a report because an armed production run is an incident.
+        """
+        c = self.snapshot.get("counters", {})
+        g = self.snapshot.get("gauges", {})
+        keys = (
+            "checkpoint.saves", "checkpoint.restores", "checkpoint.corrupt",
+            "checkpoint.shard_saves", "recovery.elastic_resumes",
+            "faults.injected", "serving.version_retries",
+            "ingest.read_retries", "streaming.feed_retries",
+            "solves.rolled_back", "solves.frozen",
+        )
+        if not any(c.get(k) for k in keys):
+            return None
+        out: dict[str, Any] = {k.replace(".", "_"): int(c.get(k, 0))
+                               for k in keys}
+        max_fetch = g.get("checkpoint.max_shard_fetch_bytes")
+        if max_fetch is not None:
+            out["max_shard_fetch_bytes"] = int(max_fetch)
+        injected_by_point = {
+            name[len("faults.injected."):]: int(value)
+            for name, value in c.items()
+            if name.startswith("faults.injected.")
+        }
+        if injected_by_point:
+            out["faults_injected_by_point"] = injected_by_point
+        return out
+
+    def _recovery_markdown(self) -> list[str]:
+        rec = self.recovery_summary()
+        if rec is None:
+            return []
+        out = ["## Recovery", ""]
+        saves = rec.get("checkpoint_saves", 0)
+        if saves:
+            line = f"- {saves} checkpoint save(s)"
+            shard_saves = rec.get("checkpoint_shard_saves", 0)
+            if shard_saves:
+                line += f", {shard_saves} per-shard payload write(s)"
+                max_fetch = rec.get("max_shard_fetch_bytes")
+                if max_fetch is not None:
+                    line += (
+                        f" (largest single host fetch "
+                        f"{_fmt_bytes(max_fetch)} — never the full table)"
+                    )
+            out.append(line)
+        restores = rec.get("checkpoint_restores", 0)
+        if restores:
+            elastic = rec.get("recovery_elastic_resumes", 0)
+            out.append(
+                f"- {restores} restore(s)"
+                + (
+                    f", **{elastic} elastic** (resumed onto a different "
+                    "device topology than the one that saved)"
+                    if elastic else ""
+                )
+            )
+        corrupt = rec.get("checkpoint_corrupt", 0)
+        if corrupt:
+            out.append(
+                f"- **{corrupt} corrupt/partial checkpoint(s) skipped** "
+                "during restore (newest-valid fallback)"
+            )
+        retries = [
+            ("serving_version_retries", "serving model-version loads"),
+            ("ingest_read_retries", "ingest chunk reads"),
+            ("streaming_feed_retries", "streaming host→device feeds"),
+        ]
+        for key, what in retries:
+            n = rec.get(key, 0)
+            if n:
+                out.append(
+                    f"- {n} transient-IO retry(ies) absorbed on {what}"
+                )
+        rolled = rec.get("solves_rolled_back", 0)
+        frozen = rec.get("solves_frozen", 0)
+        if rolled or frozen:
+            out.append(
+                f"- guard: {rolled} solve rollback(s), {frozen} "
+                "coordinate freeze(s)"
+            )
+        injected = rec.get("faults_injected", 0)
+        if injected:
+            by_point = rec.get("faults_injected_by_point") or {}
+            detail = ", ".join(
+                f"`{p}`×{n}" for p, n in sorted(by_point.items())
+            )
+            out.append(
+                f"- **{injected} fault(s) deliberately injected** "
+                f"({detail}) — this run had an armed fault plan"
             )
         out.append("")
         return out

@@ -1080,3 +1080,99 @@ class TestLockDisciplineIngestL015:
 
         rel = os.path.join("photon_ml_tpu", "ingest", "pipeline.py")
         assert local.is_l011_hot(rel)
+
+
+# ---------------------------------------------------------------------------
+# L016 fault-point test coverage (tools/analysis/faultcov.py)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultCoverageL016:
+    """Every registered fault point must be named by a test literal —
+    an unarmed injection seam is untested recovery code wearing a
+    coverage badge."""
+
+    PKG = """
+        from photon_ml_tpu import faults
+
+        _FP = faults.register_point("pkg.seam.covered", write_path=True)
+        _FP2 = faults.register_point("pkg.seam.orphan")
+    """
+
+    def _run(self, tmp_path, files):
+        from tools.analysis import faultcov
+
+        write_tree(tmp_path, files)
+        srcs = [
+            core.load_source(rel, str(tmp_path / rel)) for rel in files
+        ]
+        return faultcov.run(srcs)
+
+    def test_uncovered_point_flagged_with_its_id(self, tmp_path):
+        findings = self._run(tmp_path, {
+            "photon_ml_tpu/mod.py": self.PKG,
+            "tests/test_mod.py": """
+                def test_covered():
+                    assert "pkg.seam.covered" in CATALOG
+            """,
+        })
+        assert codes(findings) == ["L016"]
+        assert "pkg.seam.orphan" in findings[0].message
+        assert findings[0].path == "photon_ml_tpu/mod.py"
+
+    def test_coverage_via_json_plan_literal_counts(self, tmp_path):
+        # a substring inside an env-transported JSON plan blob covers too
+        findings = self._run(tmp_path, {
+            "photon_ml_tpu/mod.py": self.PKG,
+            "tests/test_mod.py": """
+                PLAN = '{"rules": [{"point": "pkg.seam.covered"}]}'
+
+                def test_orphan_armed():
+                    arm('{"rules": [{"point": "pkg.seam.orphan"}]}')
+            """,
+        })
+        assert findings == []
+
+    def test_non_literal_registration_is_flagged(self, tmp_path):
+        findings = self._run(tmp_path, {
+            "photon_ml_tpu/mod.py": """
+                from photon_ml_tpu import faults
+
+                NAME = "dyn" + ".seam"
+                _FP = faults.register_point(NAME)
+            """,
+            "tests/test_mod.py": "LIT = 'dyn.seam'\n",
+        })
+        assert codes(findings) == ["L016"]
+        assert "non-literal" in findings[0].message
+
+    def test_tree_without_tests_is_skipped(self, tmp_path):
+        # reduced fixture trees carry no tests/ — the pass must not
+        # flag every point as uncovered there
+        findings = self._run(tmp_path, {
+            "photon_ml_tpu/mod.py": self.PKG,
+        })
+        assert findings == []
+
+    def test_driver_runs_l016_only_on_real_trees(self, tmp_path):
+        # require_seeds=False (reduced fixture tree) skips the pass...
+        res = analyze(tmp_path, {
+            "photon_ml_tpu/__init__.py": "",
+            "photon_ml_tpu/mod.py": self.PKG,
+            "tests/test_mod.py": "LIT = 'pkg.seam.covered'\n",
+        })
+        assert "L016" not in codes(res.findings)
+
+    def test_real_tree_catalog_satisfies_l016(self):
+        """The shipped package's own registry passes: every registered
+        point is named by at least one test literal (the EXPECTED_POINTS
+        catalog in tests/test_faults.py keeps this true by construction)."""
+        from tools.analysis import faultcov
+
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(driver.__file__))))
+        files = [
+            core.load_source(os.path.relpath(p, root), p)
+            for p in driver.source_files(root)
+        ]
+        assert faultcov.run(files) == []

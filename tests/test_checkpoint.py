@@ -276,6 +276,47 @@ def test_graceful_stop_flag_on_sigterm():
         signal.signal(signal.SIGTERM, prev)
 
 
+def test_graceful_stop_second_signal_hard_exits_75(monkeypatch):
+    """A REPEATED SIGTERM during the final-checkpoint write is the
+    scheduler escalating: the process must hard-exit (75) immediately
+    instead of blocking behind a slow save — via async-signal-safe calls
+    only (a raw write(2) + ``os._exit``; logging could block on a lock a
+    stuck thread holds). ``os._exit`` is intercepted — a real _exit
+    would take the test runner with it; the crash matrix covers the
+    true-exit shape via subprocesses."""
+    exited = []
+    monkeypatch.setattr(
+        "photon_ml_tpu.game.checkpoint.os._exit",
+        lambda code: exited.append(code),
+    )
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        stop = GracefulStop().install(signums=(signal.SIGTERM,))
+        signal.raise_signal(signal.SIGTERM)  # graceful request
+        assert stop() and exited == []
+        # ... the final checkpoint write is slow; the scheduler escalates
+        signal.raise_signal(signal.SIGTERM)
+        assert exited == [75]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_graceful_stop_hard_exit_code_is_configurable(monkeypatch):
+    exited = []
+    monkeypatch.setattr(
+        "photon_ml_tpu.game.checkpoint.os._exit",
+        lambda code: exited.append(code),
+    )
+    prev = signal.getsignal(signal.SIGINT)
+    try:
+        GracefulStop(hard_exit_code=99).install(signums=(signal.SIGINT,))
+        signal.raise_signal(signal.SIGINT)
+        signal.raise_signal(signal.SIGINT)
+        assert exited == [99]
+    finally:
+        signal.signal(signal.SIGINT, prev)
+
+
 def test_sigterm_mid_fit_writes_final_checkpoint(tmp_path):
     """The acceptance path in-process: a stop request arriving mid-fit ends
     the run with TrainingInterrupted AND a final checkpoint from which a

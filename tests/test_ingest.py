@@ -312,6 +312,102 @@ def test_decode_error_names_file_and_chunk(tmp_path, rng):
         )
 
 
+def test_transient_read_failure_is_retried_not_fatal(tmp_path, rng):
+    """A flaky OSError on ONE chunk's byte-range read must not kill the
+    stream: the bounded per-chunk retry re-reads it, the dataset comes
+    out bit-identical, and the absorbed flake is visible in IngestStats
+    + ``ingest.read_retries``. Injected at the real read seam
+    (``ingest.decode.read``) rather than a mock, so the retry loop is
+    exercised exactly where production flakes land."""
+    from photon_ml_tpu import faults, telemetry
+
+    paths = _write_shards(tmp_path, rng, n_rows=400, n_files=1)
+    ds_ref, maps = read_game_dataset_from_avro(
+        paths[0], id_columns=("userId",), return_index_maps=True
+    )
+    from photon_ml_tpu.ingest.pipeline import ChunkStream
+
+    spec = IngestSpec(workers=1, chunk_rows=100, nnz_per_row_hint=8,
+                      read_retries=2, retry_backoff_s=0.0)
+    telemetry.reset()
+    try:
+        faults.install_plan(faults.FaultPlan([
+            faults.FaultRule("ingest.decode.read", action="io", nth=2),
+        ]))
+        ds = read_game_dataset_streamed(
+            paths, index_maps=maps, id_columns=("userId",), spec=spec
+        )
+        _assert_datasets_equal(ds, ds_ref)
+        counters = telemetry.snapshot()["counters"]
+        assert counters["ingest.read_retries"] == 1
+        assert counters["faults.injected"] == 1
+    finally:
+        faults.clear_plan()
+        telemetry.reset()
+
+    # the absorbed flake is visible on the stream's own stats too
+    telemetry.reset()
+    try:
+        faults.install_plan(faults.FaultPlan([
+            faults.FaultRule("ingest.decode.read", action="io", nth=1),
+        ]))
+        stream = ChunkStream(paths, index_maps=maps,
+                             id_columns=("userId",), spec=spec)
+        for _ in stream:
+            pass
+        assert stream.stats().read_retries == 1
+    finally:
+        faults.clear_plan()
+        telemetry.reset()
+
+
+def test_read_retries_exhausted_propagates_and_deterministic_skips_retry(
+    tmp_path, rng
+):
+    """Two failure shapes stay distinct: a read that flakes on EVERY
+    attempt propagates after the retry budget (stream dies with the
+    typed error), while a deterministic ChunkDecodeError never burns a
+    retry at all — re-reading corrupt bytes cannot help."""
+    from photon_ml_tpu import faults, telemetry
+    from photon_ml_tpu.ingest import ChunkDecodeError
+    from photon_ml_tpu.ingest.pipeline import ChunkStream
+
+    paths = _write_shards(tmp_path, rng, n_rows=200, n_files=1)
+    _, maps = read_game_dataset_from_avro(
+        paths[0], id_columns=("userId",), return_index_maps=True
+    )
+    spec = IngestSpec(workers=1, chunk_rows=100, nnz_per_row_hint=8,
+                      read_retries=1, retry_backoff_s=0.0)
+    telemetry.reset()
+    try:
+        faults.install_plan(faults.FaultPlan([
+            faults.FaultRule("ingest.decode.read", action="io",
+                             probability=1.0),
+        ]))
+        with pytest.raises(OSError):
+            list(ChunkStream(paths, index_maps=maps,
+                             id_columns=("userId",), spec=spec))
+        # attempts = retries + 1 per chunk; only the RETRY is counted
+        assert (
+            telemetry.snapshot()["counters"]["ingest.read_retries"] >= 1
+        )
+    finally:
+        faults.clear_plan()
+        telemetry.reset()
+
+    # deterministic decode failure: no retry counter movement
+    telemetry.reset()
+    try:
+        with pytest.raises((ChunkDecodeError, KeyError)):
+            read_game_dataset_streamed(
+                paths, index_maps=maps, id_columns=("memberId",), spec=spec
+            )
+        assert telemetry.snapshot()["counters"].get(
+            "ingest.read_retries") is None
+    finally:
+        telemetry.reset()
+
+
 # ---------------------------------------------------------------------------
 # double_buffered (the game/streaming feeding facility)
 # ---------------------------------------------------------------------------

@@ -766,6 +766,56 @@ def test_report_without_ingest_has_no_section():
     assert "ingest_rows_per_sec" not in live.key_metrics()
 
 
+def test_report_recovery_section_round_trip():
+    """The "Recovery" section makes "the run recovered" auditable:
+    sharded saves with the max single-shard fetch (the no-host-gather
+    proof), elastic resumes, corrupt-skip fallbacks, absorbed
+    transient-IO retries, and — loudly — deliberate injections."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    telemetry.metrics.counter("checkpoint.saves").inc(3)
+    telemetry.metrics.counter("checkpoint.shard_saves").inc(24)
+    telemetry.metrics.gauge("checkpoint.max_shard_fetch_bytes").set(
+        5 * 2**20
+    )
+    telemetry.metrics.counter("checkpoint.restores").inc(1)
+    telemetry.metrics.counter("checkpoint.corrupt").inc(1)
+    telemetry.metrics.counter("recovery.elastic_resumes").inc(1)
+    telemetry.metrics.counter("ingest.read_retries").inc(2)
+    telemetry.metrics.counter("serving.version_retries").inc(1)
+    telemetry.metrics.counter("faults.injected").inc(4)
+    telemetry.metrics.counter(
+        "faults.injected.checkpoint.save.before_rename"
+    ).inc(4)
+    live = RunReport.from_live()
+    rec = live.recovery_summary()
+    assert rec["checkpoint_saves"] == 3
+    assert rec["checkpoint_shard_saves"] == 24
+    assert rec["max_shard_fetch_bytes"] == 5 * 2**20
+    assert rec["recovery_elastic_resumes"] == 1
+    assert rec["faults_injected_by_point"] == {
+        "checkpoint.save.before_rename": 4
+    }
+    md = live.to_markdown()
+    assert "## Recovery" in md
+    assert "never the full table" in md
+    assert "1 elastic" in md
+    assert "corrupt/partial checkpoint(s) skipped" in md
+    assert "2 transient-IO retry(ies) absorbed on ingest chunk reads" in md
+    assert "deliberately injected" in md
+    assert "checkpoint.save.before_rename" in md
+    assert live.to_json()["recovery"]["checkpoint_restores"] == 1
+
+
+def test_report_without_recovery_activity_has_no_section():
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    live = RunReport.from_live()
+    assert live.recovery_summary() is None
+    assert "## Recovery" not in live.to_markdown()
+
+
 def test_heartbeat_ingest_fields():
     """Heartbeats surface live ingest throughput — and only when an
     ingest pipeline actually ran (absence stays unknown, never zero)."""

@@ -439,6 +439,76 @@ def test_publish_version_requires_index_maps(tmp_path, game_world):
         publish_version(str(tmp_path), _make_model(truth), {})
 
 
+def test_registry_retries_transient_io_and_does_not_pin_the_version(
+    tmp_path, game_world
+):
+    """One flaky read must not mark a good version skipped-by-mtime
+    forever: a transient OSError on the load is retried with backoff
+    (``serving.version_retries``) and the version still comes up — and
+    nothing lands in the mtime-pinned skip set."""
+    from photon_ml_tpu import faults
+
+    _, truth = game_world
+    registry_dir = str(tmp_path)
+    publish_version(registry_dir, _make_model(truth), _INDEX_MAPS)
+    telemetry.reset()
+    try:
+        # the first load attempt fails with an injected OSError; the
+        # bounded retry's second attempt succeeds
+        faults.install_plan(faults.FaultPlan([
+            faults.FaultRule("serving.registry.load", action="io", nth=1),
+        ]))
+        registry = ModelRegistry(registry_dir, max_batch=4, warm=False,
+                                 poll_interval=60, retry_backoff_s=0.01)
+        registry.start()
+        try:
+            assert registry.engine.version == "v-00000001"
+            counters = telemetry.snapshot()["counters"]
+            assert counters["serving.version_retries"] == 1
+            assert counters.get("serving.skipped_versions") is None
+            assert registry._skipped == {}
+        finally:
+            registry.stop()
+    finally:
+        faults.clear_plan()
+        telemetry.reset()
+
+
+def test_registry_transient_exhaustion_skips_refresh_not_forever(
+    tmp_path, game_world
+):
+    """When EVERY retry of a load fails transiently, the version is
+    skipped for that refresh only — the next poll retries from scratch
+    (no mtime pin) and succeeds once the flake clears. Deterministic
+    validation failures keep the mtime pin (existing behavior, asserted
+    by test_registry_skips_corrupt_and_index_less_versions)."""
+    from photon_ml_tpu import faults
+
+    _, truth = game_world
+    registry_dir = str(tmp_path)
+    publish_version(registry_dir, _make_model(truth), _INDEX_MAPS)
+    telemetry.reset()
+    try:
+        faults.install_plan(faults.FaultPlan([
+            faults.FaultRule("serving.registry.load", action="io",
+                             probability=1.0),
+        ]))
+        registry = ModelRegistry(registry_dir, max_batch=4, warm=False,
+                                 poll_interval=60, load_retries=1,
+                                 retry_backoff_s=0.01)
+        assert registry.refresh() is False  # both attempts flaked
+        counters = telemetry.snapshot()["counters"]
+        assert counters["serving.version_retries"] == 1
+        assert counters["serving.skipped_versions"] == 1
+        assert registry._skipped == {}  # NOT pinned: next poll retries
+        faults.clear_plan()
+        assert registry.refresh() is True  # the flake cleared
+        assert registry.engine.version == "v-00000001"
+    finally:
+        faults.clear_plan()
+        telemetry.reset()
+
+
 # ---------------------------------------------------------------------------
 # front ends
 # ---------------------------------------------------------------------------

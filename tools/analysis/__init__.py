@@ -24,6 +24,10 @@ before code lands. The passes, in execution order:
 6. :mod:`tools.analysis.locks` — L015: classes that spawn threads must
    guard attributes written from both the thread target and public
    methods with ``with self._lock/_cv``.
+7. :mod:`tools.analysis.faultcov` — L016: every registered fault-
+   injection point (``photon_ml_tpu.faults``) must be exercised by at
+   least one test — an unarmed injection seam is untested recovery code
+   wearing a coverage badge.
 
 :mod:`tools.analysis.driver` orchestrates all of it and owns the CLI
 surface (``--json``, ``--baseline``, ``--write-baseline``, ``--root``).

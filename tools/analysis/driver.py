@@ -10,7 +10,7 @@ import glob as _glob
 import os
 from typing import Optional
 
-from tools.analysis import hotpath, jitpurity, local, locks
+from tools.analysis import faultcov, hotpath, jitpurity, local, locks
 from tools.analysis.callgraph import build_graph
 from tools.analysis.core import (
     Finding,
@@ -111,6 +111,10 @@ def analyze(
     findings.extend(hotpath.run(graph, require_seeds=require_seeds))
     findings.extend(jitpurity.run(graph))
     findings.extend(locks.run(graph))
+    if require_seeds:
+        # L016 fault-point coverage needs the real tests/ tree; reduced
+        # fixture trees (require_seeds=False) legitimately carry neither
+        findings.extend(faultcov.run(files))
     graph_stats = {
         "modules": len(graph.modules),
         "functions": len(graph.functions),

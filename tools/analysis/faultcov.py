@@ -1,0 +1,119 @@
+"""Pass 5 — L016 fault-point test coverage.
+
+A fault-injection seam that no test arms is dead weight that LOOKS like
+coverage: the crash matrix claims "every registered point is proven
+recoverable", but a point added in a refactor and never wired into a
+test would rot silently — the exact failure mode the injection registry
+exists to prevent. This pass closes the loop statically:
+
+- **registration sites** are found by AST: every
+  ``register_point("<id>", ...)`` call with a literal first argument
+  inside ``photon_ml_tpu/`` (the repo convention — module-level
+  constants bound at import; a non-literal id is itself flagged, since
+  neither this pass nor a reader can know what it registers);
+- **coverage** means the id appears inside at least one string literal
+  under ``tests/`` — an exact plan rule (``FaultRule("my.seam", ...)``),
+  an env-transported JSON plan, or the crash-matrix enumeration test's
+  explicit expected-points list all count. Substring matching over
+  literals keeps JSON blobs covered without executing anything.
+
+Scope: like the other interprocedural passes this runs over the real
+tree only — reduced test trees (``require_seeds=False`` in the driver)
+skip it, as does a tree that carries no tests at all.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Sequence
+
+from tools.analysis.core import Finding, SourceFile
+
+_TESTS_PREFIX = "tests" + os.sep
+_PACKAGE_PREFIX = "photon_ml_tpu" + os.sep
+
+
+def _registration_sites(
+    package_files: Sequence[SourceFile],
+) -> tuple[list[tuple[str, int, str]], list[Finding]]:
+    """(rel, line, point_id) per literal ``register_point`` call, plus
+    findings for non-literal registrations (unverifiable ids)."""
+    sites: list[tuple[str, int, str]] = []
+    findings: list[Finding] = []
+    for sf in package_files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (
+                func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None
+            )
+            if name != "register_point" or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                sites.append((sf.rel, node.lineno, first.value))
+            else:
+                findings.append(
+                    Finding(
+                        path=sf.rel,
+                        line=node.lineno,
+                        code="L016",
+                        message=(
+                            "register_point() with a non-literal id — "
+                            "the fault-point registry must be statically "
+                            "enumerable (tests and this pass key on the "
+                            "literal id)"
+                        ),
+                    )
+                )
+    return sites, findings
+
+
+def _test_string_literals(files: Sequence[SourceFile]) -> list[str]:
+    out: list[str] = []
+    for sf in files:
+        if sf.tree is None or not sf.rel.startswith(_TESTS_PREFIX):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                out.append(node.value)
+    return out
+
+
+def run(files: Sequence[SourceFile]) -> list[Finding]:
+    package_files = [
+        sf for sf in files if sf.rel.startswith(_PACKAGE_PREFIX)
+    ]
+    sites, findings = _registration_sites(package_files)
+    if not sites:
+        return findings
+    literals = _test_string_literals(files)
+    if not literals:
+        return findings  # no tests in this tree (reduced fixture)
+    for rel, line, point in sites:
+        if any(point in lit for lit in literals):
+            continue
+        findings.append(
+            Finding(
+                path=rel,
+                line=line,
+                code="L016",
+                message=(
+                    f"fault point '{point}' is not exercised by any "
+                    "test — no string literal under tests/ mentions it "
+                    "(arm it in a plan, or add it to the crash-matrix "
+                    "expected-points list)"
+                ),
+            )
+        )
+    return findings

@@ -1,0 +1,310 @@
+"""The crash matrix: prove recovery for EVERY write-path fault point.
+
+Spark's recovery machinery was exercised continuously by production task
+retries; ours only runs when something breaks. This harness makes the
+proof systematic instead of anecdotal: for every registered write-path
+``FaultPoint`` (the atomic checkpoint protocol's phases — see
+``photon_ml_tpu/faults/plan.py``), it
+
+1. runs a deterministic streamed random-effect fit in a SUBPROCESS armed
+   via ``PHOTON_FAULT_PLAN`` with an ``exit`` rule at that point — the
+   process dies with ``os._exit`` (no unwinding, no atexit: a real
+   preemption/OOM-kill shape) and the harness asserts it died with the
+   injection exit code (113), i.e. AT the seam and not elsewhere;
+2. re-runs the same fit UNARMED in the same working directory — the
+   restore path walks newest-first past whatever the crash left behind
+   (a half-assembled ``.tmp-`` dir, a payload without a manifest, a
+   durable checkpoint without retention applied) and resumes;
+3. asserts the resumed fit's final table EXACTLY matches the
+   uninterrupted reference fit.
+
+"newest-valid restore falls back past corrupt checkpoints" is thereby an
+enumerated, CI-enforced property: tests/test_chaos.py runs a
+budget-bounded slice of this matrix in tier-1, and static-analysis rule
+L016 (tools/analysis/faultcov.py) refuses fault points no test names.
+
+CLI::
+
+    python -m tools.chaos --workdir /tmp/chaos            # full matrix
+    python -m tools.chaos --workdir /tmp/chaos --json out.json
+    python -m tools.chaos --worker --dir D                # one fit (internal)
+
+The worker fit is self-contained and seed-deterministic (same chunk data
+in every process), checkpoints at EVERY chunk boundary, and resumes from
+the newest valid checkpoint on restart — the crash can land anywhere in
+the protocol and the rerun must still converge to the reference bits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+#: the worker fit's shape: small enough for CI, multi-chunk enough that a
+#: first-boundary crash resumes mid-stream, entity count divisible by the
+#: 8-device virtual mesh for sharded variants
+N_ENTITIES = 16
+N_ROWS = 8
+DIM = 4
+N_CHUNKS = 4
+DATA_SEED = 20260803
+
+
+def _worker_env(plan: Optional[dict]) -> dict:
+    """Subprocess environment: CPU jax (cheap, deterministic), the shared
+    compile cache if the parent set one, and the fault plan (if any)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PHOTON_FAULT_PLAN", None)
+    if plan is not None:
+        env["PHOTON_FAULT_PLAN"] = json.dumps(plan)
+    return env
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_worker(
+    workdir: str, plan: Optional[dict] = None, timeout: float = 600.0
+) -> subprocess.CompletedProcess:
+    """One worker fit in ``workdir`` (created if needed); checkpoints land
+    in ``workdir/ckpt``, the final table in ``workdir/final.npy``."""
+    os.makedirs(workdir, exist_ok=True)
+    return subprocess.run(
+        [sys.executable, "-m", "tools.chaos", "--worker", "--dir", workdir],
+        env=_worker_env(plan),
+        cwd=_repo_root(),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def exit_plan(point: str, nth: int = 1) -> dict:
+    """A fault plan that hard-kills the process at ``point``'s nth hit."""
+    return {"rules": [{"point": point, "action": "exit", "nth": nth}]}
+
+
+def run_matrix(
+    workdir: str,
+    points: Optional[Sequence[str]] = None,
+    budget_s: Optional[float] = None,
+    nth: int = 1,
+) -> dict:
+    """The crash matrix. Returns a JSON-safe report; ``ok`` is True only
+    when every ATTEMPTED point passed all three assertions.
+
+    ``budget_s`` bounds wall time: once exceeded, remaining points are
+    reported under ``skipped`` (NEVER silently dropped) — the tier-1
+    slice uses this so chaos coverage scales with the CI budget while
+    the full matrix stays one CLI call away.
+    """
+    import numpy as np
+
+    from photon_ml_tpu import faults
+
+    # registration happens at import time; pull in every module that owns
+    # a write-path seam so the enumeration is complete
+    import photon_ml_tpu.game.checkpoint  # noqa: F401
+
+    all_points = faults.write_path_points()
+    points = list(points) if points is not None else all_points
+    unknown = sorted(set(points) - set(all_points))
+    if unknown:
+        raise ValueError(
+            f"not registered write-path fault points: {unknown} "
+            f"(known: {all_points})"
+        )
+    t0 = time.monotonic()
+    report: dict = {
+        "workdir": workdir,
+        "points": points,
+        "nth": nth,
+        "results": {},
+        "skipped": [],
+        "ok": True,
+    }
+
+    # uninterrupted reference fit (also warms the jax compile cache the
+    # armed/resume runs reuse)
+    ref_dir = os.path.join(workdir, "reference")
+    proc = run_worker(ref_dir)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"reference fit failed (rc={proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+    reference = np.load(os.path.join(ref_dir, "final.npy"))
+
+    for point in points:
+        if budget_s is not None and time.monotonic() - t0 > budget_s:
+            report["skipped"] = [
+                p for p in points if p not in report["results"]
+            ]
+            break
+        entry: dict = {"point": point}
+        point_dir = os.path.join(workdir, point.replace(".", "_"))
+        armed = run_worker(point_dir, plan=exit_plan(point, nth=nth))
+        entry["armed_rc"] = armed.returncode
+        if armed.returncode != faults.DEFAULT_EXIT_CODE:
+            entry["error"] = (
+                f"armed run exited {armed.returncode}, expected "
+                f"{faults.DEFAULT_EXIT_CODE} (did the point fire?)\n"
+                f"{armed.stdout[-1000:]}\n{armed.stderr[-1000:]}"
+            )
+            report["results"][point] = entry
+            report["ok"] = False
+            continue
+        resumed = run_worker(point_dir)  # unarmed rerun: restore + finish
+        entry["resume_rc"] = resumed.returncode
+        if resumed.returncode != 0:
+            entry["error"] = (
+                f"resume run failed (rc={resumed.returncode}):\n"
+                f"{resumed.stdout[-1000:]}\n{resumed.stderr[-1000:]}"
+            )
+            report["results"][point] = entry
+            report["ok"] = False
+            continue
+        got = np.load(os.path.join(point_dir, "final.npy"))
+        entry["max_abs_delta"] = float(np.max(np.abs(got - reference)))
+        entry["exact"] = bool(np.array_equal(got, reference))
+        try:
+            summary = json.loads(resumed.stdout.strip().splitlines()[-1])
+            entry["resumed_from_chunk"] = summary.get("start_chunk")
+        except (ValueError, IndexError):
+            pass
+        if not entry["exact"]:
+            entry["error"] = (
+                "resumed final table does not match the uninterrupted "
+                f"reference (max |delta| = {entry['max_abs_delta']:g})"
+            )
+            report["ok"] = False
+        report["results"][point] = entry
+    report["elapsed_s"] = round(time.monotonic() - t0, 3)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# the worker fit (runs in the subprocess)
+# ---------------------------------------------------------------------------
+
+
+def _worker_main(directory: str) -> int:
+    import numpy as np
+
+    os.makedirs(directory, exist_ok=True)
+    import jax.numpy as jnp
+
+    from photon_ml_tpu import faults
+    from photon_ml_tpu.game.checkpoint import (
+        CheckpointSpec,
+        StreamingCheckpointManager,
+    )
+    from photon_ml_tpu.game.streaming import (
+        ShardedCoefficientTable,
+        StreamingRandomEffectTrainer,
+    )
+    from photon_ml_tpu.ops.dense import DenseBatch
+    from photon_ml_tpu.optim import (
+        OptimizerConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+
+    faults.warn_if_armed()
+    rng = np.random.default_rng(DATA_SEED)
+    X = rng.normal(size=(N_ENTITIES, N_ROWS, DIM))
+    W = rng.normal(size=(N_ENTITIES, DIM))
+    z = np.einsum("erk,ek->er", X, W)
+    y = (rng.random((N_ENTITIES, N_ROWS)) < 1 / (1 + np.exp(-z))).astype(
+        float
+    )
+    per = N_ENTITIES // N_CHUNKS
+
+    def chunk(lo, hi):
+        return DenseBatch(
+            x=X[lo:hi].astype(np.float32),
+            labels=y[lo:hi].astype(np.float32),
+            offsets=np.zeros((hi - lo, N_ROWS), np.float32),
+            weights=np.ones((hi - lo, N_ROWS), np.float32),
+        )
+
+    chunks = [(i * per, chunk(i * per, (i + 1) * per))
+              for i in range(N_CHUNKS)]
+    cfg = OptimizerConfig(
+        max_iterations=60,
+        tolerance=1e-9,
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=0.3,
+    )
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(directory=os.path.join(directory, "ckpt"), every=1)
+    )
+    state = mgr.restore()  # newest VALID; falls back past crash debris
+    table = ShardedCoefficientTable(N_ENTITIES, DIM)
+    start_chunk = 0
+    if state is not None:
+        table.write_chunk(0, jnp.asarray(state.coefficients))
+        start_chunk = state.next_chunk
+    trainer = StreamingRandomEffectTrainer("logistic", cfg, prefetch=False)
+    trainer.train(table, chunks, checkpointer=mgr, start_chunk=start_chunk)
+    final = os.path.join(directory, "final.npy")
+    np.save(final, table.to_numpy())
+    print(json.dumps({
+        "final": final,
+        "resumed": state is not None,
+        "start_chunk": start_chunk,
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools.chaos", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("--worker", action="store_true",
+                        help="run ONE worker fit (internal)")
+    parser.add_argument("--dir", help="worker fit directory (--worker)")
+    parser.add_argument("--workdir", help="matrix working directory")
+    parser.add_argument("--points", nargs="*",
+                        help="subset of write-path points (default: all)")
+    parser.add_argument("--nth", type=int, default=1,
+                        help="crash on the nth hit of each point (default 1)")
+    parser.add_argument("--budget-s", type=float,
+                        help="wall-time budget; leftover points reported "
+                        "as skipped")
+    parser.add_argument("--json", dest="json_out",
+                        help="write the matrix report to this path")
+    args = parser.parse_args(argv)
+    if args.worker:
+        if not args.dir:
+            parser.error("--worker requires --dir")
+        return _worker_main(args.dir)
+    if not args.workdir:
+        parser.error("--workdir is required (or --worker --dir)")
+    report = run_matrix(
+        args.workdir, points=args.points, budget_s=args.budget_s,
+        nth=args.nth,
+    )
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+    for point, entry in report["results"].items():
+        status = "ok" if entry.get("exact") else "FAIL"
+        print(f"{status:4s} {point}  (armed rc={entry.get('armed_rc')}, "
+              f"resumed from chunk {entry.get('resumed_from_chunk')})")
+    for point in report["skipped"]:
+        print(f"skip {point}  (budget exhausted)")
+    print(f"{'OK' if report['ok'] else 'FAILED'} in "
+          f"{report['elapsed_s']:.1f}s")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
