@@ -48,6 +48,12 @@ def main():
     # spans/metrics opt in via PHOTON_TRACE_OUT / PHOTON_TELEMETRY_OUT; the
     # snapshot below rides the bench JSON either way (one shared schema)
     telemetry.configure_from_env()
+    # an armed PHOTON_FAULT_PLAN would corrupt the bench numbers silently
+    # (injected stalls/errors read as regressions) — same loud warning the
+    # train/serve drivers give
+    from photon_ml_tpu import faults
+
+    faults.warn_if_armed()
 
     n_rows = 1_000_000
     n_features = 10_000

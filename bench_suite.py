@@ -415,6 +415,18 @@ def main(argv=None) -> int:
         "with a note",
     )
     args = parser.parse_args(argv)
+    from photon_ml_tpu import faults
+
+    if faults.warn_if_armed():
+        if args.gate:
+            # gated runs are the CI perf contract: numbers produced under
+            # injection are not comparable to any baseline — refuse
+            print(
+                "bench_suite: refusing --gate with PHOTON_FAULT_PLAN "
+                "armed (injected faults corrupt gated metrics)",
+                file=sys.stderr,
+            )
+            return 2
     deadline = budget_deadline()
     results = run_suite(deadline=deadline)
     if args.multichip:

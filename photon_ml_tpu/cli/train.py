@@ -201,6 +201,9 @@ def _init_distributed_and_mesh(config: Mapping):
     dist = config.get("distributed")
     if dist is not None:
         env = multihost.DistributedConfig.from_env()
+        # flaky gloo/grpc rendezvous retries with backoff
+        # (multihost.init_retries counted; FleetInitError after
+        # exhaustion) — "init_retries"/"init_backoff_s" config keys
         multihost.initialize(
             multihost.DistributedConfig(
                 coordinator_address=dist.get(
@@ -209,6 +212,10 @@ def _init_distributed_and_mesh(config: Mapping):
                 num_processes=dist.get("num_processes", env.num_processes),
                 process_id=dist.get("process_id", env.process_id),
                 auto=bool(dist.get("auto", env.auto)),
+                init_retries=int(dist.get("init_retries", env.init_retries)),
+                init_backoff_s=float(
+                    dist.get("init_backoff_s", env.init_backoff_s)
+                ),
             )
         )
     if multihost.is_multiprocess():
@@ -217,11 +224,14 @@ def _init_distributed_and_mesh(config: Mapping):
         # rejected by jax) across processes. Multi-host training drives
         # the per-process APIs instead (multihost.process_slice /
         # host_local_array / game.streaming.LocalChunk — see README
-        # "Multi-host deployment"); the CLI stops here rather than train
-        # one divergent model per host.
+        # "Multi-host deployment"), supervised by tools/fleet.py (member
+        # liveness, coordinated checkpoints, survivor-elastic relaunch);
+        # the CLI stops here rather than train one divergent model per
+        # host.
         raise NotImplementedError(
             "the `train` CLI does not span processes yet; write a worker "
-            "with the per-process APIs (README 'Multi-host deployment')"
+            "with the per-process APIs and supervise it with tools/fleet "
+            "(README 'Multi-host deployment' / 'Fleet supervision')"
         )
     mesh_spec = config.get("mesh")
     if not mesh_spec and dist is None:

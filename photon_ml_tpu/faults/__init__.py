@@ -24,6 +24,7 @@ from photon_ml_tpu.faults.plan import (
     clear_plan,
     corrupt_array,
     corrupt_health,
+    distributed_points,
     fault_point,
     install_from_env,
     install_plan,
@@ -46,6 +47,7 @@ __all__ = [
     "clear_plan",
     "corrupt_array",
     "corrupt_health",
+    "distributed_points",
     "fault_point",
     "install_from_env",
     "install_plan",

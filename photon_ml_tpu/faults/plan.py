@@ -85,6 +85,7 @@ class FaultPointInfo:
     name: str
     write_path: bool  # checkpoint/publish write protocol: chaos-matrix set
     description: str
+    distributed: bool = False  # multi-process seam: fleet crash-matrix set
 
 
 _REGISTRY: dict[str, FaultPointInfo] = {}
@@ -92,11 +93,16 @@ _REGISTRY_LOCK = threading.Lock()
 
 
 def register_point(
-    name: str, *, write_path: bool = False, description: str = ""
+    name: str,
+    *,
+    write_path: bool = False,
+    distributed: bool = False,
+    description: str = "",
 ) -> str:
     """Declare an injection seam (module level, import time). Idempotent;
-    re-registering with a DIFFERENT write_path is a programming error.
-    Returns ``name`` so call sites bind it to a module constant."""
+    re-registering with a DIFFERENT write_path/distributed classification
+    is a programming error. Returns ``name`` so call sites bind it to a
+    module constant."""
     with _REGISTRY_LOCK:
         existing = _REGISTRY.get(name)
         if existing is not None:
@@ -105,9 +111,17 @@ def register_point(
                     f"fault point '{name}' already registered with "
                     f"write_path={existing.write_path}"
                 )
+            if existing.distributed != distributed:
+                raise ValueError(
+                    f"fault point '{name}' already registered with "
+                    f"distributed={existing.distributed}"
+                )
             return name
         _REGISTRY[name] = FaultPointInfo(
-            name=name, write_path=write_path, description=description
+            name=name,
+            write_path=write_path,
+            description=description,
+            distributed=distributed,
         )
     return name
 
@@ -124,6 +138,13 @@ def write_path_points() -> list[str]:
     matrix (tools/chaos.py) enumerates, sorted for determinism."""
     with _REGISTRY_LOCK:
         return sorted(n for n, i in _REGISTRY.items() if i.write_path)
+
+
+def distributed_points() -> list[str]:
+    """The multi-process seams — the set the DISTRIBUTED crash matrix
+    (tools/chaos.py fleet rows) enumerates, sorted for determinism."""
+    with _REGISTRY_LOCK:
+        return sorted(n for n, i in _REGISTRY.items() if i.distributed)
 
 
 @dataclasses.dataclass(frozen=True)

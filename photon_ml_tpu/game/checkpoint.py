@@ -76,6 +76,16 @@ _FP_MANIFEST_READ = faults.register_point(
     "checkpoint.manifest.read",
     description="manifest open/parse during restore (corrupt-skip path)",
 )
+# Coordinated (multi-process) saves add one more seam: a member dying
+# between writing its shard payloads and landing its per-process manifest
+# leaves the quorum forever incomplete — process 0 must time out and
+# abandon the checkpoint (uncertified), never hang the fleet or certify a
+# partial one.
+_FP_PEER_MANIFEST = faults.register_point(
+    "checkpoint.peer_manifest", distributed=True,
+    description="before a member writes its per-process shard manifest "
+    "during a coordinated save",
+)
 
 
 class CheckpointError(RuntimeError):
@@ -106,18 +116,27 @@ class CheckpointSpec:
     cleared at manager construction (otherwise a stale run's
     higher-numbered steps would outlive this run's through retention and
     hijack a later resume).
+
+    ``quorum_timeout_s`` only matters for COORDINATED (multi-process)
+    streaming saves: how long process 0 waits for every peer's manifest
+    before abandoning the checkpoint uncertified (a dead peer must never
+    hang the save), and how long peers wait for process 0's rendezvous /
+    certification before giving up.
     """
 
     directory: str
     every: int = 1
     keep_last: int = 3
     resume: bool = True
+    quorum_timeout_s: float = 60.0
 
     def __post_init__(self):
         if self.every < 1:
             raise ValueError("checkpoint every must be >= 1")
         if self.keep_last < 1:
             raise ValueError("checkpoint keep_last must be >= 1")
+        if self.quorum_timeout_s <= 0:
+            raise ValueError("checkpoint quorum_timeout_s must be > 0")
 
 
 @dataclasses.dataclass
@@ -524,7 +543,18 @@ class StreamingCheckpointManager:
         telemetry.gauge("checkpoint.max_shard_fetch_bytes").set(max_bytes)
         return descriptors
 
-    def save(self, state: StreamCheckpointState) -> str:
+    def save(self, state: StreamCheckpointState) -> Optional[str]:
+        """Persist ``state`` as ``chunk-<next_chunk>``; the final path.
+
+        In a multi-process fleet this is the COORDINATED protocol
+        (:meth:`_save_coordinated` — every member must call save at the
+        same boundary); it may return None when the quorum never formed
+        (a peer died mid-save) — the directory is left uncertified and
+        restore falls back past it."""
+        import jax
+
+        if jax.process_count() > 1:
+            return self._save_coordinated(state)
         name = f"chunk-{state.next_chunk:08d}"
         final = os.path.join(self.spec.directory, name)
         tmp = os.path.join(self.spec.directory, f".tmp-{name}")
@@ -573,12 +603,244 @@ class StreamingCheckpointManager:
         self._apply_retention()
         return final
 
+    # -- coordinated multi-process saves -------------------------------------
+
+    @staticmethod
+    def _wait_until(predicate, timeout_s: float, poll_s: float = 0.05) -> bool:
+        """Poll ``predicate`` until true or ``timeout_s`` elapses — the
+        filesystem-rendezvous barrier primitive. Time-bounded by design:
+        a dead peer must never hang the fleet's save."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if predicate():
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    def _peer_manifest_name(self, pid: int) -> str:
+        return f"manifest.proc-{pid:04d}.json"
+
+    def _save_coordinated(self, state: StreamCheckpointState) -> Optional[str]:
+        """Multi-process save: every member writes its ADDRESSABLE shards
+        plus a per-process manifest into a shared ``.tmp-`` directory;
+        process 0 certifies the quorum manifest (``manifest.json``) only
+        after every peer's manifest lands, then renames the directory
+        into place. Completeness therefore has a single witness — the
+        quorum manifest — and a checkpoint whose fleet lost a member
+        mid-save is left uncertified (``checkpoint.quorum_timeouts``),
+        exactly as restorable-past as a single-process crash's debris.
+
+        Rendezvous is filesystem-only (requires the checkpoint directory
+        to be shared across the fleet, same as restore does) and every
+        wait is bounded by ``spec.quorum_timeout_s``."""
+        import json
+
+        import jax
+
+        pid = jax.process_index()
+        nproc = jax.process_count()
+        name = f"chunk-{state.next_chunk:08d}"
+        final = os.path.join(self.spec.directory, name)
+        tmp = os.path.join(self.spec.directory, f".tmp-{name}")
+        rendezvous = os.path.join(tmp, "rendezvous.json")
+        timeout = self.spec.quorum_timeout_s
+        coeffs = state.coefficients
+        dim = int(coeffs.shape[1])
+        with telemetry.span(
+            "checkpoint:save", next_chunk=state.next_chunk, coordinated=True
+        ):
+            faults.fault_point(_FP_SAVE_BEFORE_TMP)
+            if pid == 0:
+                if os.path.exists(tmp):
+                    # stale debris from a crashed earlier save of this
+                    # chunk: move it aside ATOMICALLY so a racing peer
+                    # can never mistake old contents for this rendezvous
+                    trash = os.path.join(
+                        self.spec.directory, f".trash-{name}"
+                    )
+                    shutil.rmtree(trash, ignore_errors=True)
+                    os.rename(tmp, trash)
+                    shutil.rmtree(trash, ignore_errors=True)
+                os.makedirs(tmp)
+                atomic_write_json(
+                    rendezvous,
+                    {"num_processes": nproc,
+                     "next_chunk": int(state.next_chunk)},
+                )
+            else:
+                def _rendezvous_matches() -> bool:
+                    # content-validated, not mere existence: a STALE
+                    # rendezvous from an abandoned earlier save (or a
+                    # different fleet size replaying the same chunk)
+                    # must not lure this member into a tmp dir process 0
+                    # is about to trash
+                    try:
+                        with open(rendezvous, encoding="utf-8") as fh:
+                            doc = json.load(fh)
+                    except (OSError, ValueError):
+                        return False
+                    return (
+                        doc.get("num_processes") == nproc
+                        and doc.get("next_chunk") == int(state.next_chunk)
+                    )
+
+                if not self._wait_until(_rendezvous_matches, timeout):
+                    telemetry.counter("checkpoint.quorum_timeouts").inc()
+                    logger.warning(
+                        "coordinated save %s: no matching rendezvous from "
+                        "process 0 within %.1fs; abandoning (uncertified)",
+                        name, timeout,
+                    )
+                    return None
+            shard_files = self._write_entity_array(
+                tmp, f"coefficients-p{pid:04d}", coeffs
+            )
+            variance_files = None
+            if state.variances is not None:
+                variance_files = self._write_entity_array(
+                    tmp, f"variances-p{pid:04d}", state.variances
+                )
+            faults.fault_point(_FP_PEER_MANIFEST)
+            # the per-process manifest lands LAST (atomic): its presence
+            # certifies THIS member's shards complete
+            atomic_write_json(
+                os.path.join(tmp, self._peer_manifest_name(pid)),
+                {
+                    "process_id": pid,
+                    "num_processes": nproc,
+                    "next_chunk": int(state.next_chunk),
+                    "shards": shard_files,
+                    "variance_shards": variance_files,
+                },
+            )
+            telemetry.counter("checkpoint.peer_manifests").inc()
+            if pid != 0:
+                # wait for certification (rename) or abandonment; either
+                # way this member's save call returns — the outcome is
+                # process 0's to decide
+                self._wait_until(
+                    lambda: os.path.exists(final) or not os.path.exists(tmp),
+                    timeout,
+                )
+                if os.path.exists(final):
+                    telemetry.counter("checkpoint.saves").inc()
+                    return final
+                telemetry.counter("checkpoint.quorum_timeouts").inc()
+                logger.warning(
+                    "coordinated save %s was never certified by process 0",
+                    name,
+                )
+                return None
+            # process 0: the quorum barrier — every peer's manifest, or bust
+            peer_paths = [
+                os.path.join(tmp, self._peer_manifest_name(p))
+                for p in range(nproc)
+            ]
+            if not self._wait_until(
+                lambda: all(os.path.exists(p) for p in peer_paths), timeout
+            ):
+                missing = [
+                    p for pth, p in zip(peer_paths, range(nproc))
+                    if not os.path.exists(pth)
+                ]
+                telemetry.counter("checkpoint.quorum_timeouts").inc()
+                logger.warning(
+                    "coordinated save %s: peer manifest(s) from process(es) "
+                    "%s never landed within %.1fs — abandoning uncertified "
+                    "(restore will fall back past it)", name, missing, timeout,
+                )
+                return None
+            merged: list[dict] = []
+            merged_var: list[dict] = []
+            for path in peer_paths:
+                with open(path, encoding="utf-8") as fh:
+                    peer = json.load(fh)
+                merged.extend(peer["shards"])
+                merged_var.extend(peer.get("variance_shards") or ())
+            merged.sort(key=lambda d: int(d["row_start"]))
+            merged_var.sort(key=lambda d: int(d["row_start"]))
+            # the merged shard set DEFINES the checkpoint's entity axis:
+            # certify only a contiguous [0, N) cover (a replicated-row
+            # overlap or a hole means a peer wrote rows the fleet did not
+            # agree on — certifying it would hand restore a lie)
+            num_entities = 0
+            for d in merged:
+                if int(d["row_start"]) != num_entities:
+                    telemetry.counter(
+                        "checkpoint.quorum_cover_violations"
+                    ).inc()
+                    logger.warning(
+                        "coordinated save %s: merged shards do not cover "
+                        "the entity axis contiguously (gap/overlap at row "
+                        "%d) — abandoning uncertified", name, num_entities,
+                    )
+                    return None
+                num_entities += int(d["rows"])
+            # every payload byte a peer manifest names must actually be
+            # on disk — a peer raced into a stale tmp dir (its shards
+            # died with the trash) can land a manifest here, and
+            # certifying on metadata alone would certify a partial
+            # checkpoint
+            missing_payload = [
+                d["file"]
+                for d in (*merged, *merged_var)
+                if not os.path.exists(os.path.join(tmp, d["file"]))
+            ]
+            if missing_payload:
+                telemetry.counter(
+                    "checkpoint.quorum_cover_violations"
+                ).inc()
+                logger.warning(
+                    "coordinated save %s: peer manifest(s) name payload "
+                    "file(s) missing from the save dir (%s) — abandoning "
+                    "uncertified", name, missing_payload,
+                )
+                return None
+            faults.fault_point(_FP_SAVE_BEFORE_MANIFEST)
+            # the QUORUM manifest: written only after every peer landed,
+            # and the only artifact restore treats as certification
+            atomic_write_json(
+                os.path.join(tmp, _MANIFEST_FILE),
+                {
+                    "format_version": _STREAM_FORMAT_VERSION,
+                    "kind": "streaming",
+                    "next_chunk": int(state.next_chunk),
+                    "num_entities": num_entities,
+                    "dim": dim,
+                    "dtype": str(getattr(coeffs, "dtype", "float32")),
+                    "shards": merged,
+                    "variance_shards": merged_var or None,
+                    "sharding": _sharding_record(coeffs),
+                    "env": _environment_record(),
+                    "quorum": {"num_processes": nproc},
+                },
+                indent=2,
+                sort_keys=True,
+            )
+            faults.fault_point(_FP_SAVE_BEFORE_RENAME)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            faults.fault_point(_FP_SAVE_AFTER_RENAME)
+            fsync_dir(self.spec.directory)
+        telemetry.counter("checkpoint.saves").inc()
+        telemetry.gauge("checkpoint.last_save_ts").set(
+            telemetry.trace.TRACER.now()
+        )
+        self._apply_retention()
+        return final
+
     def _apply_retention(self) -> None:
         dirs = self._chunk_dirs()
         for _c, path in dirs[: -self.spec.keep_last]:
             shutil.rmtree(path, ignore_errors=True)
         for name in os.listdir(self.spec.directory):
-            if name.startswith(".tmp-chunk-"):
+            if name.startswith(".tmp-chunk-") or name.startswith(
+                ".trash-chunk-"
+            ):
                 shutil.rmtree(
                     os.path.join(self.spec.directory, name),
                     ignore_errors=True,

@@ -70,6 +70,9 @@ _FP_CHUNK_BOUNDARY = faults.register_point(
     "streaming.chunk.boundary",
     description="between a chunk solve and its checkpoint/stop handling",
 )
+# the fleet seam shared with the GSPMD solve dispatch: the last host-side
+# instruction before a chunk solve's cross-process collective program
+from photon_ml_tpu.parallel.distributed import FP_COLLECTIVE_ENTRY  # noqa: E402
 
 
 @lru_cache(maxsize=16)
@@ -461,6 +464,7 @@ class StreamingRandomEffectTrainer:
                     obj = damped_objective(
                         obj, self._guard.damping_for(attempt)
                     )
+                faults.fault_point(FP_COLLECTIVE_ENTRY)
                 res, var = self._solver(obj, batch, w0, self._l1, cons)
                 # injection seam: a `nan` rule here poisons the solve
                 # result, driving the guard's retry/rollback path on demand

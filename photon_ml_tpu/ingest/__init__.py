@@ -48,6 +48,7 @@ from photon_ml_tpu.ingest.planner import (  # noqa: F401
     ChunkPlan,
     FileMeta,
     plan_chunks,
+    plans_for_host,
     read_file_meta,
     scan_blocks,
 )
@@ -74,6 +75,7 @@ __all__ = [
     "PipelineClosed",
     "double_buffered",
     "plan_chunks",
+    "plans_for_host",
     "read_file_meta",
     "read_game_dataset_streamed",
     "scan_blocks",

@@ -220,3 +220,28 @@ def plan_chunks(
 
 def total_rows(plans: Sequence[ChunkPlan]) -> int:
     return sum(p.n_rows for p in plans)
+
+
+def plans_for_host(
+    plans: Sequence[ChunkPlan], process_id: int, num_processes: int
+) -> list[ChunkPlan]:
+    """The deterministic per-host slice of a global chunk plan: chunk
+    ``i`` belongs to host ``i % num_processes`` (round-robin over the
+    global order, so host loads stay balanced whatever the file sizes).
+
+    This is a pure function of ``(plans, num_processes)`` — no
+    coordination state — which is what makes SURVIVOR-ELASTIC resume
+    work: when a fleet member dies and the fit relaunches on fewer
+    hosts, every survivor recomputes the split for the new fleet size
+    and the dead host's chunks land on survivors automatically. Replay
+    from a checkpoint's ``next_chunk`` then re-decodes exactly the rows
+    the old fleet would have, in the same global order.
+    """
+    if num_processes < 1:
+        raise ValueError("num_processes must be >= 1")
+    if not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"process_id {process_id} out of range for "
+            f"{num_processes} host(s)"
+        )
+    return [p for p in plans if p.index % num_processes == process_id]

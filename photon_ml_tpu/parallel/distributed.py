@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from photon_ml_tpu import faults
 from photon_ml_tpu.telemetry.xla import instrumented_jit, record_collective
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.ops.sparse import SparseBatch
@@ -50,6 +51,19 @@ from photon_ml_tpu.optim.factory import OptimizerConfig, build_objective, dispat
 from photon_ml_tpu.parallel.mesh import DATA_AXIS
 
 Array = jax.Array
+
+# Fleet fault seam: the last host-side instruction before this process
+# commits to a cross-process collective program. A member hard-killed
+# here is the worst-case partial failure — its peers enter the
+# collective and block against a partner that is never coming, so
+# recovery is the SUPERVISOR's job (liveness detection + boundary stop +
+# survivor relaunch), not an exception handler's. Hit by the GSPMD solve
+# dispatch below and by the streamed chunk solve (game/streaming.py).
+FP_COLLECTIVE_ENTRY = faults.register_point(
+    "parallel.collective.entry", distributed=True,
+    description="host-side entry into a multi-process collective program "
+    "(gspmd/distributed solve dispatch, streamed chunk solves)",
+)
 
 
 def _unstack_batch(stacked: SparseBatch) -> SparseBatch:
@@ -151,6 +165,7 @@ def _solve_common(
         int(w0.nbytes) + 4,
         count=max(int(config.max_iterations), 1),
     )
+    faults.fault_point(FP_COLLECTIVE_ENTRY)
     return solver(
         obj, batch, w0, l1, constraints, init_value, init_grad_norm
     )

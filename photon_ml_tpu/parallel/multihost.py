@@ -36,19 +36,54 @@ single-process 8-device run bit-for-tolerance.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
+import threading
+import time
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from photon_ml_tpu import faults
+
+logger = logging.getLogger("photon_ml_tpu.parallel.multihost")
+
 _ENV_COORDINATOR = "PHOTON_ML_COORDINATOR"
 _ENV_NUM_PROCESSES = "PHOTON_ML_NUM_PROCESSES"
 _ENV_PROCESS_ID = "PHOTON_ML_PROCESS_ID"
 _ENV_AUTO = "PHOTON_ML_AUTO_DISTRIBUTED"
+_ENV_INIT_RETRIES = "PHOTON_ML_INIT_RETRIES"
 
 _initialized = False
+
+# fleet fault seams (photon_ml_tpu.faults): distributed init (an `exit`
+# rule here is a member preempted before it ever joined; `raise`/`io`
+# rules are the flaky-gloo shape the bounded retry absorbs) and the
+# heartbeat touch (an `exit` rule is a member dying between collectives —
+# the supervisor sees the stale proc-<i>.alive file, not an exit hook)
+_FP_INIT = faults.register_point(
+    "multihost.init", distributed=True,
+    description="jax.distributed.initialize attempt (retried with backoff)",
+)
+_FP_HEARTBEAT = faults.register_point(
+    "fleet.heartbeat", distributed=True,
+    description="one liveness-file touch by the heartbeat writer thread",
+)
+
+
+class FleetInitError(RuntimeError):
+    """jax.distributed initialization failed every attempt; carries the
+    coordinator address so the operator knows WHICH rendezvous died."""
+
+    def __init__(self, coordinator: Optional[str], attempts: int, last: Exception):
+        self.coordinator = coordinator
+        super().__init__(
+            f"could not join the fleet at coordinator "
+            f"{coordinator or '<auto-detected>'} after {attempts} "
+            f"attempt(s): {last}"
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,6 +104,10 @@ class DistributedConfig:
     process_id: Optional[int] = None
     local_device_ids: Optional[tuple[int, ...]] = None
     auto: bool = False  # TPU-pod auto-detection
+    #: bounded retry around flaky gloo/grpc rendezvous: total attempts =
+    #: 1 + init_retries, exponential backoff starting at init_backoff_s
+    init_retries: int = 3
+    init_backoff_s: float = 0.5
 
     @classmethod
     def from_env(cls) -> "DistributedConfig":
@@ -76,11 +115,13 @@ class DistributedConfig:
         nproc = os.environ.get(_ENV_NUM_PROCESSES)
         pid = os.environ.get(_ENV_PROCESS_ID)
         auto = os.environ.get(_ENV_AUTO, "").lower() in ("1", "true", "yes")
+        retries = os.environ.get(_ENV_INIT_RETRIES)
         return cls(
             coordinator_address=addr,
             num_processes=int(nproc) if nproc else None,
             process_id=int(pid) if pid else None,
             auto=auto,
+            init_retries=int(retries) if retries else 3,
         )
 
     @property
@@ -111,11 +152,43 @@ class DistributedConfig:
             )
 
 
+def _init_attempts(cfg: DistributedConfig, attempt_fn) -> None:
+    """Bounded-retry driver around one initialize attempt: transient
+    rendezvous failures (grpc refused, gloo handshake flakes — surfaced
+    by jax as RuntimeError/OSError) back off exponentially and count
+    ``multihost.init_retries``; exhaustion raises the typed
+    :class:`FleetInitError` naming the coordinator."""
+    from photon_ml_tpu import telemetry
+
+    attempts = max(int(cfg.init_retries), 0) + 1
+    last: Optional[Exception] = None
+    for attempt in range(attempts):
+        if attempt:
+            telemetry.counter("multihost.init_retries").inc()
+            backoff = cfg.init_backoff_s * (2 ** (attempt - 1))
+            logger.warning(
+                "distributed init failed (%s); retry %d/%d in %.2fs",
+                last, attempt, attempts - 1, backoff,
+            )
+            time.sleep(backoff)
+        try:
+            faults.fault_point(_FP_INIT)
+            attempt_fn()
+            return
+        except (RuntimeError, OSError, ConnectionError, TimeoutError) as e:
+            last = e
+    assert last is not None
+    raise FleetInitError(cfg.coordinator_address, attempts, last)
+
+
 def initialize(config: Optional[DistributedConfig] = None) -> None:
     """Connect this process to the fleet (idempotent).
 
     Must run before the first jax computation. Single-process callers may
-    skip it entirely; :func:`global_mesh` works either way.
+    skip it entirely; :func:`global_mesh` works either way. Transient
+    rendezvous failures are retried ``config.init_retries`` times with
+    exponential backoff (``multihost.init_retries`` counted); exhaustion
+    raises :class:`FleetInitError` naming the coordinator address.
     """
     global _initialized
     if _initialized:
@@ -132,16 +205,19 @@ def initialize(config: Optional[DistributedConfig] = None) -> None:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:  # noqa: BLE001 — unknown option on other jax versions
             pass
-        jax.distributed.initialize(
-            coordinator_address=cfg.coordinator_address,
-            num_processes=cfg.num_processes,
-            process_id=cfg.process_id,
-            local_device_ids=cfg.local_device_ids,
+        _init_attempts(
+            cfg,
+            lambda: jax.distributed.initialize(
+                coordinator_address=cfg.coordinator_address,
+                num_processes=cfg.num_processes,
+                process_id=cfg.process_id,
+                local_device_ids=cfg.local_device_ids,
+            ),
         )
         _initialized = True
     elif cfg.auto:
         # TPU pod: topology/coordinator come from the TPU runtime env.
-        jax.distributed.initialize()
+        _init_attempts(cfg, jax.distributed.initialize)
         _initialized = True
 
 
@@ -233,6 +309,135 @@ def replicate_to_all(value: np.ndarray, mesh: Mesh) -> jax.Array:
     return jax.make_array_from_process_local_data(
         sharding, np.asarray(value), global_shape=np.shape(value)
     )
+
+
+# ---------------------------------------------------------------------------
+# fleet liveness: heartbeat files + supervisor-side staleness detection
+# ---------------------------------------------------------------------------
+
+#: heartbeat file name for one fleet member
+def heartbeat_path(directory: str, process_id: int) -> str:
+    return os.path.join(directory, f"proc-{int(process_id)}.alive")
+
+
+class HeartbeatWriter:
+    """Touch ``proc-<i>.alive`` on a cadence from a daemon thread.
+
+    The liveness signal is the file's MTIME, so detection needs only a
+    shared filesystem — no RPC with a process that may already be dead.
+    ``os._exit`` (a real preemption, or the ``fleet.heartbeat`` exit
+    rule) kills this thread with the process, and the file goes stale;
+    a supervisor reading :func:`dead_peers` sees the member as dead once
+    staleness exceeds its deadline. Python-thread cadence jitter is why
+    deadlines should be several intervals long.
+    """
+
+    def __init__(self, directory: str, process_id: int,
+                 interval_s: float = 1.0):
+        if interval_s <= 0:
+            raise ValueError("heartbeat interval_s must be > 0")
+        self.path = heartbeat_path(directory, process_id)
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self) -> None:
+        """One touch (also called inline by the worker loop so a BLOCKED
+        main thread with a live writer thread still counts as alive —
+        liveness means "the process exists", progress is telemetry's
+        job)."""
+        faults.fault_point(_FP_HEARTBEAT)
+        with open(self.path, "a"):
+            os.utime(self.path, None)
+
+    def start(self) -> "HeartbeatWriter":
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self.beat()
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.beat()
+            except OSError as e:  # a torn-down workdir must not kill the run
+                logger.warning("heartbeat touch failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s * 4)
+
+
+def dead_peers(
+    directory: str,
+    num_processes: int,
+    deadline_s: float,
+    now: Optional[float] = None,
+) -> list[int]:
+    """Process ids whose heartbeat file is STALE beyond ``deadline_s``.
+
+    A missing file does NOT count dead — the member may not have reached
+    its first beat yet (the supervisor pairs this with exit-code
+    watching, which catches members that die before beating)."""
+    # wall clock by necessity: staleness is measured against file MTIMES,
+    # which are wall-clock — monotonic time has no common epoch with them
+    now = time.time() if now is None else now  # photon: noqa[L006]
+    dead = []
+    for pid in range(int(num_processes)):
+        try:
+            mtime = os.path.getmtime(heartbeat_path(directory, pid))
+        except OSError:
+            continue
+        if now - mtime > deadline_s:
+            dead.append(pid)
+    return dead
+
+
+def fleet_any(flag: bool, mesh: Optional[Mesh] = None,
+              axis: Optional[str] = None) -> bool:
+    """Fleet-consistent OR of a per-process bool — the agreement that
+    makes boundary stops CLEAN across a fleet.
+
+    A stop request (SIGTERM) lands on ONE member; if each member read
+    only its local flag, the signaled member would stop at boundary K
+    while a peer that checked a moment earlier sails into chunk K+1's
+    collective and blocks forever against a stopped partner. Reducing
+    the flag through a tiny mesh collective makes every member see the
+    SAME verdict at the SAME boundary (SPMD programs run in lockstep),
+    so all members stop — and write their coordinated final checkpoint —
+    together. Single-process (or no mesh): just the local flag."""
+    if mesh is None or jax.process_count() == 1:
+        return bool(flag)
+    from photon_ml_tpu.parallel import sharding as psharding
+
+    resolved = axis or psharding.model_axis(mesh) or psharding.data_axis(mesh)
+    if resolved is None:
+        resolved = mesh.axis_names[0]
+    n = psharding.axis_size(mesh, resolved)
+    lo, hi = process_slice(n, mesh, resolved)
+    local = np.full((hi - lo,), 1.0 if flag else 0.0, np.float32)
+    arr = host_local_array(local, mesh, P(resolved), global_shape=(n,))
+    reduced = _fleet_any_program(mesh)(arr)
+    return bool(float(np.asarray(reduced.addressable_data(0))) > 0.0)
+
+
+_FLEET_ANY_CACHE: dict = {}
+
+
+def _fleet_any_program(mesh: Mesh):
+    prog = _FLEET_ANY_CACHE.get(mesh)
+    if prog is None:
+        import jax.numpy as jnp
+
+        prog = jax.jit(
+            jnp.max, out_shardings=NamedSharding(mesh, P())
+        )
+        _FLEET_ANY_CACHE[mesh] = prog
+    return prog
 
 
 def gather_to_host(arr: jax.Array) -> np.ndarray:

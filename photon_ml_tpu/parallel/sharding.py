@@ -167,10 +167,17 @@ def place_entity_rows(
         )
     sharding = entity_sharding(mesh, axis)
     if shape[0] % axis_size(mesh, sharding.spec[0]):
+        # name the LEGAL topologies: an operator picking a survivor
+        # count after losing hosts needs the valid sizes, not a modulus
+        valid = [
+            d for d in range(1, min(shape[0], jax.device_count()) + 1)
+            if shape[0] % d == 0
+        ]
         raise ElasticPlacementError(
             f"num_entities={shape[0]} must divide over the "
             f"{axis_size(mesh, sharding.spec[0])}-device "
-            f"'{sharding.spec[0]}' axis to re-place elastically"
+            f"'{sharding.spec[0]}' axis to re-place elastically; valid "
+            f"target axis sizes for this checkpoint: {valid}"
         )
 
     def callback(index):

@@ -1037,6 +1037,11 @@ class RunReport:
             "faults.injected", "serving.version_retries",
             "ingest.read_retries", "streaming.feed_retries",
             "solves.rolled_back", "solves.frozen",
+            # fleet recovery (multi-process fits under tools/fleet.py)
+            "recovery.fleet_member_deaths", "recovery.fleet_relaunches",
+            "checkpoint.quorum_timeouts", "checkpoint.peer_manifests",
+            "checkpoint.quorum_cover_violations",
+            "multihost.init_retries",
         )
         if not any(c.get(k) for k in keys):
             return None
@@ -1100,6 +1105,36 @@ class RunReport:
                 out.append(
                     f"- {n} transient-IO retry(ies) absorbed on {what}"
                 )
+        deaths = rec.get("recovery_fleet_member_deaths", 0)
+        relaunches = rec.get("recovery_fleet_relaunches", 0)
+        if deaths or relaunches:
+            out.append(
+                f"- **fleet: {deaths} member death(s), {relaunches} "
+                "survivor relaunch(es)** (supervised multi-process fit — "
+                "the fit continued on the surviving host set)"
+            )
+        quorum_timeouts = rec.get("checkpoint_quorum_timeouts", 0)
+        peer_manifests = rec.get("checkpoint_peer_manifests", 0)
+        if quorum_timeouts or peer_manifests:
+            out.append(
+                f"- coordinated checkpoints: {peer_manifests} per-process "
+                f"manifest(s) written, {quorum_timeouts} quorum "
+                "timeout(s) (saves abandoned uncertified — a dead peer "
+                "never hangs the fleet or certifies a partial checkpoint)"
+            )
+        cover = rec.get("checkpoint_quorum_cover_violations", 0)
+        if cover:
+            out.append(
+                f"- **{cover} coordinated save(s) abandoned on a "
+                "shard-cover violation** (merged peer shards had a "
+                "gap/overlap or a missing payload file — never certified)"
+            )
+        init_retries = rec.get("multihost_init_retries", 0)
+        if init_retries:
+            out.append(
+                f"- {init_retries} distributed-init retry(ies) absorbed "
+                "(flaky rendezvous, exponential backoff)"
+            )
         rolled = rec.get("solves_rolled_back", 0)
         frozen = rec.get("solves_frozen", 0)
         if rolled or frozen:

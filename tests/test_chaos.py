@@ -378,3 +378,189 @@ def test_restore_placed_falls_back_past_corrupt_newest(rng, tmp_path):
         assert telemetry.snapshot()["counters"]["checkpoint.corrupt"] == 1
     finally:
         telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# 4. elastic restore, mesh-GROW direction (recovered capacity)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_restore_grows_mesh_one_to_four(rng, tmp_path, multichip):
+    """Elasticity works in BOTH directions: a checkpoint written on a
+    single device (the degraded survivor shape) restores onto a 4-device
+    mesh — capacity recovered after an incident — genuinely re-sliced
+    4 ways and bit-identical."""
+    from photon_ml_tpu.game.checkpoint import StreamCheckpointState
+    from photon_ml_tpu.parallel import make_mesh
+
+    coeffs = rng.normal(size=(16, 3)).astype(np.float32)
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(directory=str(tmp_path), every=1)
+    )
+    mgr.save(StreamCheckpointState(next_chunk=2, coefficients=coeffs))
+    mesh4 = make_mesh({"entity": 4}, devices=jax.devices()[:4])
+    restored = mgr.restore_placed(mesh=mesh4)
+    assert restored is not None and restored.elastic
+    assert restored.next_chunk == 2
+    np.testing.assert_array_equal(np.asarray(restored.coefficients), coeffs)
+    shard_rows = {
+        (s.index[0].start or 0, s.index[0].stop)
+        for s in restored.coefficients.addressable_shards
+    }
+    assert len(shard_rows) == 4  # 1 shard on disk -> 4 on the mesh
+
+
+def test_elastic_grow_indivisible_names_the_valid_sizes(
+    rng, tmp_path, multichip
+):
+    """Growing onto a mesh the entity count cannot divide over raises the
+    typed error AND lists the legal target axis sizes — the operator
+    picking a survivor/recovery fleet size reads them off the message
+    instead of factorizing entity counts by hand."""
+    from photon_ml_tpu.game.checkpoint import StreamCheckpointState
+    from photon_ml_tpu.parallel import make_mesh
+    from photon_ml_tpu.parallel.sharding import ElasticPlacementError
+
+    coeffs = rng.normal(size=(6, 3)).astype(np.float32)  # 6 % 4 != 0
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(directory=str(tmp_path), every=1)
+    )
+    mgr.save(StreamCheckpointState(next_chunk=1, coefficients=coeffs))
+    mesh4 = make_mesh({"entity": 4}, devices=jax.devices()[:4])
+    with pytest.raises(ElasticPlacementError) as exc:
+        mgr.restore_placed(mesh=mesh4)
+    msg = str(exc.value)
+    assert "valid target axis sizes" in msg
+    assert "[1, 2, 3, 6]" in msg  # divisors of 6 within device reach
+    # the checkpoint stays restorable on any of the named sizes
+    mesh2 = make_mesh({"entity": 2}, devices=jax.devices()[:2])
+    restored = mgr.restore_placed(mesh=mesh2)
+    np.testing.assert_array_equal(np.asarray(restored.coefficients), coeffs)
+
+
+# ---------------------------------------------------------------------------
+# 5. the DISTRIBUTED crash matrix (2-process gloo fleets, tools/fleet.py)
+# ---------------------------------------------------------------------------
+
+
+def test_distributed_points_enumeration_is_stable():
+    """The fleet-seam set the distributed matrix (tools/chaos.py --fleet)
+    runs over is part of the contract: a new distributed seam must be
+    added HERE to land (and thereby to the matrix and lint L016)."""
+    import photon_ml_tpu.game.checkpoint  # noqa: F401
+    import photon_ml_tpu.parallel.distributed  # noqa: F401
+    import photon_ml_tpu.parallel.multihost  # noqa: F401
+
+    assert faults.distributed_points() == [
+        "checkpoint.peer_manifest",
+        "fleet.heartbeat",
+        "multihost.init",
+        "parallel.collective.entry",
+    ]
+
+
+@pytest.mark.chaos_distributed
+def test_distributed_matrix_tier1_row(tmp_path):
+    """Budget-capped tier-1 slice of the DISTRIBUTED matrix: one
+    2-process gloo fleet with one member hard-killed at the
+    checkpoint.peer_manifest seam (the quorum seam — a certified
+    coordinated checkpoint sits behind the kill, so this row proves
+    survivor resume FROM a coordinated checkpoint, the protocol's whole
+    point). The full 4-seam matrix runs under --slow /
+    `python -m tools.chaos --fleet`."""
+    from tools import chaos
+
+    budget = float(os.environ.get("PHOTON_CHAOS_BUDGET_S", "300"))
+    report = chaos.run_fleet_matrix(
+        str(tmp_path),
+        points=["checkpoint.peer_manifest"],
+        budget_s=budget,
+    )
+    if report["skipped"]:
+        warnings.warn(
+            "chaos budget truncated the distributed matrix; uncovered "
+            f"this run: {report['skipped']} (full matrix: python -m "
+            "tools.chaos --fleet)",
+            stacklevel=1,
+        )
+        return
+    assert report["ok"], json.dumps(report, indent=2, default=str)
+    entry = report["results"]["checkpoint.peer_manifest"]
+    assert entry["victim_rc"] == faults.DEFAULT_EXIT_CODE
+    assert entry["relaunches"] == 1  # resumed on the survivor
+    assert entry["loss_delta"] < 1e-6
+    assert entry["partial_certified"] == []  # zero partial checkpoints
+
+
+@pytest.mark.chaos_distributed
+@pytest.mark.slow
+def test_distributed_matrix_every_fleet_seam_recovers(tmp_path):
+    """The full distributed matrix: for EVERY registered distributed
+    seam, a 2-process fleet with one member hard-killed at the seam
+    resumes on the survivor, matches the uninterrupted fleet reference's
+    final loss to 1e-6, and never certifies a partial checkpoint."""
+    from tools import chaos
+
+    budget = float(os.environ.get("PHOTON_CHAOS_BUDGET_S", "600"))
+    report = chaos.run_fleet_matrix(str(tmp_path), budget_s=budget)
+    assert report["ok"], json.dumps(report, indent=2, default=str)
+    covered = [
+        p for p, e in report["results"].items() if e.get("passed")
+    ]
+    assert covered, "the budget covered no distributed point at all"
+    for entry in report["results"].values():
+        assert entry["victim_rc"] == faults.DEFAULT_EXIT_CODE
+        assert entry["partial_certified"] == []
+        assert entry["loss_delta"] < 1e-6
+    if report["skipped"]:
+        warnings.warn(
+            "chaos budget truncated the distributed matrix; uncovered "
+            f"this run: {report['skipped']}",
+            stacklevel=1,
+        )
+
+
+@pytest.mark.chaos_distributed
+def test_sigterm_to_one_member_boundary_stops_the_whole_fleet(tmp_path):
+    """GracefulStop across a fleet: SIGTERM delivered to ONE member of a
+    2-process gloo fit propagates through the fleet_any boundary
+    agreement — EVERY member stops at the SAME chunk boundary, writes
+    the coordinated final checkpoint, and exits 75. No member is left
+    spinning in a collective (nobody needed SIGKILL escalation), and the
+    final checkpoint is quorum-certified by both processes."""
+    from tools import fleet
+
+    report = fleet.run_fleet(fleet.FleetSpec(
+        workdir=str(tmp_path),
+        num_processes=2,
+        devices_per_process=2,
+        sigterm_after_s=1.5,
+        sigterm_process=0,
+        chunk_sleep_s=0.3,
+        quorum_timeout_s=5.0,
+        grace_s=20.0,
+        timeout_s=240.0,
+    ))
+    assert report["interrupted"] is True, json.dumps(report, default=str)
+    gen0 = report["generations"][0]
+    assert gen0["outcome"] == "interrupted"
+    # BOTH members exited through the graceful boundary stop — the
+    # unsignaled member agreed via the fleet_any collective
+    assert gen0["rcs"] == {0: fleet.GRACEFUL_EXIT_CODE,
+                           1: fleet.GRACEFUL_EXIT_CODE}
+    assert gen0["escalated"] == []  # clean boundary stop, no SIGKILL
+    assert not report["generations"][1:]  # interrupted, not relaunched
+    # the final coordinated checkpoint is certified with a 2-process
+    # quorum and fully readable
+    ckpt_dir = os.path.join(str(tmp_path), "ckpt")
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(directory=ckpt_dir, every=1)
+    )
+    assert fleet.verify_certified_checkpoints(
+        ckpt_dir, fleet.N_ENTITIES, fleet.DIM
+    ) == []
+    restored = mgr.restore()
+    assert restored is not None
+    manifest = json.loads(open(os.path.join(
+        mgr._chunk_dirs()[-1][1], "manifest.json")).read())
+    assert manifest["quorum"] == {"num_processes": 2}

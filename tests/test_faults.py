@@ -53,6 +53,11 @@ EXPECTED_POINTS = {
     "serving.dispatch",
     "serving.registry.poll",
     "serving.registry.load",
+    # distributed fleet seams (the distributed crash matrix set)
+    "multihost.init",
+    "fleet.heartbeat",
+    "checkpoint.peer_manifest",
+    "parallel.collective.entry",
 }
 
 WRITE_PATH_POINTS = [
@@ -60,6 +65,15 @@ WRITE_PATH_POINTS = [
     "checkpoint.save.before_manifest",
     "checkpoint.save.before_rename",
     "checkpoint.save.before_tmp",
+]
+
+#: the multi-process seams — tools/chaos.py --fleet enumerates exactly
+#: this set (sorted), one 2-process kill-one-member row per seam
+DISTRIBUTED_POINTS = [
+    "checkpoint.peer_manifest",
+    "fleet.heartbeat",
+    "multihost.init",
+    "parallel.collective.entry",
 ]
 
 
@@ -73,10 +87,13 @@ def test_registry_catalog_is_complete_and_stable():
     import photon_ml_tpu.ingest.pipeline  # noqa: F401
     import photon_ml_tpu.serving.batcher  # noqa: F401
     import photon_ml_tpu.serving.registry  # noqa: F401
+    import photon_ml_tpu.parallel.distributed  # noqa: F401
+    import photon_ml_tpu.parallel.multihost  # noqa: F401
 
     registered = faults.registered_points()
     assert set(registered) == EXPECTED_POINTS
     assert faults.write_path_points() == WRITE_PATH_POINTS
+    assert faults.distributed_points() == DISTRIBUTED_POINTS
     for name, info in registered.items():
         assert info.name == name
         assert info.description  # a seam nobody can describe is a smell
@@ -90,6 +107,8 @@ def test_reregistration_is_idempotent_but_write_path_conflicts_raise():
     ) == "checkpoint.manifest.read"
     with pytest.raises(ValueError, match="write_path"):
         faults.register_point("checkpoint.manifest.read", write_path=True)
+    with pytest.raises(ValueError, match="distributed"):
+        faults.register_point("checkpoint.manifest.read", distributed=True)
 
 
 # ---------------------------------------------------------------------------
@@ -406,3 +425,33 @@ def test_raise_injection_at_chunk_boundary_leaves_resumable_state(
     trainer.train(table2, chunks, checkpointer=mgr,
                   start_chunk=state.next_chunk)
     np.testing.assert_array_equal(table2.to_numpy(), expected)
+
+
+def test_bench_suite_gate_refuses_while_armed(tmp_path):
+    """An armed plan under a GATED bench run is refused outright (exit
+    2): numbers produced under injection are not comparable to any
+    baseline, and a silent pass would corrupt the CI perf contract.
+    (bench.py / bench_suite.py also warn loudly on any armed run, same
+    as cli train/serve.)"""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PHOTON_FAULT_PLAN"] = json.dumps(
+        {"rules": [{"point": "cd.step.boundary", "action": "raise"}]}
+    )
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text("{}")
+    proc = subprocess.run(
+        [sys.executable, "bench_suite.py", "--gate", str(baseline)],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "refusing --gate" in proc.stderr
+    assert "FAULT INJECTION ARMED" in proc.stderr

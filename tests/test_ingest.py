@@ -512,3 +512,46 @@ def test_out_of_core_cli_train_matches_incore_fit(tmp_path, rng):
     assert s_st["best_metric"] == pytest.approx(
         s_in["best_metric"], abs=1e-6
     )
+
+
+def test_plans_for_host_partitions_deterministically():
+    """The per-host split is a pure function of (plans, fleet size):
+    disjoint, covering, round-robin balanced — and a SURVIVOR fleet's
+    recomputed split absorbs the dead host's chunks with no coordination
+    state (the fleet supervisor's elastic-resume contract)."""
+    from photon_ml_tpu.ingest import ChunkPlan, plans_for_host
+
+    plans = [
+        ChunkPlan(index=i, path=f"f{i % 2}.avro", byte_start=0,
+                  byte_end=10, n_rows=5, row_start=5 * i, n_blocks=1)
+        for i in range(7)
+    ]
+    split = [plans_for_host(plans, pid, 3) for pid in range(3)]
+    # disjoint and covering, in global order
+    all_indices = sorted(p.index for host in split for p in host)
+    assert all_indices == list(range(7))
+    assert [p.index for p in split[0]] == [0, 3, 6]
+    assert [p.index for p in split[1]] == [1, 4]
+    assert [p.index for p in split[2]] == [2, 5]
+    # round-robin balance: host loads differ by at most one chunk
+    sizes = [len(h) for h in split]
+    assert max(sizes) - min(sizes) <= 1
+    # survivor elasticity: hosts 0 and 1 survive a 3->2 shrink; the NEW
+    # split covers everything, including the dead host's chunks
+    survivors = [plans_for_host(plans, pid, 2) for pid in range(2)]
+    assert sorted(
+        p.index for host in survivors for p in host
+    ) == list(range(7))
+    # single host owns the whole stream
+    assert plans_for_host(plans, 0, 1) == plans
+
+
+def test_plans_for_host_validates_ids():
+    from photon_ml_tpu.ingest import plans_for_host
+
+    with pytest.raises(ValueError, match="num_processes"):
+        plans_for_host([], 0, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        plans_for_host([], 2, 2)
+    with pytest.raises(ValueError, match="out of range"):
+        plans_for_host([], -1, 2)

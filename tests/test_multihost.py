@@ -126,3 +126,147 @@ def test_two_process_parity_dryrun():
     import __graft_entry__ as ge
 
     ge._dryrun_multiprocess(4)
+
+
+# ---------------------------------------------------------------------------
+# fleet robustness: init retry, heartbeat liveness (PR 11)
+# ---------------------------------------------------------------------------
+
+
+def test_initialize_retries_transient_failures_with_backoff(monkeypatch):
+    """A flaky rendezvous (gloo/grpc surfacing RuntimeError/OSError) is
+    retried with exponential backoff and counted; the attempt that
+    succeeds ends the loop."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.parallel import multihost
+
+    sleeps: list[float] = []
+    monkeypatch.setattr(multihost.time, "sleep", sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("connection refused")
+
+    telemetry.reset()
+    try:
+        cfg = multihost.DistributedConfig(
+            coordinator_address="10.0.0.9:8476", num_processes=2,
+            process_id=0, init_retries=3, init_backoff_s=0.25,
+        )
+        multihost._init_attempts(cfg, flaky)
+        assert calls["n"] == 3
+        assert sleeps == [0.25, 0.5]  # exponential
+        assert (
+            telemetry.snapshot()["counters"]["multihost.init_retries"] == 2
+        )
+    finally:
+        telemetry.reset()
+
+
+def test_initialize_exhaustion_raises_fleet_init_error(monkeypatch):
+    """Exhausted retries raise the typed FleetInitError NAMING the
+    coordinator address — the operator learns which rendezvous died."""
+    from photon_ml_tpu.parallel import multihost
+
+    monkeypatch.setattr(multihost.time, "sleep", lambda s: None)
+
+    def always_down():
+        raise ConnectionError("no route to host")
+
+    cfg = multihost.DistributedConfig(
+        coordinator_address="10.1.2.3:9999", num_processes=2,
+        process_id=1, init_retries=2,
+    )
+    with pytest.raises(multihost.FleetInitError, match="10.1.2.3:9999"):
+        multihost._init_attempts(cfg, always_down)
+    # attempts = 1 + init_retries, spelled out in the message
+    try:
+        multihost._init_attempts(cfg, always_down)
+    except multihost.FleetInitError as e:
+        assert "3 attempt(s)" in str(e)
+        assert e.coordinator == "10.1.2.3:9999"
+
+
+def test_initialize_injected_fault_seam_is_retryable(monkeypatch):
+    """An armed `multihost.init` raise rule is absorbed by the bounded
+    retry (InjectedFault is a RuntimeError) — the flaky-rendezvous shape
+    the distributed matrix's exit rule escalates to a true kill."""
+    from photon_ml_tpu import faults, telemetry
+    from photon_ml_tpu.parallel import multihost
+
+    monkeypatch.setattr(multihost.time, "sleep", lambda s: None)
+    faults.install_plan(faults.FaultPlan(
+        [faults.FaultRule("multihost.init", action="raise", nth=1)]
+    ))
+    telemetry.reset()
+    try:
+        cfg = multihost.DistributedConfig(
+            coordinator_address="h:1", num_processes=2, process_id=0,
+            init_retries=1,
+        )
+        done = {"n": 0}
+        multihost._init_attempts(cfg, lambda: done.update(n=done["n"] + 1))
+        assert done["n"] == 1  # first attempt died AT the seam, second ran
+        assert (
+            telemetry.snapshot()["counters"]["multihost.init_retries"] == 1
+        )
+    finally:
+        faults.clear_plan()
+        telemetry.reset()
+
+
+def test_init_retries_config_from_env(monkeypatch):
+    from photon_ml_tpu.parallel import multihost
+
+    monkeypatch.setenv("PHOTON_ML_INIT_RETRIES", "7")
+    assert multihost.DistributedConfig.from_env().init_retries == 7
+    monkeypatch.delenv("PHOTON_ML_INIT_RETRIES")
+    assert multihost.DistributedConfig.from_env().init_retries == 3
+
+
+def test_heartbeat_writer_touches_and_dead_peers_detects_staleness(
+    tmp_path,
+):
+    """The liveness protocol end-to-end on one filesystem: a started
+    writer's file exists and refreshes; dead_peers flags only members
+    whose file went STALE — never missing files (a member that has not
+    joined yet is the exit-code watcher's job, not liveness')."""
+    import os as _os
+    import time as _time
+
+    from photon_ml_tpu.parallel import multihost
+
+    d = str(tmp_path)
+    w = multihost.HeartbeatWriter(d, 0, interval_s=0.05).start()
+    try:
+        path = multihost.heartbeat_path(d, 0)
+        assert _os.path.exists(path)
+        m0 = _os.path.getmtime(path)
+        deadline = _time.monotonic() + 5.0
+        while _os.path.getmtime(path) <= m0:
+            assert _time.monotonic() < deadline, "heartbeat never refreshed"
+            _time.sleep(0.02)
+    finally:
+        w.stop()
+    # staleness, evaluated with an injected clock (no sleeping): proc 0
+    # beat "30s ago", proc 1 is fresh, proc 2 never joined
+    now = _time.time()
+    _os.utime(path, (now - 30.0, now - 30.0))
+    fresh = multihost.HeartbeatWriter(d, 1, interval_s=1.0)
+    _os.makedirs(d, exist_ok=True)
+    fresh.beat()
+    assert multihost.dead_peers(d, 3, deadline_s=5.0, now=now) == [0]
+    assert multihost.dead_peers(d, 3, deadline_s=60.0, now=now) == []
+    with pytest.raises(ValueError, match="interval_s"):
+        multihost.HeartbeatWriter(d, 0, interval_s=0.0)
+
+
+def test_fleet_any_single_process_is_the_local_flag():
+    from photon_ml_tpu.parallel import multihost
+
+    mesh = global_mesh({"entity": 8})
+    assert multihost.fleet_any(True, mesh) is True
+    assert multihost.fleet_any(False, mesh) is False
+    assert multihost.fleet_any(True, None) is True

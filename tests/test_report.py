@@ -808,6 +808,37 @@ def test_report_recovery_section_round_trip():
     assert live.to_json()["recovery"]["checkpoint_restores"] == 1
 
 
+def test_report_recovery_fleet_rows_round_trip():
+    """Fleet-recovery accounting (supervised multi-process fits): member
+    deaths + survivor relaunches, coordinated-checkpoint quorum
+    outcomes, and absorbed distributed-init retries each get their own
+    Recovery row — and any one of them alone is enough to materialize
+    the section."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    telemetry.metrics.counter("recovery.fleet_member_deaths").inc(1)
+    telemetry.metrics.counter("recovery.fleet_relaunches").inc(1)
+    telemetry.metrics.counter("checkpoint.peer_manifests").inc(6)
+    telemetry.metrics.counter("checkpoint.quorum_timeouts").inc(2)
+    telemetry.metrics.counter("multihost.init_retries").inc(3)
+    live = RunReport.from_live()
+    rec = live.recovery_summary()
+    assert rec["recovery_fleet_member_deaths"] == 1
+    assert rec["recovery_fleet_relaunches"] == 1
+    assert rec["checkpoint_peer_manifests"] == 6
+    assert rec["checkpoint_quorum_timeouts"] == 2
+    assert rec["multihost_init_retries"] == 3
+    md = live.to_markdown()
+    assert "## Recovery" in md
+    assert "fleet: 1 member death(s), 1 survivor relaunch(es)" in md
+    assert "6 per-process manifest(s) written, 2 quorum timeout(s)" in md
+    assert "3 distributed-init retry(ies) absorbed" in md
+    assert (
+        live.to_json()["recovery"]["recovery_fleet_relaunches"] == 1
+    )
+
+
 def test_report_without_recovery_activity_has_no_section():
     from photon_ml_tpu.telemetry.report import RunReport
 

@@ -16,6 +16,13 @@ exists to prevent. This pass closes the loop statically:
   an env-transported JSON plan, or the crash-matrix enumeration test's
   explicit expected-points list all count. Substring matching over
   literals keeps JSON blobs covered without executing anything.
+- **classification** must be statically enumerable too: the
+  ``write_path=``/``distributed=`` kwargs select which matrix
+  (single-process write-path vs distributed fleet rows) proves a seam
+  recoverable, so a non-literal value there is flagged the same as a
+  non-literal id — the distributed enumeration test
+  (``faults.distributed_points()`` in tests/test_chaos.py) and this
+  pass both key on it.
 
 Scope: like the other interprocedural passes this runs over the real
 tree only — reduced test trees (``require_seeds=False`` in the driver)
@@ -56,6 +63,24 @@ def _registration_sites(
             if name != "register_point" or not node.args:
                 continue
             first = node.args[0]
+            for kw in node.keywords:
+                if kw.arg in ("write_path", "distributed") and not (
+                    isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, bool)
+                ):
+                    findings.append(
+                        Finding(
+                            path=sf.rel,
+                            line=node.lineno,
+                            code="L016",
+                            message=(
+                                f"register_point() with a non-literal "
+                                f"{kw.arg}= — matrix membership "
+                                "(write_path_points/distributed_points) "
+                                "must be statically enumerable"
+                            ),
+                        )
+                    )
             if isinstance(first, ast.Constant) and isinstance(
                 first.value, str
             ):

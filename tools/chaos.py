@@ -23,10 +23,22 @@ enumerated, CI-enforced property: tests/test_chaos.py runs a
 budget-bounded slice of this matrix in tier-1, and static-analysis rule
 L016 (tools/analysis/faultcov.py) refuses fault points no test names.
 
+The DISTRIBUTED matrix (``--fleet``) extends the proof to partial fleet
+failure: for every registered *distributed* fault point
+(``faults.distributed_points()`` — fleet init, the heartbeat touch, the
+per-process quorum manifest, collective entry), a 2-process gloo fleet
+is launched under the ``tools/fleet.py`` supervisor with ONE member
+armed to hard-kill at that seam (rc=113 asserted), the survivors are
+boundary-stopped and the fit relaunched on the surviving host set via
+``restore_placed()``; the resumed fit's final LOSS must match the
+uninterrupted fleet reference to 1e-6, and an audit of the checkpoint
+directory must find zero partially-certified checkpoints.
+
 CLI::
 
     python -m tools.chaos --workdir /tmp/chaos            # full matrix
     python -m tools.chaos --workdir /tmp/chaos --json out.json
+    python -m tools.chaos --workdir /tmp/chaos --fleet    # distributed rows
     python -m tools.chaos --worker --dir D                # one fit (internal)
 
 The worker fit is self-contained and seed-deterministic (same chunk data
@@ -191,6 +203,181 @@ def run_matrix(
 
 
 # ---------------------------------------------------------------------------
+# the DISTRIBUTED crash matrix (fleet rows, via tools/fleet.py)
+# ---------------------------------------------------------------------------
+
+#: which hit of each fleet seam the victim dies on. Chosen so every row
+#: that CAN have a certified checkpoint behind it does — the interesting
+#: property is resuming from a certified coordinated checkpoint on the
+#: survivors, not restarting from scratch:
+#:   multihost.init          1st hit — dead before ever joining (the
+#:                           relaunch-from-nothing row)
+#:   fleet.heartbeat         6th touch — mid-fit between collectives
+#:   checkpoint.peer_manifest 2nd save — one coordinated checkpoint is
+#:                           already certified; the second is abandoned
+#:                           by quorum timeout, never certified partial
+#:   parallel.collective.entry 2nd chunk solve — the survivor wedges in
+#:                           the collective and needs SIGKILL reclaim
+FLEET_NTH = {
+    "multihost.init": 1,
+    "fleet.heartbeat": 6,
+    "checkpoint.peer_manifest": 2,
+    "parallel.collective.entry": 2,
+}
+
+
+def fleet_final_loss(table) -> float:
+    """Total per-entity L2-regularized objective of a fleet worker's
+    final table — the scalar the 1e-6 survivor-resume acceptance is
+    stated over (cross-mesh fp noise keeps raw coefficients only to
+    ~1e-3; at the optimum the loss delta is second-order)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.ops.dense import DenseBatch
+    from photon_ml_tpu.ops.objective import make_objective
+    from photon_ml_tpu.optim import glm_adapter
+    from tools import fleet
+
+    X, y = fleet.make_problem()
+    obj = make_objective("logistic", l2_weight=0.3)
+    total = 0.0
+    for e in range(X.shape[0]):
+        adapter = glm_adapter(obj, DenseBatch.from_arrays(X[e], y[e]))
+        total += float(adapter.value_and_grad(jnp.asarray(table[e]))[0])
+    return total
+
+
+def run_fleet_matrix(
+    workdir: str,
+    points: Optional[Sequence[str]] = None,
+    budget_s: Optional[float] = None,
+) -> dict:
+    """The distributed crash matrix: for every fleet fault seam, a
+    2-process gloo fleet with one member hard-killed at the seam must
+    (1) observe the member die WITH the injection exit code, (2) resume
+    on the survivor and complete, (3) match the uninterrupted fleet
+    reference's final loss to 1e-6, and (4) never certify a partial
+    checkpoint (audited over the row's whole checkpoint directory).
+
+    Budget-aware like :func:`run_matrix`: points beyond ``budget_s`` are
+    reported ``skipped``, never silently dropped.
+    """
+    import numpy as np
+
+    from photon_ml_tpu import faults
+
+    # distributed seams register at import of their owning modules
+    import photon_ml_tpu.game.checkpoint  # noqa: F401
+    import photon_ml_tpu.parallel.distributed  # noqa: F401
+    import photon_ml_tpu.parallel.multihost  # noqa: F401
+    from tools import fleet
+
+    all_points = faults.distributed_points()
+    points = list(points) if points is not None else all_points
+    unknown = sorted(set(points) - set(all_points))
+    if unknown:
+        raise ValueError(
+            f"not registered distributed fault points: {unknown} "
+            f"(known: {all_points})"
+        )
+    t0 = time.monotonic()
+    report: dict = {
+        "workdir": workdir,
+        "points": points,
+        "results": {},
+        "skipped": [],
+        "ok": True,
+    }
+
+    def make_spec(
+        subdir: str, plan: Optional[dict], detect_by: str = "exit_code"
+    ) -> fleet.FleetSpec:
+        return fleet.FleetSpec(
+            workdir=os.path.join(workdir, subdir),
+            num_processes=2,
+            devices_per_process=2,
+            victim_plan=plan,
+            victim_process=1,
+            quorum_timeout_s=3.0,
+            grace_s=8.0,
+            heartbeat_deadline_s=5.0,
+            timeout_s=240.0,
+            detect_by=detect_by,
+        )
+
+    # uninterrupted 2-process fleet reference (also warms the compile
+    # cache every armed/relaunched worker reuses)
+    ref = fleet.run_fleet(make_spec("reference_fleet", None))
+    if not ref.get("ok"):
+        raise RuntimeError(
+            f"uninterrupted reference fleet failed: "
+            f"{json.dumps(ref, default=str)[:2000]}"
+        )
+    ref_loss = fleet_final_loss(np.load(ref["final_path"]))
+    report["reference_loss"] = ref_loss
+
+    for point in points:
+        if budget_s is not None and time.monotonic() - t0 > budget_s:
+            report["skipped"] = [
+                p for p in points if p not in report["results"]
+            ]
+            break
+        entry: dict = {"point": point}
+        subdir = point.replace(".", "_")
+        plan = exit_plan(point, nth=FLEET_NTH.get(point, 1))
+        # the heartbeat row runs detect_by="heartbeat": the lost-host
+        # verdict must come from proc-<i>.alive STALENESS, not the exit
+        # code — this is what makes the liveness protocol itself
+        # crash-proven rather than just present
+        run = fleet.run_fleet(make_spec(
+            subdir, plan,
+            detect_by="heartbeat" if point == "fleet.heartbeat"
+            else "exit_code",
+        ))
+        gen0 = run["generations"][0]
+        entry["generations"] = len(run["generations"])
+        entry["relaunches"] = run.get("relaunches")
+        entry["victim_rc"] = gen0["rcs"].get(1)
+        entry["deaths"] = run.get("deaths_total")
+        problems = []
+        if gen0["rcs"].get(1) != faults.DEFAULT_EXIT_CODE:
+            problems.append(
+                f"victim exited {gen0['rcs'].get(1)}, expected "
+                f"{faults.DEFAULT_EXIT_CODE} (did the seam fire?)"
+            )
+        if not run.get("ok"):
+            problems.append(
+                "fleet did not complete after the member death: "
+                + json.dumps(run["generations"], default=str)[:1500]
+            )
+        else:
+            got_loss = fleet_final_loss(np.load(run["final_path"]))
+            entry["final_loss"] = got_loss
+            entry["loss_delta"] = abs(got_loss - ref_loss)
+            if entry["loss_delta"] >= 1e-6:
+                problems.append(
+                    "survivor-resumed final loss off the uninterrupted "
+                    f"fleet reference by {entry['loss_delta']:g} (>= 1e-6)"
+                )
+        partial = fleet.verify_certified_checkpoints(
+            os.path.join(workdir, subdir, "ckpt"),
+            fleet.N_ENTITIES, fleet.DIM,
+        )
+        entry["partial_certified"] = partial
+        if partial:
+            problems.append(
+                f"partially-certified checkpoint(s) observed: {partial}"
+            )
+        if problems:
+            entry["error"] = "; ".join(problems)
+            report["ok"] = False
+        entry["passed"] = not problems
+        report["results"][point] = entry
+    report["elapsed_s"] = round(time.monotonic() - t0, 3)
+    return report
+
+
+# ---------------------------------------------------------------------------
 # the worker fit (runs in the subprocess)
 # ---------------------------------------------------------------------------
 
@@ -272,6 +459,10 @@ def main(argv=None) -> int:
                         help="run ONE worker fit (internal)")
     parser.add_argument("--dir", help="worker fit directory (--worker)")
     parser.add_argument("--workdir", help="matrix working directory")
+    parser.add_argument("--fleet", action="store_true",
+                        help="run the DISTRIBUTED matrix (2-process gloo "
+                        "fleets, one member hard-killed per seam) instead "
+                        "of the single-process write-path matrix")
     parser.add_argument("--points", nargs="*",
                         help="subset of write-path points (default: all)")
     parser.add_argument("--nth", type=int, default=1,
@@ -288,17 +479,29 @@ def main(argv=None) -> int:
         return _worker_main(args.dir)
     if not args.workdir:
         parser.error("--workdir is required (or --worker --dir)")
-    report = run_matrix(
-        args.workdir, points=args.points, budget_s=args.budget_s,
-        nth=args.nth,
-    )
+    if args.fleet:
+        report = run_fleet_matrix(
+            args.workdir, points=args.points, budget_s=args.budget_s,
+        )
+    else:
+        report = run_matrix(
+            args.workdir, points=args.points, budget_s=args.budget_s,
+            nth=args.nth,
+        )
     if args.json_out:
         with open(args.json_out, "w", encoding="utf-8") as fh:
             json.dump(report, fh, indent=2, sort_keys=True)
     for point, entry in report["results"].items():
-        status = "ok" if entry.get("exact") else "FAIL"
-        print(f"{status:4s} {point}  (armed rc={entry.get('armed_rc')}, "
-              f"resumed from chunk {entry.get('resumed_from_chunk')})")
+        if args.fleet:
+            status = "ok" if entry.get("passed") else "FAIL"
+            print(f"{status:4s} {point}  (victim rc="
+                  f"{entry.get('victim_rc')}, relaunches="
+                  f"{entry.get('relaunches')}, loss delta="
+                  f"{entry.get('loss_delta')})")
+        else:
+            status = "ok" if entry.get("exact") else "FAIL"
+            print(f"{status:4s} {point}  (armed rc={entry.get('armed_rc')}, "
+                  f"resumed from chunk {entry.get('resumed_from_chunk')})")
     for point in report["skipped"]:
         print(f"skip {point}  (budget exhausted)")
     print(f"{'OK' if report['ok'] else 'FAILED'} in "

@@ -1,0 +1,674 @@
+"""Fleet supervisor: launch, watch, and survivor-elastic-relaunch a
+multi-process fit.
+
+The reference inherited this from YARN — a lost executor was re-requested
+and Spark's lineage replayed its partitions. The TPU port's fleets are N
+long-lived jax.distributed processes whose collectives WEDGE when a
+member dies mid-program, so supervision is explicit:
+
+1. **launch** — N worker processes join a gloo/grpc rendezvous
+   (``parallel.multihost.initialize`` with bounded retry) and run the
+   streamed entity-sharded fit with COORDINATED checkpoints
+   (``game.checkpoint`` quorum manifests) at every chunk boundary;
+2. **watch** — exit codes plus the heartbeat-file liveness protocol
+   (``proc-<i>.alive`` touched on a cadence; staleness beyond a deadline
+   = dead). A member exiting with the injection code 113 (or losing its
+   heartbeat) marks its host LOST;
+3. **stop the survivors** — SIGTERM requests the boundary stop
+   (``GracefulStop`` + the ``fleet_any`` collective agreement make every
+   member stop at the SAME boundary); members wedged in a collective
+   against a dead partner cannot reach the boundary, so after a grace
+   period the supervisor escalates to SIGKILL — their progress since the
+   last certified checkpoint is lost, and that is fine, because chunks
+   replay deterministically;
+4. **relaunch on the survivors** — a new, smaller fleet restores the
+   newest CERTIFIED checkpoint via ``restore_placed()`` (the entity axis
+   re-sliced onto the shrunken mesh) and recomputes its per-host splits
+   deterministically (``ingest.planner.plans_for_host`` /
+   ``multihost.process_slice``) — the dead host's work lands on
+   survivors with no coordination state.
+
+An external SIGTERM to ONE member (preemption) propagates through the
+same boundary agreement: every member writes the coordinated final
+checkpoint and exits 75 — interrupted, not relaunched.
+
+CLI::
+
+    python -m tools.fleet --workdir /tmp/fleet                # supervise
+    python -m tools.fleet --worker --proc 0 --nproc 2 ...     # (internal)
+
+tools/chaos.py drives this harness for the DISTRIBUTED crash matrix:
+one member hard-killed at each fleet fault seam, the survivor-resumed
+fit's final loss checked against the uninterrupted fleet reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional
+
+#: worker fit shape — shared with tools.chaos so the fleet reference and
+#: the single-process matrix solve the same problem
+N_ENTITIES = 16
+N_ROWS = 8
+DIM = 4
+N_CHUNKS = 4
+DATA_SEED = 20260803
+
+#: exit code of a graceful boundary stop (cli train's "interrupted,
+#: restart me" convention)
+GRACEFUL_EXIT_CODE = 75
+
+#: a worker that NOTICED the fleet break (a collective failed against a
+#: dead peer) exits with this code via ``os._exit`` — unwinding normally
+#: would wedge in jax's atexit distributed-shutdown barrier against the
+#: very peer that died. The supervisor reads it as "host fine, fleet
+#: broken": the member relaunches in the next generation.
+FLEET_ABORT_EXIT_CODE = 76
+
+
+def make_problem():
+    """The deterministic worker problem ``(X, y)``: every fleet member —
+    and the chaos matrix's reference scorer — generates the SAME data
+    from DATA_SEED, so there is exactly one definition to drift."""
+    import numpy as np
+
+    rng = np.random.default_rng(DATA_SEED)
+    X = rng.normal(size=(N_ENTITIES, N_ROWS, DIM))
+    W = rng.normal(size=(N_ENTITIES, DIM))
+    z = np.einsum("erk,ek->er", X, W)
+    y = (rng.random((N_ENTITIES, N_ROWS)) < 1 / (1 + np.exp(-z))).astype(
+        np.float32
+    )
+    return X.astype(np.float32), y
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class FleetSpec:
+    """One supervised fleet run (including any survivor relaunches)."""
+
+    workdir: str
+    num_processes: int = 2
+    devices_per_process: int = 2
+    heartbeat_every_s: float = 0.25
+    #: staleness beyond which a member with no exit code counts dead
+    heartbeat_deadline_s: float = 5.0
+    #: how long survivors get to reach their boundary stop after SIGTERM
+    #: before the supervisor escalates to SIGKILL
+    grace_s: float = 12.0
+    #: coordinated-checkpoint quorum wait inside the workers (kept well
+    #: under grace_s so an abandoned save resolves before escalation)
+    quorum_timeout_s: float = 4.0
+    max_relaunches: int = 2
+    timeout_s: float = 600.0
+    #: fault plan armed onto EXACTLY ONE member (the victim) of the
+    #: first generation — the chaos harness's kill switch
+    victim_plan: Optional[dict] = None
+    victim_process: int = 1
+    #: deliver SIGTERM to this member this many seconds after its FIRST
+    #: heartbeat (external preemption of one host; None = never).
+    #: Anchoring on the heartbeat — not launch — keeps the signal inside
+    #: the fit whatever jax import/compile latency the box has
+    sigterm_after_s: Optional[float] = None
+    sigterm_process: int = 0
+    #: test-only: stretch each chunk boundary so mid-fit signals land
+    chunk_sleep_s: float = 0.0
+    #: how a lost host is recognized: "exit_code" marks a member lost the
+    #: moment it exits with the injection code 113; "heartbeat" ignores
+    #: that fast path and waits for the member's ``proc-<i>.alive`` file
+    #: to go stale — the pure liveness-protocol detection (the matrix's
+    #: ``fleet.heartbeat`` row runs this mode so staleness detection is
+    #: itself crash-proven)
+    detect_by: str = "exit_code"
+
+
+def _worker_env(
+    spec: FleetSpec, proc: int, armed: bool
+) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(
+        f"--xla_force_host_platform_device_count={spec.devices_per_process}"
+    )
+    env["XLA_FLAGS"] = " ".join(flags)
+    env.pop("PHOTON_FAULT_PLAN", None)
+    if armed and spec.victim_plan is not None:
+        env["PHOTON_FAULT_PLAN"] = json.dumps(spec.victim_plan)
+    return env
+
+
+@dataclasses.dataclass
+class _Member:
+    proc: subprocess.Popen
+    process_id: int
+    out_path: str
+    err_path: str
+    rc: Optional[int] = None
+    lost_host: bool = False  # exited 113 / heartbeat-stale-killed
+
+
+def _launch_generation(
+    spec: FleetSpec, generation: int, nproc: int, arm_victim: bool
+) -> list[_Member]:
+    fleet_dir = os.path.join(spec.workdir, "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    # stale liveness files from the previous generation must not mask a
+    # new member's death (mtime staleness is the signal)
+    for name in os.listdir(fleet_dir):
+        if name.endswith(".alive"):
+            try:
+                os.unlink(os.path.join(fleet_dir, name))
+            except OSError:
+                pass
+    port = _free_port() if nproc > 1 else 0
+    members = []
+    for pid in range(nproc):
+        out_path = os.path.join(
+            spec.workdir, f"gen{generation}-proc{pid}.out"
+        )
+        err_path = os.path.join(
+            spec.workdir, f"gen{generation}-proc{pid}.err"
+        )
+        armed = arm_victim and pid == spec.victim_process
+        argv = [
+            sys.executable, "-m", "tools.fleet", "--worker",
+            "--proc", str(pid), "--nproc", str(nproc),
+            "--port", str(port), "--dir", spec.workdir,
+            "--quorum-timeout", str(spec.quorum_timeout_s),
+            "--heartbeat-every", str(spec.heartbeat_every_s),
+            "--chunk-sleep", str(spec.chunk_sleep_s),
+        ]
+        with open(out_path, "wb") as out, open(err_path, "wb") as err:
+            proc = subprocess.Popen(
+                argv,
+                env=_worker_env(spec, pid, armed),
+                cwd=_repo_root(),
+                stdout=out,
+                stderr=err,
+            )
+        members.append(_Member(proc, pid, out_path, err_path))
+    return members
+
+
+def _signal_all(members: list[_Member], sig) -> None:
+    for m in members:
+        if m.proc.poll() is None:
+            try:
+                m.proc.send_signal(sig)
+            except OSError:
+                pass
+
+
+def _supervise_generation(
+    spec: FleetSpec, generation: int, nproc: int, deadline: float
+) -> dict:
+    """Run one fleet generation to completion; the per-generation record
+    (exit codes, detected deaths, whether escalation was needed)."""
+    from photon_ml_tpu.parallel import multihost
+
+    fleet_dir = os.path.join(spec.workdir, "fleet")
+    members = _launch_generation(
+        spec, generation, nproc, arm_victim=generation == 0
+    )
+    started = time.monotonic()
+    sigterm_sent = False
+    sigterm_anchor: Optional[float] = None
+    stopping = False
+    stop_started = 0.0
+    escalated: list[int] = []
+    try:
+        while True:
+            now = time.monotonic()
+            if now > deadline:
+                _signal_all(members, signal.SIGKILL)
+                for m in members:
+                    m.proc.wait()
+                    m.rc = m.proc.returncode
+                return {
+                    "generation": generation,
+                    "num_processes": nproc,
+                    "rcs": {m.process_id: m.rc for m in members},
+                    "outcome": "timeout",
+                    "escalated": escalated,
+                }
+            # external-preemption injection: SIGTERM one member mid-fit,
+            # anchored on its first heartbeat so the signal lands inside
+            # the fit regardless of jax import/compile latency
+            if spec.sigterm_after_s is not None and not sigterm_sent:
+                if sigterm_anchor is None and os.path.exists(
+                    multihost.heartbeat_path(
+                        fleet_dir, spec.sigterm_process
+                    )
+                ):
+                    sigterm_anchor = now
+                if (
+                    sigterm_anchor is not None
+                    and now - sigterm_anchor >= spec.sigterm_after_s
+                ):
+                    for m in members:
+                        if (
+                            m.process_id == spec.sigterm_process
+                            and m.proc.poll() is None
+                        ):
+                            m.proc.send_signal(signal.SIGTERM)
+                    sigterm_sent = True
+            # collect exits. Exit-code classification: 113 (the injected
+            # preemption/OOM-kill code) = host LOST; 76 = this member
+            # noticed the fleet break and bailed (host retained); other
+            # unexpected codes are crashes on a retained host.
+            for m in members:
+                if m.rc is None and m.proc.poll() is not None:
+                    m.rc = m.proc.returncode
+                    if m.rc == 113 and spec.detect_by == "exit_code":
+                        m.lost_host = True
+            # heartbeat staleness: the liveness-protocol detection. A
+            # stale member that never delivered an exit code is a dead
+            # or wedged HOST — reclaim (SIGKILL) and mark it lost.
+            if now - started > spec.heartbeat_deadline_s:
+                for pid in multihost.dead_peers(
+                    fleet_dir, nproc, spec.heartbeat_deadline_s
+                ):
+                    m = members[pid]
+                    if m.lost_host:
+                        continue
+                    if m.rc is None and m.proc.poll() is None:
+                        m.proc.send_signal(signal.SIGKILL)
+                        m.proc.wait()
+                        m.rc = m.proc.returncode
+                        m.lost_host = True
+                        escalated.append(pid)
+                    elif spec.detect_by == "heartbeat" and m.rc == 113:
+                        # heartbeat-mode: the lost-host verdict waited
+                        # for the file to go stale, not the exit code
+                        m.lost_host = True
+            lost = [m for m in members if m.lost_host]
+            broken = [
+                m for m in members
+                if m.rc is not None
+                and m.rc not in (0, GRACEFUL_EXIT_CODE)
+                and m.process_id not in escalated
+            ]
+            alive = [m for m in members if m.rc is None]
+            if (lost or broken) and not stopping:
+                # member death (or a broken-fleet bail): stop the
+                # survivors at their next boundary. Death COUNTING
+                # happens in run_fleet over the generation's final
+                # verdict — a broken-only stop is not a member death.
+                stopping = True
+                stop_started = now
+                _signal_all(members, signal.SIGTERM)
+            if (
+                stopping
+                and alive
+                and now - stop_started > spec.grace_s
+                and not any(m.process_id in escalated for m in alive)
+            ):
+                # survivors wedged in a collective against the dead
+                # member can never reach the boundary — reclaim them;
+                # the certified-checkpoint replay makes this lossless
+                for m in alive:
+                    escalated.append(m.process_id)
+                _signal_all(members, signal.SIGKILL)
+            if not alive:
+                break
+            time.sleep(0.05)
+    finally:
+        for m in members:
+            if m.proc.poll() is None:
+                m.proc.kill()
+            m.proc.wait()
+            if m.rc is None:
+                m.rc = m.proc.returncode
+    if spec.detect_by == "heartbeat":
+        # pure liveness-protocol mode: the lost-host verdict comes ONLY
+        # from proc-<i>.alive staleness. A fast fleet can finish (every
+        # member exited) before the victim's file ever goes stale, so
+        # resolve pending verdicts here — the victim is dead, its file
+        # WILL stale out within one deadline
+        pending = [m for m in members if m.rc == 113 and not m.lost_host]
+        resolve_by = time.monotonic() + spec.heartbeat_deadline_s * 2
+        while pending and time.monotonic() < resolve_by:
+            stale = multihost.dead_peers(
+                fleet_dir, nproc, spec.heartbeat_deadline_s
+            )
+            for m in pending:
+                if m.process_id in stale:
+                    m.lost_host = True
+            pending = [m for m in pending if not m.lost_host]
+            if pending:
+                time.sleep(0.1)
+    rcs = {m.process_id: m.rc for m in members}
+    deaths = [m.process_id for m in members if m.lost_host]
+    if deaths:
+        outcome = "member_death"
+    elif all(r == 0 for r in rcs.values()):
+        outcome = "complete"
+    elif all(r in (0, GRACEFUL_EXIT_CODE) for r in rcs.values()):
+        outcome = "interrupted"
+    else:
+        outcome = "failed"
+    return {
+        "generation": generation,
+        "num_processes": nproc,
+        "rcs": rcs,
+        "deaths": deaths,
+        "outcome": outcome,
+        "escalated": escalated,
+    }
+
+
+def run_fleet(spec: FleetSpec) -> dict:
+    """Supervise a fit to completion across member loss: launch, watch,
+    boundary-stop, relaunch on survivors. JSON-safe report; ``ok`` means
+    the fit COMPLETED (survivor resume counts; a graceful external
+    interruption reports ``interrupted`` instead)."""
+    from photon_ml_tpu import telemetry
+
+    os.makedirs(spec.workdir, exist_ok=True)
+    deadline = time.monotonic() + spec.timeout_s
+    nproc = spec.num_processes
+    generations = []
+    relaunches = 0
+    report: dict = {"workdir": spec.workdir, "generations": generations}
+    while True:
+        gen = _supervise_generation(spec, len(generations), nproc, deadline)
+        generations.append(gen)
+        if gen.get("deaths"):
+            telemetry.counter("recovery.fleet_member_deaths").inc(
+                len(gen["deaths"])
+            )
+        if gen["outcome"] == "complete":
+            report.update(ok=True, interrupted=False)
+            break
+        if gen["outcome"] == "interrupted":
+            report.update(ok=False, interrupted=True)
+            break
+        if gen["outcome"] in ("timeout", "failed") and not gen.get("deaths"):
+            report.update(ok=False, interrupted=False)
+            break
+        survivors = nproc - len(gen["deaths"])
+        if survivors < 1 or relaunches >= spec.max_relaunches:
+            report.update(ok=False, interrupted=False)
+            break
+        relaunches += 1
+        telemetry.counter("recovery.fleet_relaunches").inc()
+        nproc = survivors
+    report["relaunches"] = relaunches
+    report["deaths_total"] = sum(
+        len(g.get("deaths") or ()) for g in generations
+    )
+    report["final_path"] = os.path.join(spec.workdir, "final.npy")
+    return report
+
+
+def verify_certified_checkpoints(
+    checkpoint_dir: str, num_entities: int, dim: int
+) -> list[str]:
+    """Audit every CERTIFIED checkpoint under ``checkpoint_dir``: each
+    ``chunk-*`` directory must carry a quorum/complete manifest whose
+    shards contiguously cover [0, num_entities) with readable payloads.
+    Returns a list of violation strings (empty = no partial checkpoint
+    was ever certified — the distributed matrix's third assertion)."""
+    from photon_ml_tpu.game.checkpoint import (
+        CheckpointError,
+        CheckpointSpec,
+        StreamingCheckpointManager,
+    )
+
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(directory=checkpoint_dir, every=1)
+    )
+    problems = []
+    for _c, path in mgr._chunk_dirs():
+        try:
+            manifest = mgr._read_manifest(path)
+            if int(manifest["num_entities"]) != num_entities:
+                raise CheckpointError(
+                    f"{path}: wrong entity count "
+                    f"{manifest['num_entities']}"
+                )
+            if int(manifest["dim"]) != dim:
+                raise CheckpointError(f"{path}: wrong dim {manifest['dim']}")
+            reader = mgr._row_reader(path, manifest, "coefficients")
+            reader(0, num_entities)  # every payload byte readable
+        except (CheckpointError, ValueError, OSError, KeyError) as e:
+            problems.append(f"{path}: certified but partial/corrupt: {e}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# the worker fit (one fleet member)
+# ---------------------------------------------------------------------------
+
+
+def _worker_main(args) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from photon_ml_tpu import faults
+    from photon_ml_tpu.parallel import multihost
+
+    faults.warn_if_armed()
+    if args.nproc > 1:
+        multihost.initialize(
+            multihost.DistributedConfig(
+                coordinator_address=f"127.0.0.1:{args.port}",
+                num_processes=args.nproc,
+                process_id=args.proc,
+                init_retries=2,
+                init_backoff_s=0.2,
+            )
+        )
+        assert jax.process_count() == args.nproc
+    heartbeat = multihost.HeartbeatWriter(
+        os.path.join(args.dir, "fleet"),
+        args.proc,
+        interval_s=args.heartbeat_every,
+    ).start()
+    try:
+        return _worker_fit(args, np)
+    finally:
+        heartbeat.stop()
+
+
+def _worker_fit(args, np) -> int:
+    import jax
+    import jax.numpy as jnp  # noqa: F401 — jax must be live before mesh use
+
+    from photon_ml_tpu.game.checkpoint import (
+        CheckpointSpec,
+        GracefulStop,
+        StreamingCheckpointManager,
+        TrainingInterrupted,
+    )
+    from photon_ml_tpu.game.streaming import (
+        LocalChunk,
+        ShardedCoefficientTable,
+        StreamingRandomEffectTrainer,
+    )
+    from photon_ml_tpu.ops.dense import DenseBatch
+    from photon_ml_tpu.optim import (
+        OptimizerConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_ml_tpu.parallel import multihost
+
+    stop = GracefulStop().install()
+    n_dev = jax.device_count()
+    mesh = multihost.global_mesh({"entity": n_dev})
+    # shared deterministic problem: every member generates the same data
+    X, y = make_problem()
+    per = N_ENTITIES // N_CHUNKS
+
+    def local_chunk(start: int) -> LocalChunk:
+        # this process's slice of the chunk's global [start, start+per)
+        # rows — recomputed from the CURRENT mesh, so a survivor fleet's
+        # members absorb the dead host's rows deterministically
+        lo, hi = multihost.process_slice(per, mesh, "entity")
+        glo, ghi = start + lo, start + hi
+        return LocalChunk(
+            DenseBatch(
+                x=X[glo:ghi],
+                labels=y[glo:ghi],
+                offsets=np.zeros((ghi - glo, N_ROWS), np.float32),
+                weights=np.ones((ghi - glo, N_ROWS), np.float32),
+            ),
+            global_size=per,
+        )
+
+    chunks = [(i * per, local_chunk(i * per)) for i in range(N_CHUNKS)]
+    cfg = OptimizerConfig(
+        max_iterations=60,
+        tolerance=1e-9,
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=0.3,
+    )
+    mgr = StreamingCheckpointManager(
+        CheckpointSpec(
+            directory=os.path.join(args.dir, "ckpt"),
+            every=1,
+            quorum_timeout_s=args.quorum_timeout,
+        )
+    )
+    restored = mgr.restore_placed(mesh=mesh)
+    if restored is not None:
+        table = ShardedCoefficientTable.from_coefficients(
+            restored.coefficients, mesh=mesh
+        )
+        start_chunk = restored.next_chunk
+    else:
+        table = ShardedCoefficientTable(N_ENTITIES, DIM, mesh=mesh)
+        start_chunk = 0
+
+    def should_stop() -> bool:
+        if args.chunk_sleep > 0:
+            time.sleep(args.chunk_sleep)
+        # fleet-consistent agreement: every member sees the same verdict
+        # at the same boundary, so nobody sails alone into a collective
+        return multihost.fleet_any(stop.requested, mesh)
+
+    trainer = StreamingRandomEffectTrainer(
+        "logistic", cfg, mesh=mesh, prefetch=False
+    )
+    try:
+        trainer.train(
+            table,
+            chunks,
+            checkpointer=mgr,
+            start_chunk=start_chunk,
+            should_stop=should_stop,
+        )
+        final = table.to_numpy()  # every member runs the gather collective
+    except TrainingInterrupted as e:
+        print(json.dumps({
+            "interrupted": True,
+            "at_chunk": e.step,
+            "checkpoint": e.checkpoint_path,
+            "start_chunk": start_chunk,
+            "process_id": args.proc,
+        }))
+        return GRACEFUL_EXIT_CODE
+    except Exception as e:  # noqa: BLE001 — any failure in a degraded fleet
+        if jax.process_count() > 1:
+            # a collective failed (gloo "connection closed by peer" et
+            # al): the fleet is broken and this process cannot help it.
+            # Exit through os._exit — normal unwinding would WEDGE in
+            # jax's atexit distributed-shutdown barrier against the dead
+            # peer, turning one lost host into a hung survivor.
+            print(json.dumps({
+                "fleet_abort": True,
+                "process_id": args.proc,
+                "error": f"{type(e).__name__}: {e}"[:500],
+            }))
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(FLEET_ABORT_EXIT_CODE)
+        raise
+    if jax.process_index() == 0:
+        np.save(os.path.join(args.dir, "final.npy"), final)
+    print(json.dumps({
+        "interrupted": False,
+        "resumed": restored is not None,
+        "start_chunk": start_chunk,
+        "process_id": args.proc,
+        "num_processes": args.nproc,
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools.fleet", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("--worker", action="store_true",
+                        help="run as ONE fleet member (internal)")
+    parser.add_argument("--proc", type=int, default=0)
+    parser.add_argument("--nproc", type=int, default=1)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--dir", help="fleet working directory")
+    parser.add_argument("--quorum-timeout", type=float, default=4.0)
+    parser.add_argument("--heartbeat-every", type=float, default=0.25)
+    parser.add_argument("--chunk-sleep", type=float, default=0.0)
+    parser.add_argument("--workdir", help="supervisor working directory")
+    parser.add_argument("--num-processes", type=int, default=2)
+    parser.add_argument("--devices-per-process", type=int, default=2)
+    parser.add_argument("--max-relaunches", type=int, default=2)
+    parser.add_argument("--json", dest="json_out",
+                        help="write the supervisor report to this path")
+    args = parser.parse_args(argv)
+    if args.worker:
+        if not args.dir:
+            parser.error("--worker requires --dir")
+        return _worker_main(args)
+    if not args.workdir:
+        parser.error("--workdir is required (or --worker --dir)")
+    # the supervisor owns recovery.fleet_* — export them like bench.py
+    # does (PHOTON_TELEMETRY_OUT / PHOTON_TRACE_OUT opt-in) so a real
+    # fleet run's member deaths/relaunches reach the RunReport Recovery
+    # section, not just this process's memory
+    from photon_ml_tpu import telemetry
+
+    telemetry.configure_from_env()
+    report = run_fleet(FleetSpec(
+        workdir=args.workdir,
+        num_processes=args.num_processes,
+        devices_per_process=args.devices_per_process,
+        max_relaunches=args.max_relaunches,
+    ))
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return 0 if report.get("ok") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
