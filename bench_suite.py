@@ -44,9 +44,16 @@ SUITE_METRICS = (
     "poisson_offsets_box_1Mx10K_rows_per_sec_per_chip",
 )
 
-#: Gate metrics where a RISE is the regression (wall-time ratios); all
-#: other gated metrics are rates where a drop regresses.
-LOWER_IS_BETTER_METRICS = frozenset({"sweep_over_single_ratio"})
+#: Gate metrics where a RISE is the regression (wall-time ratios and
+#: latency/flatness SLOs); all other gated metrics are rates where a
+#: drop regresses.
+LOWER_IS_BETTER_METRICS = frozenset({
+    "sweep_over_single_ratio",
+    "serving_slo_p99_ms",
+    "serving_slo_p99_swap_ratio",
+    "serving_slo_p99_nearline_ratio",
+    "serving_nearline_apply_ms",
+})
 
 
 #: Safety margin reserved BEFORE the PHOTON_BENCH_BUDGET_S wall so the
@@ -414,6 +421,15 @@ def main(argv=None) -> int:
         "baselines that predate ingest_pipeline_rows_per_sec skip it "
         "with a note",
     )
+    parser.add_argument(
+        "--serving",
+        action="store_true",
+        help="also run bench_serving.py's sustained-load SLO sweep "
+        "(offered-load grid, p99-across-hot-swap and across-nearline "
+        "flatness, time-to-applied-update) and include the serving_slo_* "
+        "metrics in the gate; baselines that predate them skip with a "
+        "note",
+    )
     args = parser.parse_args(argv)
     from photon_ml_tpu import faults
 
@@ -445,6 +461,10 @@ def main(argv=None) -> int:
         from bench_ingest import run_ingest
 
         results.update(run_ingest(deadline=deadline))
+    if args.serving:
+        from bench_serving import run_serving_slo
+
+        results.update(run_serving_slo(deadline=deadline))
     if args.gate:
         return run_gate(
             results, load_gate_baseline(args.gate), args.gate_threshold

@@ -3,16 +3,30 @@
 Long-lived, low-latency counterpart of the batch ``cli score`` driver:
 
     python -m photon_ml_tpu.cli serve --registry-dir out/registry \\
-        --port 8080 --max-batch 64 --max-delay-ms 5 --queue-depth 256
+        --port 8080 --max-batch 64 --queue-depth 256
+
+    python -m photon_ml_tpu.cli serve --model-dir out/model/best \\
+        --mesh model=8 --frontend asyncio --batcher continuous \\
+        --nearline memberId --nearline-publish-dir out/registry
 
     python -m photon_ml_tpu.cli serve --model-dir out/model/best --stdio
 
 ``--registry-dir`` watches a versioned models directory and hot-swaps to
 the newest valid version (see serving/registry.py for the layout);
 ``--model-dir`` pins one saved model (still requiring its
-``feature-indexes/``). ``--stdio`` swaps the HTTP front end for a JSONL
-stdin/stdout loop so pipelines and CI can drive the service without
-sockets.
+``feature-indexes/``). ``--mesh model=N`` serves the random-effect
+coefficient tables ENTITY-SHARDED over an N-device mesh axis instead of
+replicated — the GLMix "tables too big for one chip" deployment;
+``--re-checkpoint coord=dir`` restores that coordinate's table from a
+sharded streamed-checkpoint manifest straight onto the serving mesh
+(``restore_placed``, no host materialization). ``--frontend asyncio``
+swaps the thread-per-connection stdlib server for the event-loop front
+end; ``--batcher continuous`` swaps the fixed-deadline micro-batcher for
+continuous batching (admit rows into the next in-flight bucket as device
+capacity frees). ``--nearline <id_name>`` accepts ``POST /v1/update``
+feedback events and re-solves just those entities' coefficient rows in
+place. ``--stdio`` swaps the HTTP front end for a JSONL stdin/stdout
+loop so pipelines and CI can drive the service without sockets.
 """
 
 from __future__ import annotations
@@ -24,6 +38,34 @@ import sys
 import threading
 
 from photon_ml_tpu.utils import logger, setup_logging
+
+
+def _build_mesh(raw: str):
+    """``--mesh`` flag -> a serving Mesh (or None for off)."""
+    from photon_ml_tpu.cli.train import parse_mesh_flag
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.parallel.sharding import MODEL_AXIS
+
+    spec = parse_mesh_flag(raw)
+    if spec is False:
+        return None
+    if spec is True:
+        import jax
+
+        spec = {MODEL_AXIS: jax.device_count()}
+    return make_mesh(spec)
+
+
+def _parse_re_checkpoints(pairs):
+    out = {}
+    for pair in pairs or ():
+        coord, eq, directory = pair.partition("=")
+        if not eq or not coord or not directory:
+            raise ValueError(
+                f"--re-checkpoint expects 'coord=dir', got {pair!r}"
+            )
+        out[coord] = directory
+    return out or None
 
 
 def main(argv=None) -> int:
@@ -40,6 +82,41 @@ def main(argv=None) -> int:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8080)
     parser.add_argument(
+        "--mesh",
+        help="serve entity-sharded over a named mesh: 'model=N' places "
+        "random-effect coefficient tables across the N-device model axis "
+        "('auto' uses all devices); registry hot swaps re-place every "
+        "new version with the same sharding",
+    )
+    parser.add_argument(
+        "--entity-axis",
+        help="mesh axis to shard entity rows over (default: the mesh's "
+        "model axis)",
+    )
+    parser.add_argument(
+        "--re-checkpoint",
+        action="append",
+        metavar="COORD=DIR",
+        help="restore this coordinate's coefficient table from a sharded "
+        "streamed-checkpoint directory straight onto the serving mesh "
+        "(repeatable)",
+    )
+    parser.add_argument(
+        "--frontend",
+        choices=("threading", "asyncio"),
+        default="threading",
+        help="HTTP front end: stdlib thread-per-connection or the "
+        "single-event-loop server (asyncio defaults --batcher to "
+        "continuous)",
+    )
+    parser.add_argument(
+        "--batcher",
+        choices=("deadline", "continuous"),
+        help="request scheduler: fixed-deadline coalescing (MicroBatcher) "
+        "or continuous batching (default: continuous under --frontend "
+        "asyncio, deadline otherwise)",
+    )
+    parser.add_argument(
         "--max-batch", type=int, default=64,
         help="largest padded device batch (compiled buckets are powers of "
         "two up to this)",
@@ -47,7 +124,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--max-delay-ms", type=float, default=5.0,
         help="micro-batching deadline: how long a request may wait for "
-        "co-riders",
+        "co-riders (deadline batcher only; continuous ignores it)",
     )
     parser.add_argument(
         "--queue-depth", type=int, default=256,
@@ -63,6 +140,26 @@ def main(argv=None) -> int:
         help="registry watch interval in seconds",
     )
     parser.add_argument(
+        "--nearline",
+        metavar="ID_NAME",
+        help="accept POST /v1/update feedback events and re-solve that "
+        "random-effect coordinate's entity rows in place",
+    )
+    parser.add_argument(
+        "--nearline-flush-s", type=float, default=1.0,
+        help="nearline flush cadence: buffered events are re-solved and "
+        "swapped into the live tables this often",
+    )
+    parser.add_argument(
+        "--nearline-publish-dir",
+        help="persist nearline-updated tables as new registry versions "
+        "here (defaults to --registry-dir when watching one)",
+    )
+    parser.add_argument(
+        "--nearline-publish-s", type=float, default=30.0,
+        help="minimum seconds between nearline version publishes",
+    )
+    parser.add_argument(
         "--stdio", action="store_true",
         help="serve a JSONL request/response loop on stdin/stdout instead "
         "of HTTP",
@@ -76,7 +173,9 @@ def main(argv=None) -> int:
     # purpose — say so at startup, loudly
     faults.warn_if_armed()
     from photon_ml_tpu.serving import (
+        AsyncScoringServer,
         ModelRegistry,
+        NearlineUpdater,
         ScoringEngine,
         ScoringServer,
         ScoringService,
@@ -84,32 +183,77 @@ def main(argv=None) -> int:
     )
 
     registry = None
+    mesh = _build_mesh(args.mesh) if args.mesh else None
     if args.model_dir:
         source = ScoringEngine.load(
             args.model_dir,
             max_batch=args.max_batch,
             max_row_nnz=args.max_row_nnz,
+            mesh=mesh,
+            entity_axis=args.entity_axis,
+            re_checkpoints=_parse_re_checkpoints(args.re_checkpoint),
         ).warmup()
     else:
+        if args.re_checkpoint:
+            raise SystemExit(
+                "--re-checkpoint requires --model-dir (registry versions "
+                "carry their own tables)"
+            )
         registry = ModelRegistry(
             args.registry_dir,
             max_batch=args.max_batch,
             max_row_nnz=args.max_row_nnz,
             poll_interval=args.poll_interval,
+            mesh=mesh,
+            entity_axis=args.entity_axis,
         )
         registry.start()
         source = registry
 
     try:
         if args.stdio:
+            ignored = [
+                flag
+                for flag, on in (
+                    ("--nearline", args.nearline),
+                    ("--frontend", args.frontend != "threading"),
+                    ("--batcher", args.batcher),
+                )
+                if on
+            ]
+            if ignored:
+                raise SystemExit(
+                    "--stdio is a bare engine loop with no batcher, front "
+                    "end, or nearline path; drop " + ", ".join(ignored)
+                )
             return serve_stdio(source, sys.stdin, sys.stdout)
+        batcher = args.batcher or (
+            "continuous" if args.frontend == "asyncio" else "deadline"
+        )
         service = ScoringService(
             source,
             max_batch=args.max_batch,
             max_delay_ms=args.max_delay_ms,
             queue_depth=args.queue_depth,
+            batcher=batcher,
         )
-        server = ScoringServer(service, host=args.host, port=args.port)
+        if args.nearline:
+            publish_dir = args.nearline_publish_dir or args.registry_dir
+            engine = source.engine if registry is not None else source
+            service.attach_nearline(
+                NearlineUpdater(
+                    source,
+                    id_name=args.nearline,
+                    flush_interval_s=args.nearline_flush_s,
+                    publish_dir=publish_dir,
+                    publish_interval_s=args.nearline_publish_s,
+                    index_maps=engine.index_maps if publish_dir else None,
+                )
+            )
+        server_cls = (
+            AsyncScoringServer if args.frontend == "asyncio" else ScoringServer
+        )
+        server = server_cls(service, host=args.host, port=args.port)
         server.start()
         stop = threading.Event()
 
@@ -125,6 +269,8 @@ def main(argv=None) -> int:
                     "serving": {
                         "host": args.host,
                         "port": server.port,
+                        "frontend": args.frontend,
+                        "batcher": batcher,
                         "model_version": service.health().get("model_version"),
                     }
                 }

@@ -498,11 +498,20 @@ class StreamingCheckpointManager:
     a dead run.
     """
 
-    def __init__(self, spec: CheckpointSpec):
+    def __init__(self, spec: CheckpointSpec, read_only: bool = False):
         import numpy as np  # local: keep module import light
 
         self._np = np
         self.spec = spec
+        self.read_only = read_only
+        if read_only:
+            # the restore-to-serving path: never create, never clear —
+            # a typo'd directory is an error, not a fresh empty one
+            if not os.path.isdir(spec.directory):
+                raise CheckpointError(
+                    f"no streamed checkpoint directory at {spec.directory}"
+                )
+            return
         os.makedirs(spec.directory, exist_ok=True)
         if not spec.resume:
             stale = self._chunk_dirs()
@@ -513,6 +522,15 @@ class StreamingCheckpointManager:
                 )
             for _c, path in stale:
                 shutil.rmtree(path, ignore_errors=True)
+
+    @classmethod
+    def open_for_restore(cls, directory: str) -> "StreamingCheckpointManager":
+        """A READ-ONLY manager over an existing checkpoint directory —
+        the restore-to-serving path (:meth:`restore_placed` onto a
+        serving mesh). It never writes, never clears, and :meth:`save`
+        refuses: a serving process must not be able to mutate a training
+        run's checkpoint history."""
+        return cls(CheckpointSpec(directory=directory), read_only=True)
 
     def should_save(self, chunk_index: int) -> bool:
         return (chunk_index + 1) % self.spec.every == 0
@@ -553,6 +571,12 @@ class StreamingCheckpointManager:
         restore falls back past it."""
         import jax
 
+        if self.read_only:
+            raise CheckpointError(
+                f"checkpoint manager over {self.spec.directory} is "
+                "read-only (open_for_restore): serving must not write "
+                "into a training run's checkpoint history"
+            )
         if jax.process_count() > 1:
             return self._save_coordinated(state)
         name = f"chunk-{state.next_chunk:08d}"

@@ -119,6 +119,30 @@ class ElasticPlacementError(ValueError):
     valid checkpoints because of it."""
 
 
+def valid_entity_axis_sizes(num_entities: int) -> list[int]:
+    """The axis sizes ``num_entities`` divides over, capped at the device
+    count — the LEGAL topologies an operator can actually pick."""
+    return [
+        d for d in range(1, min(int(num_entities), jax.device_count()) + 1)
+        if num_entities % d == 0
+    ]
+
+
+def entity_axis_mismatch(
+    num_entities: int, axis: str, size: int, what: str = "re-place elastically"
+) -> ElasticPlacementError:
+    """The ONE formatting of the indivisible-entity-axis error: an operator
+    picking a mesh (elastic restore after host loss, a serving mesh) needs
+    the valid sizes listed, not a modulus. Shared by checkpoint restore
+    (:func:`place_entity_rows`) and the sharded serving engine."""
+    return ElasticPlacementError(
+        f"num_entities={num_entities} must divide over the "
+        f"{size}-device '{axis}' axis to {what}; valid "
+        f"target axis sizes for this table: "
+        f"{valid_entity_axis_sizes(num_entities)}"
+    )
+
+
 def place_entity_rows(
     read_rows,
     num_entities: int,
@@ -167,17 +191,9 @@ def place_entity_rows(
         )
     sharding = entity_sharding(mesh, axis)
     if shape[0] % axis_size(mesh, sharding.spec[0]):
-        # name the LEGAL topologies: an operator picking a survivor
-        # count after losing hosts needs the valid sizes, not a modulus
-        valid = [
-            d for d in range(1, min(shape[0], jax.device_count()) + 1)
-            if shape[0] % d == 0
-        ]
-        raise ElasticPlacementError(
-            f"num_entities={shape[0]} must divide over the "
-            f"{axis_size(mesh, sharding.spec[0])}-device "
-            f"'{sharding.spec[0]}' axis to re-place elastically; valid "
-            f"target axis sizes for this checkpoint: {valid}"
+        raise entity_axis_mismatch(
+            shape[0], sharding.spec[0],
+            axis_size(mesh, sharding.spec[0]),
         )
 
     def callback(index):

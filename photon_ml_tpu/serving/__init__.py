@@ -22,14 +22,37 @@ package is the long-lived answer:
   idea as ``game/checkpoint.py``), hot-swaps to the newest valid version
   in the background, and skips past corrupt/partial versions.
 - :mod:`photon_ml_tpu.serving.server` — stdlib HTTP endpoints
-  (``POST /v1/score``, ``GET /healthz``, ``GET /metricsz``) plus a stdio
-  JSONL mode so tests and CI can drive the service without sockets.
+  (``POST /v1/score``, ``POST /v1/update``, ``GET /healthz``,
+  ``GET /metricsz``) plus a stdio JSONL mode so tests and CI can drive
+  the service without sockets.
+- :mod:`photon_ml_tpu.serving.aio` — :class:`AsyncScoringServer`, the
+  same endpoints from ONE asyncio event loop instead of a thread per
+  connection (the sustained-load front end; pairs with
+  :class:`ContinuousBatcher`, which admits rows into the next in-flight
+  device bucket as capacity frees instead of waiting out a deadline).
+- :mod:`photon_ml_tpu.serving.nearline` — :class:`NearlineUpdater`
+  consumes (entity, features, label) feedback events and re-solves JUST
+  those entities' random-effect coefficient rows online (warm-started
+  from the live tables, the training solver's vmap lanes), swapping them
+  into the serving tables in place and publishing updated versions on a
+  cadence.
+
+With ``ScoringEngine.load(..., mesh=...)`` the random-effect tables are
+placed ENTITY-SHARDED across the mesh (``parallel.sharding`` — the same
+placement training uses, so sharded training checkpoints restore straight
+onto the serving mesh via ``re_checkpoints=``).
 
 Wired to the CLI as ``python -m photon_ml_tpu.cli serve``.
 """
 
-from photon_ml_tpu.serving.batcher import MicroBatcher, Overloaded  # noqa: F401
+from photon_ml_tpu.serving.aio import AsyncScoringServer  # noqa: F401
+from photon_ml_tpu.serving.batcher import (  # noqa: F401
+    ContinuousBatcher,
+    MicroBatcher,
+    Overloaded,
+)
 from photon_ml_tpu.serving.engine import BadRequest, ScoringEngine  # noqa: F401
+from photon_ml_tpu.serving.nearline import NearlineUpdater  # noqa: F401
 from photon_ml_tpu.serving.registry import (  # noqa: F401
     ModelRegistry,
     publish_version,
@@ -45,11 +68,14 @@ __all__ = [
     "ScoringEngine",
     "BadRequest",
     "MicroBatcher",
+    "ContinuousBatcher",
     "Overloaded",
     "ModelRegistry",
+    "NearlineUpdater",
     "publish_version",
     "scan_versions",
     "ScoringService",
     "ScoringServer",
+    "AsyncScoringServer",
     "serve_stdio",
 ]

@@ -43,6 +43,13 @@ _FP_DISPATCH = faults.register_point(
     "serving.dispatch",
     description="micro-batched scoring dispatch (one engine call)",
 )
+# The continuous-batching dispatch (the async front end's scheduler): same
+# delivery semantics as serving.dispatch, distinct seam so chaos runs can
+# target the event-loop request path specifically.
+_FP_ASYNC_DISPATCH = faults.register_point(
+    "serving.async_dispatch",
+    description="continuous-batching scoring dispatch (one engine call)",
+)
 
 
 class Overloaded(RuntimeError):
@@ -62,6 +69,9 @@ class _Unit:
 
 class MicroBatcher:
     """Deadline-bounded request coalescing in front of a scorer."""
+
+    #: injection seam this batcher's dispatch fires (subclasses override)
+    _fault_seam = _FP_DISPATCH
 
     def __init__(
         self,
@@ -196,7 +206,7 @@ class MicroBatcher:
         flat = [r for u in units for r in u.rows]
         telemetry.histogram("serving.batch_size").observe(len(flat))
         try:
-            faults.fault_point(_FP_DISPATCH)
+            faults.fault_point(self._fault_seam)
             scores, version = self._scorer(flat)
         except Exception as e:  # noqa: BLE001 — failure belongs to callers
             if len(units) == 1:
@@ -235,3 +245,49 @@ class MicroBatcher:
             with self._cv:
                 if not self._running and not self._queue:
                     return
+
+
+class ContinuousBatcher(MicroBatcher):
+    """Continuous batching: the device is never idle while work is queued.
+
+    :class:`MicroBatcher` holds the first request of every batch hostage
+    to the ``max_delay_ms`` deadline hoping co-riders arrive — the right
+    trade for a mostly-idle server, the wrong one under sustained load,
+    where the deadline only ADDS latency: while one batch runs on the
+    device, the next has already formed in the queue. This scheduler
+    instead dispatches IMMEDIATELY with whatever is queued (up to
+    ``max_batch`` rows): requests arriving while a batch is in flight are
+    admitted into the next bucket the moment device capacity frees —
+    batch size grows naturally with offered load (1 at idle, ``max_batch``
+    at saturation), and no request ever waits on a timer.
+
+    ``max_delay_ms`` is accepted for signature compatibility and ignored.
+    Admission control (queue depth in rows -> typed :class:`Overloaded`),
+    oversized-request rejection (:class:`BadRequest`), cancelled-future
+    dropping, and co-rider error isolation are all inherited unchanged —
+    one semantics, two scheduling policies.
+    """
+
+    _fault_seam = _FP_ASYNC_DISPATCH
+
+    def _collect(self) -> list[_Unit]:
+        """Block until at least one unit is queued, then take as many
+        whole units as fit in ``max_batch`` rows WITHOUT waiting for
+        more. A single unit larger than ``max_batch`` dispatches alone
+        (the engine chunks internally)."""
+        with self._cv:
+            while self._running and not self._queue:
+                self._cv.wait()
+            if not self._queue:
+                return []
+            units = [self._queue.popleft()]
+            total = len(units[0].rows)
+            while (
+                self._queue
+                and total + len(self._queue[0].rows) <= self.max_batch
+            ):
+                nxt = self._queue.popleft()
+                units.append(nxt)
+                total += len(nxt.rows)
+            self._pending_rows -= total
+            return units

@@ -39,8 +39,10 @@ semantics of training).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
+import threading
 import time
 from typing import Mapping, Optional, Sequence
 
@@ -56,6 +58,7 @@ from photon_ml_tpu.game.models import (
     RandomEffectModel,
 )
 from photon_ml_tpu.ops.losses import get_loss
+from photon_ml_tpu.parallel import sharding as psharding
 
 
 class BadRequest(ValueError):
@@ -80,7 +83,7 @@ def bucket_sizes_for(max_batch: int) -> tuple[int, ...]:
 
 @functools.lru_cache(maxsize=32)  # bounded: a long-lived server swapping
 # structurally different versions must not accumulate executables forever
-def _compiled_score_fn(link: str, coords: tuple):
+def _compiled_score_fn(link: str, coords: tuple, eshard=None):
     """One jitted score function per model STRUCTURE.
 
     ``coords`` is a static spec per coordinate: ``("fixed", shard_idx)``
@@ -90,11 +93,26 @@ def _compiled_score_fn(link: str, coords: tuple):
     one executable and swap with ZERO recompiles. Batch size and table
     shapes are read off the traced arguments — each padded bucket size is
     its own trace inside the one jit cache.
+
+    ``eshard`` (a hashable ``NamedSharding``, or None for the replicated
+    single-device engine) pins every random-effect table's entity axis to
+    the serving mesh INSIDE the trace: without the constraint the
+    compiler is free to "helpfully" replicate a table that only fits
+    sharded. With it, the per-row coefficient gathers execute on the
+    shard that owns each entity's rows (GSPMD inserts the cross-shard
+    combine) and the request path stays free of host syncs — the L013
+    gate walks this function like any other.
     """
     re_slots = {}
     for ci, spec in enumerate(coords):
         if spec[0] == "re":
             re_slots[ci] = len(re_slots)
+
+    def _pin(table):
+        # keep entity-sharded tables entity-sharded through the trace
+        if eshard is None:
+            return table
+        return jax.lax.with_sharding_constraint(table, eshard)
 
     def fn(offsets, shards, re_inputs, tables):
         batch = offsets.shape[0]
@@ -109,6 +127,7 @@ def _compiled_score_fn(link: str, coords: tuple):
                 pos_n = row_pos[rows]
                 contrib = jnp.zeros_like(values)
                 for b_idx, (proj, coef) in enumerate(tables[ci]):
+                    proj, coef = _pin(proj), _pin(coef)
                     num_entities, local_dim = proj.shape
                     p = jnp.clip(pos_n, 0, num_entities - 1)
                     if local_dim <= 64:
@@ -164,11 +183,111 @@ def _compiled_score_fn(link: str, coords: tuple):
     )
 
 
+@functools.lru_cache(maxsize=8)
+def _row_update_fn(eshard=None):
+    """The nearline row-swap executable: scatter re-solved coefficient
+    rows into a table, keeping an entity-sharded table pinned to its
+    sharding (the scatter indices are replicated, so each shard applies
+    only the rows it owns). Non-donating on purpose: the OLD table tuple
+    stays valid for any score call still holding it — donation here
+    would be the freed-buffer aliasing hazard of PR 10 all over again.
+
+    multi_shape: one signature per (table shape, update-batch size) by
+    design; the nearline updater pads update batches to power-of-two
+    sizes so steady state re-uses a handful of traces."""
+
+    def fn(table, pos, rows):
+        out = table.at[pos].set(rows)
+        if eshard is not None:
+            out = jax.lax.with_sharding_constraint(out, eshard)
+        return out
+
+    return telemetry.instrumented_jit(
+        fn, name="serving_row_update", multi_shape=True
+    )
+
+
+def _restore_re_coordinate(
+    model: GameModel,
+    coord: str,
+    ckpt_dir: str,
+    mesh=None,
+    entity_axis: Optional[str] = None,
+) -> GameModel:
+    """Replace one random-effect coordinate's coefficient table with the
+    newest certified streamed checkpoint, placed DIRECTLY onto the
+    serving mesh (``restore_placed``: per-device reads over mmap'd shard
+    files — the table never materializes on one host). The
+    restore-to-serving path of ROADMAP item 1: train sharded, checkpoint
+    sharded, serve sharded, no gather in between."""
+    from photon_ml_tpu.data.model_store import ModelLoadError
+    from photon_ml_tpu.game.checkpoint import StreamingCheckpointManager
+
+    sub = model.models.get(coord)
+    if not isinstance(sub, RandomEffectModel):
+        raise ModelLoadError(
+            ckpt_dir,
+            f"re_checkpoints names coordinate '{coord}', which is not a "
+            f"random-effect coordinate of the model "
+            f"(has: {sorted(model.models)})",
+        )
+    if len(sub.buckets) != 1:
+        raise ModelLoadError(
+            ckpt_dir,
+            f"coordinate '{coord}' has {len(sub.buckets)} geometry "
+            "buckets; streamed checkpoints hold ONE dense [E, K] table, "
+            "so only single-bucket coordinates restore from one",
+        )
+    manager = StreamingCheckpointManager.open_for_restore(ckpt_dir)
+    restore = manager.restore_placed(mesh=mesh, axis=entity_axis)
+    if restore is None:
+        raise ModelLoadError(
+            ckpt_dir,
+            "no certified streamed checkpoint to restore the serving "
+            f"table for coordinate '{coord}' from",
+        )
+    bm = sub.buckets[0]
+    got = tuple(int(d) for d in restore.coefficients.shape)
+    want = tuple(int(d) for d in bm.coefficients.shape)
+    if got != want:
+        raise ModelLoadError(
+            ckpt_dir,
+            f"checkpoint table shape {got} does not match coordinate "
+            f"'{coord}' table shape {want}",
+        )
+    return model.with_model(
+        coord,
+        dataclasses.replace(
+            sub,
+            buckets=(
+                dataclasses.replace(bm, coefficients=restore.coefficients),
+            ),
+        ),
+    )
+
+
 class ScoringEngine:
     """A :class:`GameModel` compiled into long-lived, device-resident
-    scoring form. Immutable after construction — the registry hot-swaps
-    by replacing the engine reference while in-flight requests finish on
-    the old one."""
+    scoring form. Structurally immutable after construction — the
+    registry hot-swaps by replacing the engine reference while in-flight
+    requests finish on the old one. The ONE sanctioned mutation is
+    :meth:`apply_re_rows` (nearline personalization): per-entity
+    coefficient rows are re-solved online and swapped in by replacing
+    the whole device-table tuple atomically under the engine's version
+    lock — a reader sees the old tables or the new ones, never a torn
+    mix.
+
+    With ``mesh=`` (a mesh carrying a ``model``/``entity`` axis), every
+    random-effect coefficient/projection table is placed
+    ENTITY-SHARDED over that axis via
+    :func:`photon_ml_tpu.parallel.sharding.entity_sharding` — the same
+    one placement definition training uses, so a sharded training
+    checkpoint restores straight onto the serving mesh
+    (``load(..., re_checkpoints=...)``) with no resharding. Fixed-effect
+    vectors and request inputs stay replicated; the jitted score
+    function pins the tables sharded so per-row gathers run on the
+    owning shard.
+    """
 
     def __init__(
         self,
@@ -177,6 +296,8 @@ class ScoringEngine:
         max_batch: int = 64,
         max_row_nnz: int = 128,
         version: str = "unversioned",
+        mesh=None,
+        entity_axis: Optional[str] = None,
     ):
         if max_row_nnz < 1:
             raise ValueError("max_row_nnz must be >= 1")
@@ -189,6 +310,17 @@ class ScoringEngine:
         self.warm = False
         self._link = get_loss(model.task).name
         self._index_maps = dict(index_maps or {})
+        self.mesh = mesh
+        self.entity_axis = None
+        self._eshard = None
+        if mesh is not None:
+            self.entity_axis = entity_axis or psharding.model_axis(mesh)
+            if self.entity_axis is None:
+                raise ValueError(
+                    f"serving mesh {dict(mesh.shape)} has no model/entity "
+                    "axis to shard coefficient tables over"
+                )
+            self._eshard = psharding.entity_sharding(mesh, self.entity_axis)
 
         shard_names: list[str] = []
         shard_dims: dict[str, Optional[int]] = {}
@@ -215,6 +347,24 @@ class ScoringEngine:
                 )
                 for bm in sub.buckets:
                     num_e, local_k = bm.coefficients.shape
+                    if (
+                        self._eshard is not None
+                        and num_e % psharding.axis_size(
+                            self.mesh, self.entity_axis
+                        )
+                    ):
+                        # the valid-topology listing of elastic restore,
+                        # not a bare modulus: the operator picking a
+                        # serving mesh needs the sizes that CAN hold
+                        # coordinate `name`'s table
+                        raise psharding.entity_axis_mismatch(
+                            num_e, self.entity_axis,
+                            psharding.axis_size(self.mesh, self.entity_axis),
+                            what=(
+                                f"shard coordinate '{name}' on the "
+                                "serving mesh"
+                            ),
+                        )
                     # coefficients + int32 projection, both 4-byte
                     predicted_bytes += 2 * telemetry.memory.estimate_table_bytes(
                         num_e, local_k
@@ -237,6 +387,11 @@ class ScoringEngine:
         self._shard_names = tuple(shard_names)
         self._coords = tuple(coords)
         self._re_hosts = tuple(re_hosts)
+        # RE slot -> position in self._tables (the nearline update path
+        # addresses tables by RE slot, aligned with self._re_hosts)
+        self._re_coord_indices = tuple(
+            ci for ci, spec in enumerate(self._coords) if spec[0] == "re"
+        )
         # per-shard feature-space bound for request validation: an
         # out-of-range id would be silently dropped by the clamped device
         # gathers (the silent-wrong-scores hazard). FE coefficients give
@@ -250,30 +405,79 @@ class ScoringEngine:
         )
 
         # predict the upload BEFORE it happens: a model too big for free
-        # HBM should warn at load, not OOM the first request
+        # HBM should warn at load, not OOM the first request. On a mesh
+        # the per-device share is predicted/actual table bytes over the
+        # entity-axis size — the whole point of sharded serving.
         telemetry.memory.check_headroom(
-            predicted_bytes, label=f"serving model {version}"
+            predicted_bytes
+            if self._eshard is None
+            else -(-predicted_bytes
+                   // psharding.axis_size(self.mesh, self.entity_axis)),
+            label=f"serving model {version}",
         )
         uploaded = []
         for t in host_tables:
             if isinstance(t, tuple):
-                uploaded.append(
-                    tuple(
-                        (
-                            jnp.asarray(proj, jnp.int32),
-                            jnp.asarray(coef, jnp.float32),
+                # RE tables: entity-sharded over the mesh's model axis
+                # when serving sharded; plain upload otherwise. A table
+                # restored straight from a sharded checkpoint
+                # (load(re_checkpoints=...)) arrives already placed with
+                # this exact sharding, so the device_put is a no-op.
+                if self._eshard is None:
+                    uploaded.append(
+                        tuple(
+                            (
+                                jnp.asarray(proj, jnp.int32),
+                                jnp.asarray(coef, jnp.float32),
+                            )
+                            for proj, coef in t
                         )
-                        for proj, coef in t
+                    )
+                else:
+                    uploaded.append(
+                        tuple(
+                            (
+                                jax.device_put(
+                                    jnp.asarray(proj, jnp.int32),
+                                    self._eshard,
+                                ),
+                                jax.device_put(
+                                    jnp.asarray(coef, jnp.float32),
+                                    self._eshard,
+                                ),
+                            )
+                            for proj, coef in t
+                        )
+                    )
+            elif self._eshard is None:
+                uploaded.append(jnp.asarray(t, jnp.float32))
+            else:
+                # fixed-effect vectors are small: replicate across the mesh
+                uploaded.append(
+                    jax.device_put(
+                        jnp.asarray(t, jnp.float32),
+                        psharding.replicated(self.mesh),
                     )
                 )
-            else:
-                uploaded.append(jnp.asarray(t, jnp.float32))
         self._tables = tuple(uploaded)
-        self._fn = _compiled_score_fn(self._link, self._coords)
+        self._fn = _compiled_score_fn(self._link, self._coords, self._eshard)
+        # the VERSION LOCK: apply_re_rows builds + swaps the whole table
+        # tuple under it, so concurrent nearline appliers serialize;
+        # score_rows deliberately reads self._tables WITHOUT it (one
+        # atomic reference read — old tuple or new tuple, never torn)
+        self._version_lock = threading.Lock()
+        self.nearline_seq = 0
         # per-batch-bucket executable records (telemetry.xla), captured at
         # warmup — the healthz/metricsz compile-state surface
         self._bucket_records: dict[int, object] = {}
         telemetry.gauge("serving.model_bytes").set(predicted_bytes)
+
+    @property
+    def index_maps(self) -> dict:
+        """The per-shard feature index maps this engine resolves named
+        features through (empty when constructed without any) — the maps
+        a nearline publish pins next to the updated coefficients."""
+        return self._index_maps
 
     @staticmethod
     def _shard_slot(shard_names: list[str], name: str) -> int:
@@ -291,6 +495,9 @@ class ScoringEngine:
         max_row_nnz: int = 128,
         version: Optional[str] = None,
         require_feature_indexes: bool = True,
+        mesh=None,
+        entity_axis: Optional[str] = None,
+        re_checkpoints: Optional[Mapping[str, str]] = None,
     ) -> "ScoringEngine":
         """Build an engine from a saved model directory.
 
@@ -298,6 +505,17 @@ class ScoringEngine:
         feature space pinned next to the coefficients, named features
         cannot be resolved and integer ids cannot be trusted — the
         silent-wrong-scores hazard the batch driver only warned about.
+
+        ``mesh=`` serves the model ENTITY-SHARDED (see the class
+        docstring); a random-effect table whose entity count does not
+        divide the mesh's entity axis raises
+        :class:`~photon_ml_tpu.parallel.sharding.ElasticPlacementError`
+        listing the axis sizes that CAN hold it. ``re_checkpoints=``
+        maps coordinate name -> streamed-checkpoint directory: that
+        coordinate's coefficient table is restored from the sharded
+        checkpoint manifest STRAIGHT onto the serving mesh
+        (``restore_placed`` — per-device shard reads, no host
+        materialization), replacing the table stored in ``model_dir``.
         """
         from photon_ml_tpu.data.model_store import (
             ModelLoadError,
@@ -314,12 +532,18 @@ class ScoringEngine:
                 "would be silently wrong",
             )
         model = load_game_model(model_dir)
+        for coord, ckpt_dir in (re_checkpoints or {}).items():
+            model = _restore_re_coordinate(
+                model, coord, ckpt_dir, mesh=mesh, entity_axis=entity_axis
+            )
         return cls(
             model,
             index_maps=index_maps,
             max_batch=max_batch,
             max_row_nnz=max_row_nnz,
             version=version or os.path.basename(os.path.normpath(model_dir)),
+            mesh=mesh,
+            entity_axis=entity_axis,
         )
 
     # -- request assembly ----------------------------------------------------
@@ -509,3 +733,85 @@ class ScoringEngine:
                 "calls": rec.calls,
             }
         return out
+
+    # -- nearline in-place updates -------------------------------------------
+
+    def re_slot_for(self, id_name: str) -> int:
+        """The RE slot index (into :meth:`re_host` / :meth:`re_tables`)
+        serving entity ids named ``id_name``."""
+        for slot, host in enumerate(self._re_hosts):
+            if host[0] == id_name:
+                return slot
+        raise KeyError(
+            f"model has no random-effect coordinate keyed by id "
+            f"'{id_name}' (has: {[h[0] for h in self._re_hosts]})"
+        )
+
+    def re_host(self, slot: int):
+        """(id_name, value->code lookup, entity_bucket, entity_pos) host
+        state for RE slot ``slot`` — the entity placement the nearline
+        updater resolves events through."""
+        return self._re_hosts[slot]
+
+    def re_tables(self, slot: int):
+        """The CURRENT ((projection, coefficients), ...) device tables of
+        RE slot ``slot`` — a snapshot reference; a concurrent
+        :meth:`apply_re_rows` replaces the tuple, never mutates it."""
+        return self._tables[self._re_coord_indices[slot]]
+
+    def apply_re_rows(
+        self, slot: int, bucket: int, positions, rows,
+        real_rows: Optional[int] = None,
+    ) -> int:
+        """Swap re-solved per-entity coefficient rows into the live
+        serving tables — the nearline personalization commit point.
+
+        Builds the updated table with a non-donating scatter executable
+        and replaces the WHOLE table tuple in one reference assignment
+        under the version lock: a score call dispatched at any moment
+        sees either the complete old tables or the complete new ones.
+        ``real_rows`` is how many leading lanes are real entities (the
+        rest are power-of-two padding duplicates — scattered, but not
+        counted as applied rows). Returns the engine's new nearline
+        sequence number."""
+        ci = self._re_coord_indices[slot]
+        pos = jnp.asarray(positions, jnp.int32)
+        new_rows = jnp.asarray(rows, jnp.float32)
+        update = _row_update_fn(self._eshard)
+        with self._version_lock:
+            tables = list(self._tables)
+            buckets = list(tables[ci])
+            proj, coef = buckets[bucket]
+            buckets[bucket] = (proj, update(coef, pos, new_rows))
+            tables[ci] = tuple(buckets)
+            self._tables = tuple(tables)
+            self.nearline_seq += 1
+            seq = self.nearline_seq
+        telemetry.counter("serving.nearline.applied_rows").inc(
+            int(pos.shape[0] if real_rows is None else real_rows)
+        )
+        return seq
+
+    def current_model(self) -> GameModel:
+        """The :class:`GameModel` as currently served — base model
+        structure with every random-effect bucket's coefficients replaced
+        by the LIVE device tables (reflecting nearline row swaps). Used
+        by the nearline publish cadence; the arrays stay on device — the
+        model store fetches at save time, off the request path."""
+        with self._version_lock:
+            tables = self._tables
+        model = self.model
+        re_slot = 0
+        for name, sub in model.models.items():
+            if not isinstance(sub, RandomEffectModel):
+                continue
+            ci = self._re_coord_indices[re_slot]
+            re_slot += 1
+            new_buckets = tuple(
+                dataclasses.replace(bm, coefficients=coef)
+                for bm, (_proj, coef) in zip(sub.buckets, tables[ci])
+            )
+            model = model.with_model(
+                name, dataclasses.replace(sub, buckets=new_buckets)
+            )
+        return model

@@ -139,12 +139,20 @@ class ModelRegistry:
         warm: bool = True,
         load_retries: int = 2,
         retry_backoff_s: float = 0.1,
+        mesh=None,
+        entity_axis: Optional[str] = None,
     ):
         self.directory = directory
         self.max_batch = max_batch
         self.max_row_nnz = max_row_nnz
         self.poll_interval = poll_interval
         self.warm = warm
+        # serve every loaded version ENTITY-SHARDED over this mesh (the
+        # engine's mesh= path); hot swaps re-place the new version's
+        # tables with the same sharding, so a swap never degrades a
+        # sharded deployment to replicated
+        self.mesh = mesh
+        self.entity_axis = entity_axis
         # transient-IO retry budget per version load (a half-synced NFS
         # dir, a flaky read): retries back off retry_backoff_s * 2**k and
         # count serving.version_retries
@@ -243,6 +251,8 @@ class ModelRegistry:
                     max_batch=self.max_batch,
                     max_row_nnz=self.max_row_nnz,
                     version=version_dirname(version),
+                    mesh=self.mesh,
+                    entity_axis=self.entity_axis,
                 )
                 if self.warm:
                     engine.warmup()

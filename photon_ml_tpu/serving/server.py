@@ -28,7 +28,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Mapping, Optional
 
 from photon_ml_tpu import telemetry
-from photon_ml_tpu.serving.batcher import MicroBatcher, Overloaded
+from photon_ml_tpu.serving.batcher import (
+    ContinuousBatcher,
+    MicroBatcher,
+    Overloaded,
+)
 from photon_ml_tpu.serving.engine import BadRequest, ScoringEngine
 
 logger = logging.getLogger("photon_ml_tpu.serving.server")
@@ -49,12 +53,33 @@ def _metrics_payload() -> dict:
     return payload
 
 
+def _json_scores(result: Mapping) -> dict:
+    """Shape one batcher result for the wire (shared by the threading
+    and asyncio front ends)."""
+    return {
+        # host-side already: the batcher future resolves to a numpy
+        # slice the engine fetched through sync_fetch — float() here
+        # is JSON shaping of host scalars, not a device crossing
+        "scores": [round(float(s), 8) for s in result["scores"]],  # photon: noqa[L013]
+        "model_version": result["model_version"],
+    }
+
+
 class ScoringService:
-    """Engine-or-registry + micro-batcher glue shared by HTTP and stdio.
+    """Engine-or-registry + batcher glue shared by the threading HTTP,
+    asyncio HTTP, and stdio front ends.
 
     The batcher's scorer resolves the CURRENT engine at dispatch time, so
     a registry swap takes effect on the next batch while the batch already
-    in flight finishes on the engine reference it grabbed."""
+    in flight finishes on the engine reference it grabbed.
+
+    ``batcher="continuous"`` swaps the fixed-deadline
+    :class:`MicroBatcher` for the :class:`ContinuousBatcher` (admit rows
+    into the next in-flight bucket as device capacity frees — the async
+    front end's default scheduler). :meth:`health` and
+    :meth:`metrics` never touch the batcher or its locks: a wedged or
+    saturated scoring path must not take the health surface down with it
+    (asserted by a responsiveness test)."""
 
     def __init__(
         self,
@@ -63,15 +88,24 @@ class ScoringService:
         max_delay_ms: float = 5.0,
         queue_depth: int = 256,
         request_timeout_s: float = 30.0,
+        batcher: str = "deadline",
     ):
         self._source = source
         self.request_timeout_s = request_timeout_s
-        self._batcher = MicroBatcher(
+        if batcher not in ("deadline", "continuous"):
+            raise ValueError(
+                f"batcher must be 'deadline' or 'continuous', got {batcher!r}"
+            )
+        batcher_cls = (
+            ContinuousBatcher if batcher == "continuous" else MicroBatcher
+        )
+        self._batcher = batcher_cls(
             self._score,
             max_batch=max_batch,
             max_delay_ms=max_delay_ms,
             queue_depth=queue_depth,
         )
+        self._updater = None
 
     def _score(self, rows):
         engine = _engine_of(self._source)
@@ -79,16 +113,53 @@ class ScoringService:
 
     def start(self) -> "ScoringService":
         self._batcher.start()
+        if self._updater is not None:
+            self._updater.start()
         return self
 
     def stop(self) -> None:
         self._batcher.stop()
+        if self._updater is not None:
+            self._updater.stop()
 
-    def score_request(self, payload: Mapping) -> dict:
+    # -- nearline ------------------------------------------------------------
+
+    def attach_nearline(self, updater) -> "ScoringService":
+        """Attach a :class:`~photon_ml_tpu.serving.nearline
+        .NearlineUpdater`; both front ends then accept ``POST
+        /v1/update`` events, and the updater's lifecycle follows the
+        service's."""
+        self._updater = updater
+        return self
+
+    def update_request(self, payload: Mapping) -> dict:
+        """Handle one ``/v1/update`` body: ``{"events": [...]}`` (see
+        serving/nearline.py for the event schema)."""
+        if self._updater is None:
+            raise BadRequest(
+                "nearline updates are not enabled on this server"
+            )
+        events = (
+            payload.get("events") if isinstance(payload, Mapping) else None
+        )
+        if not isinstance(events, list):
+            raise BadRequest('request body must be {"events": [...]}')
+        accepted = self._updater.submit(events)
+        return {"accepted": accepted}
+
+    # -- scoring -------------------------------------------------------------
+
+    def submit_rows(self, payload: Mapping):
+        """Validate one ``/v1/score`` body and enqueue it; the batcher
+        Future (resolves to ``{"scores", "model_version"}``). Shared by
+        the blocking (:meth:`score_request`) and asyncio front ends."""
         rows = payload.get("rows") if isinstance(payload, Mapping) else None
         if not isinstance(rows, list):
             raise BadRequest('request body must be {"rows": [...]}')
-        future = self._batcher.submit(rows)
+        return self._batcher.submit(rows)
+
+    def score_request(self, payload: Mapping) -> dict:
+        future = self.submit_rows(payload)
         try:
             result = future.result(timeout=self.request_timeout_s)
         except FutureTimeout:
@@ -96,13 +167,12 @@ class ScoringService:
             # the unit instead of scoring dead work under overload
             future.cancel()
             raise
-        return {
-            # host-side already: the batcher future resolves to a numpy
-            # slice the engine fetched through sync_fetch — float() here
-            # is JSON shaping of host scalars, not a device crossing
-            "scores": [round(float(s), 8) for s in result["scores"]],  # photon: noqa[L013]
-            "model_version": result["model_version"],
-        }
+        return _json_scores(result)
+
+    def metrics(self) -> dict:
+        """The ``/metricsz`` body — reads telemetry registries only,
+        never the batcher (stays responsive mid-warmup / mid-swap)."""
+        return _metrics_payload()
 
     def health(self) -> dict:
         try:
@@ -117,6 +187,11 @@ class ScoringService:
             "buckets": list(engine.bucket_sizes),
             "task": engine.task,
         }
+        if getattr(engine, "entity_axis", None) is not None:
+            # entity-sharded deployment: which axis the RE tables span
+            state["entity_axis"] = engine.entity_axis
+        if getattr(engine, "nearline_seq", 0):
+            state["nearline_seq"] = engine.nearline_seq
         if engine.warm:
             # per-batch-bucket compile time + cost from the executable
             # registry (telemetry.xla) — which bucket executables exist,
@@ -151,7 +226,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         service: ScoringService = self.server.service  # type: ignore[attr-defined]
-        if self.path != "/v1/score":
+        if self.path not in ("/v1/score", "/v1/update"):
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
         try:
@@ -162,7 +237,10 @@ class _Handler(BaseHTTPRequestHandler):
                               "detail": "body is not valid JSON"})
             return
         try:
-            self._reply(200, service.score_request(payload))
+            if self.path == "/v1/update":
+                self._reply(200, service.update_request(payload))
+            else:
+                self._reply(200, service.score_request(payload))
         except Overloaded as e:
             self._reply(503, {"error": "overloaded", "detail": str(e)})
         except BadRequest as e:

@@ -715,6 +715,7 @@ class RunReport:
             "sweep": self.sweep_summary(),
             "device_utilization": self.device_utilization(),
             "ingestion": self.ingestion_summary(),
+            "serving": self.serving_summary(),
             "recovery": self.recovery_summary(),
             "counters": counters,
             "gauges": self.snapshot.get("gauges", {}),
@@ -780,6 +781,7 @@ class RunReport:
         lines += self._device_utilization_markdown()
         lines += self._accounting_markdown()
         lines += self._ingestion_markdown()
+        lines += self._serving_markdown()
         lines += self._recovery_markdown()
         lines += self._memory_markdown()
         lines += self._coordinates_markdown()
@@ -1009,6 +1011,84 @@ class RunReport:
                 f"- {retries} transient read failure(s) absorbed by the "
                 "per-chunk retry (`ingest.read_retries`) — the storage "
                 "layer flaked but the stream survived"
+            )
+        out.append("")
+        return out
+
+    def serving_summary(self) -> Optional[dict[str, Any]]:
+        """Online-serving accounting, or None when no requests were
+        served. The headline is request latency (p50/p99 of
+        ``serving.total_ms``) plus the SLO disturbance story: how many
+        hot swaps happened, how many nearline per-entity applies landed
+        and how fast (``serving.nearline.update_lag_ms`` — the
+        event-enqueue -> applied-on-tables window), and how much traffic
+        admission control shed."""
+        c = self.snapshot.get("counters", {})
+        h = self.snapshot.get("histograms", {})
+        if not c.get("serving.requests"):
+            return None
+        total = h.get("serving.total_ms") or {}
+        batch = h.get("serving.batch_size") or {}
+        lag = h.get("serving.nearline.update_lag_ms") or {}
+        out: dict[str, Any] = {
+            "requests": int(c.get("serving.requests", 0)),
+            "scored_rows": int(c.get("serving.scored_rows", 0)),
+            "shed": int(c.get("serving.shed", 0)),
+            "p50_ms": total.get("p50"),
+            "p99_ms": total.get("p99"),
+            "mean_batch_rows": batch.get("mean"),
+            "model_swaps": int(c.get("serving.model_swaps", 0)),
+            "nearline_applies": int(c.get("serving.nearline.applies", 0)),
+            "nearline_applied_rows": int(
+                c.get("serving.nearline.applied_rows", 0)
+            ),
+            "nearline_lag_p99_ms": lag.get("p99"),
+            "unseen_entities": int(c.get("serving.unseen_entities", 0)),
+        }
+        return out
+
+    def _serving_markdown(self) -> list[str]:
+        srv = self.serving_summary()
+        if srv is None:
+            return []
+        out = ["## Serving", ""]
+        line = f"- {srv['requests']} request(s), {srv['scored_rows']} rows"
+        if srv.get("p99_ms") is not None:
+            line += (
+                f" — p50 {srv['p50_ms']:.1f} ms / p99 {srv['p99_ms']:.1f} ms"
+            )
+        if srv.get("mean_batch_rows"):
+            line += f" ({srv['mean_batch_rows']:.1f} rows/device batch)"
+        out.append(line)
+        shed = srv.get("shed", 0)
+        if shed:
+            out.append(
+                f"- **{shed} request(s) shed** by admission control "
+                "(returned 503 — the queue-depth budget, not failures)"
+            )
+        swaps = srv.get("model_swaps", 0)
+        applies = srv.get("nearline_applies", 0)
+        if swaps or applies:
+            line = f"- {swaps} registry hot-swap(s)"
+            if applies:
+                line += (
+                    f", {applies} nearline apply(ies) covering "
+                    f"{srv['nearline_applied_rows']} entity row(s)"
+                )
+                if srv.get("nearline_lag_p99_ms") is not None:
+                    line += (
+                        f" — p99 event->applied "
+                        f"{srv['nearline_lag_p99_ms']:.1f} ms"
+                    )
+            line += (
+                " — p99 across each disturbance is the SLO bench's "
+                "flatness gate (`serving_slo_p99_swap_ratio`)"
+            )
+            out.append(line)
+        unseen = srv.get("unseen_entities", 0)
+        if unseen:
+            out.append(
+                f"- {unseen} unseen-entity row(s) served fixed-effect-only"
             )
         out.append("")
         return out

@@ -51,8 +51,11 @@ EXPECTED_POINTS = {
     "ingest.upload.chunk",
     # serving
     "serving.dispatch",
+    "serving.async_dispatch",
     "serving.registry.poll",
     "serving.registry.load",
+    "serving.nearline_event",
+    "serving.nearline_apply",
     # distributed fleet seams (the distributed crash matrix set)
     "multihost.init",
     "fleet.heartbeat",
@@ -86,6 +89,7 @@ def test_registry_catalog_is_complete_and_stable():
     import photon_ml_tpu.ingest.decode  # noqa: F401
     import photon_ml_tpu.ingest.pipeline  # noqa: F401
     import photon_ml_tpu.serving.batcher  # noqa: F401
+    import photon_ml_tpu.serving.nearline  # noqa: F401
     import photon_ml_tpu.serving.registry  # noqa: F401
     import photon_ml_tpu.parallel.distributed  # noqa: F401
     import photon_ml_tpu.parallel.multihost  # noqa: F401

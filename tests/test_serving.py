@@ -661,6 +661,23 @@ def test_serving_e2e_http_hot_swap(tmp_path, game_world):
         registry.stop()
 
 
+def test_cli_serve_stdio_rejects_ignored_flags(tmp_path, game_world):
+    """--stdio is a bare engine loop: combining it with flags that only
+    affect the HTTP stack (--nearline, --frontend asyncio, --batcher)
+    must fail loudly instead of silently ignoring them."""
+    from photon_ml_tpu.cli import serve as serve_cli
+
+    data, truth = game_world
+    model = _make_model(truth)
+    registry_dir = str(tmp_path / "registry")
+    publish_version(registry_dir, model, _INDEX_MAPS)
+    with pytest.raises(SystemExit, match="--nearline, --frontend"):
+        serve_cli.main([
+            "--registry-dir", registry_dir, "--stdio", "--max-batch", "8",
+            "--nearline", "userId", "--frontend", "asyncio",
+        ])
+
+
 def test_cli_serve_stdio_subprocess(tmp_path, game_world):
     """`cli serve --registry-dir ... --stdio` drives the full stack (load,
     warmup, request schema) from a clean process without sockets."""

@@ -42,7 +42,12 @@ SYNC_SEEDS = (
     "photon_ml_tpu.serving.engine.ScoringEngine.warmup",
     "photon_ml_tpu.serving.batcher.MicroBatcher.submit",
     "photon_ml_tpu.serving.batcher.MicroBatcher._loop",
+    "photon_ml_tpu.serving.batcher.ContinuousBatcher._collect",
     "photon_ml_tpu.serving.server.ScoringService.score_request",
+    "photon_ml_tpu.serving.server.ScoringService.submit_rows",
+    # the event-loop request path: a sync here stalls EVERY connection
+    "photon_ml_tpu.serving.aio.AsyncScoringServer._route",
+    "photon_ml_tpu.serving.aio.AsyncScoringServer._score",
 )
 
 #: The sanctioned device->host crossing: its body is the accounted fetch.

@@ -47,6 +47,10 @@ L008_BLESSED = {
 L010_HOT_PATH = {
     os.path.join("photon_ml_tpu", "serving", "engine.py"),
     os.path.join("photon_ml_tpu", "serving", "batcher.py"),
+    # the asyncio front end: one blocked event loop stalls EVERY
+    # connection, so a stray sync here is worse than in the threading
+    # server
+    os.path.join("photon_ml_tpu", "serving", "aio.py"),
 }
 
 # Hot-path library modules where every jit-compiled program must go
@@ -71,6 +75,10 @@ L011_HOT_DIRS = (
 )
 L011_HOT_FILES = {
     os.path.join("photon_ml_tpu", "serving", "engine.py"),
+    # the nearline updater re-solves entity rows on a live-serving
+    # cadence: a bare jax.jit there would hide exactly the executables
+    # whose recompiles the SLO bench gates p99 flatness over
+    os.path.join("photon_ml_tpu", "serving", "nearline.py"),
     os.path.join("photon_ml_tpu", "training.py"),
 }
 L011_COLD_ALLOWLIST = {
