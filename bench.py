@@ -370,9 +370,12 @@ def write_run_report():
     trace_out = os.environ.get("PHOTON_TRACE_OUT")
     if not trace_out:
         return
+    from photon_ml_tpu import telemetry
     from photon_ml_tpu.telemetry.report import RunReport, report_path
 
-    md_path = report_path(trace_out)
+    # same per-member suffixing the trace sink applied: in a fleet each
+    # process owns its report instead of last-writer-winning one file
+    md_path = report_path(telemetry.member_artifact_path(trace_out))
     if os.path.exists(md_path):
         print(f"run report (from sub-benchmark): {md_path}", file=sys.stderr)
         return

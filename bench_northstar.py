@@ -305,7 +305,8 @@ def _run(workdir):
         from photon_ml_tpu.telemetry.report import RunReport, report_path
 
         report = RunReport.from_live()
-        md_path = report_path(trace_out)
+        # per-member suffixing in a fleet (matches the trace sink's path)
+        md_path = report_path(telemetry.member_artifact_path(trace_out))
         with open(md_path, "w", encoding="utf-8") as fh:
             fh.write(report.to_markdown())
         report.save_json(md_path[: -len(".md")] + ".json")

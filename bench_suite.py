@@ -53,6 +53,10 @@ LOWER_IS_BETTER_METRICS = frozenset({
     "serving_slo_p99_swap_ratio",
     "serving_slo_p99_nearline_ratio",
     "serving_nearline_apply_ms",
+    # fleet observability (bench_multichip): time lost waiting at
+    # collectives and per-member MFU imbalance both regress upward
+    "fleet_collective_wait_fraction",
+    "fleet_mfu_spread",
 })
 
 

@@ -12,11 +12,20 @@ one markdown report (stdout, or ``--out``): the phase-time tree, top-k
 costs, fetch/recompile accounting, HBM peaks, per-coordinate convergence
 and guard history, and heartbeat liveness.
 
+``--fleet <dir>`` switches to the FLEET aggregation instead: the
+directory's per-member artifact streams (``trace.proc-<i>.jsonl`` /
+``telemetry.proc-<i>.jsonl`` — the identity suffixing contract) merge
+into one report with per-member rows, collective-wait attribution, the
+straggler callout, and lost-member degradation
+(telemetry.fleet_report.FleetReport).
+
 ``--compare`` takes a baseline report JSON (written by ``--json`` on an
 earlier run, or a bare ``{metric: value}`` dict) and appends a comparison
 table; with ``--fail-on-regress`` the process exits ``3`` when any key
 metric moved against its goodness direction by more than ``--threshold``
-(default 20%) — the CI perf gate.
+(default 20%) — the CI perf gate. With ``--fleet`` the comparison runs
+over the AGGREGATED fleet key metrics (``fleet_rows_per_sec``,
+``fleet_collective_wait_fraction``, ``fleet_mfu_spread``, ...).
 
 Exit codes: 0 ok, 1 unreadable inputs, 2 usage, 3 regression detected.
 """
@@ -25,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Optional
 
@@ -49,6 +59,13 @@ def main(argv: Optional[list] = None) -> int:
         "--checkpoint-dir",
         help="checkpoint directory whose step manifests carry convergence "
         "and guard history",
+    )
+    parser.add_argument(
+        "--fleet",
+        metavar="DIR",
+        help="aggregate a FLEET directory of per-member artifact streams "
+        "(*.proc-<i>.jsonl) into one merged report instead of reading "
+        "single-run --trace/--telemetry artifacts",
     )
     parser.add_argument(
         "--out", help="write the markdown report here (default: stdout)"
@@ -76,23 +93,47 @@ def main(argv: Optional[list] = None) -> int:
         "--threshold (CI perf gate)",
     )
     args = parser.parse_args(argv)
-    if not (args.trace or args.telemetry or args.checkpoint_dir):
+    if args.fleet and (args.trace or args.telemetry or args.checkpoint_dir):
         parser.error(
-            "nothing to report on: give --trace, --telemetry, and/or "
-            "--checkpoint-dir"
+            "--fleet aggregates a member-artifact directory; it cannot "
+            "be combined with --trace/--telemetry/--checkpoint-dir"
+        )
+    if not (
+        args.fleet or args.trace or args.telemetry or args.checkpoint_dir
+    ):
+        parser.error(
+            "nothing to report on: give --fleet, --trace, --telemetry, "
+            "and/or --checkpoint-dir"
         )
 
-    from photon_ml_tpu.telemetry.report import RunReport
+    if args.fleet:
+        from photon_ml_tpu.telemetry.fleet_report import FleetReport
 
-    try:
-        report = RunReport.load(
-            trace=args.trace,
-            telemetry=args.telemetry,
-            checkpoint_dir=args.checkpoint_dir,
-        )
-    except OSError as e:
-        print(f"cannot read telemetry artifacts: {e}", file=sys.stderr)
-        return EXIT_ERROR
+        if not os.path.isdir(args.fleet):
+            print(
+                f"--fleet {args.fleet} is not a directory", file=sys.stderr
+            )
+            return EXIT_ERROR
+        report = FleetReport.load(args.fleet)
+        if not report.members:
+            print(
+                f"no member artifact streams (*.proc-<i>.jsonl) found "
+                f"under {args.fleet}",
+                file=sys.stderr,
+            )
+            return EXIT_ERROR
+    else:
+        from photon_ml_tpu.telemetry.report import RunReport
+
+        try:
+            report = RunReport.load(
+                trace=args.trace,
+                telemetry=args.telemetry,
+                checkpoint_dir=args.checkpoint_dir,
+            )
+        except OSError as e:
+            print(f"cannot read telemetry artifacts: {e}", file=sys.stderr)
+            return EXIT_ERROR
 
     deltas = None
     if args.compare:

@@ -332,6 +332,9 @@ def _maybe_write_report(
     report_out = config.get("report_out")
     if not report_out:
         return
+    # same per-member suffixing as the trace/telemetry sinks: N fleet
+    # members pointed at one --report-out must not last-writer-win
+    report_out = telemetry.member_artifact_path(report_out)
     from photon_ml_tpu.telemetry.report import RunReport
 
     ckpt_dir = (config.get("checkpoint") or {}).get("dir")
@@ -373,10 +376,6 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     faults.warn_if_armed()
     game_config = parse_game_config(config)
     output_dir = output_dir or config.get("output_dir")
-    trace_out = config.get("trace_out")
-    telemetry_out = config.get("telemetry_out")
-    if trace_out:
-        telemetry.configure(trace_out=trace_out)
     checkpoint_spec = _parse_checkpoint_spec(config)
     guard = _parse_guard_spec(config)
     if config.get("sweep"):
@@ -405,6 +404,22 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
         # default signal handling (die immediately) is then the right call
         stop.install()
     mesh = _init_distributed_and_mesh(config)
+
+    # explicit --trace-out/--telemetry-out paths get the SAME per-member
+    # suffixing the PHOTON_*_OUT env path applies (telemetry.identity):
+    # under a fleet each member writes trace.proc-<i>.jsonl instead of
+    # last-writer-winning one file; single-process paths pass through
+    # untouched. Resolved AFTER _init_distributed_and_mesh so the
+    # multi-process-jax identity mode sees the initialized process index
+    # (PHOTON_PROC_ID needs no jax and works either way); no spans are
+    # lost — the first traced phase is the data read below.
+    trace_out = config.get("trace_out")
+    if trace_out:
+        trace_out = telemetry.member_artifact_path(trace_out)
+        telemetry.configure(trace_out=trace_out)
+    telemetry_out = config.get("telemetry_out")
+    if telemetry_out:
+        telemetry_out = telemetry.member_artifact_path(telemetry_out)
 
     with timed("read training data"):
         train_data, index_maps = read_input(config["input"])

@@ -73,6 +73,7 @@ _FP_CHUNK_BOUNDARY = faults.register_point(
 # the fleet seam shared with the GSPMD solve dispatch: the last host-side
 # instruction before a chunk solve's cross-process collective program
 from photon_ml_tpu.parallel.distributed import FP_COLLECTIVE_ENTRY  # noqa: E402
+from photon_ml_tpu.parallel.multihost import collective_wait  # noqa: E402
 
 
 @lru_cache(maxsize=16)
@@ -465,7 +466,10 @@ class StreamingRandomEffectTrainer:
                         obj, self._guard.damping_for(attempt)
                     )
                 faults.fault_point(FP_COLLECTIVE_ENTRY)
-                res, var = self._solver(obj, batch, w0, self._l1, cons)
+                # per-member collective-wait attribution (no-op single
+                # process): the window the fleet report sums per member
+                with collective_wait("streaming_chunk_solve"):
+                    res, var = self._solver(obj, batch, w0, self._l1, cons)
                 # injection seam: a `nan` rule here poisons the solve
                 # result, driving the guard's retry/rollback path on demand
                 w = faults.corrupt_array(_FP_SOLVE_RESULT, res.w)

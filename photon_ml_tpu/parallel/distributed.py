@@ -166,9 +166,15 @@ def _solve_common(
         count=max(int(config.max_iterations), 1),
     )
     faults.fault_point(FP_COLLECTIVE_ENTRY)
-    return solver(
-        obj, batch, w0, l1, constraints, init_value, init_grad_norm
-    )
+    # collective-wait attribution (multi-process only): how long THIS
+    # member spent dispatching into the cross-process program — the
+    # per-member signal the fleet report ranks stragglers by
+    from photon_ml_tpu.parallel.multihost import collective_wait
+
+    with collective_wait(label):
+        return solver(
+            obj, batch, w0, l1, constraints, init_value, init_grad_norm
+        )
 
 
 def gspmd_solve(

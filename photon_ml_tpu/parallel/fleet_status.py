@@ -1,0 +1,289 @@
+"""Live fleet status: the JSON snapshot an operator polls during a pod run.
+
+The supervisor (tools/fleet.py) already KNOWS the fleet's state — exit
+codes, heartbeat-file mtimes, deaths, relaunch generation — but until now
+that state lived in one Python loop's locals and was only readable post
+mortem. :class:`FleetStatusWriter` publishes it on a cadence:
+
+- ``--status-file``: one atomic JSON snapshot (write-tmp-then-rename via
+  ``utils.atomic`` — a poller must never read a torn file, per the L008
+  discipline), refreshed every ``interval_s``;
+- ``--status-port``: the same snapshot served over HTTP
+  (``GET /statusz``), computed fresh per request;
+- member liveness comes from the heartbeat-file mtimes
+  (``proc-<i>.alive`` — the ``multihost.HeartbeatWriter`` protocol), and
+  each member's last progress fields from the tail of its telemetry
+  stream (``telemetry.progress.tail_heartbeat_fields``, which REQUIRES
+  the ``proc`` attribution field so a mis-pointed file reads as silence,
+  not as another member's progress).
+
+Failure semantics: a status write is OBSERVABILITY, never control — an
+unwritable status file (disk full, torn-down workdir, or the
+``fleet.status_write`` fault seam's ``io`` rule) logs, counts
+``fleet.status_write_errors``, and the supervisor keeps supervising.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import threading
+from typing import Any, Optional
+
+from photon_ml_tpu import faults
+
+logger = logging.getLogger("photon_ml_tpu.parallel.fleet_status")
+
+__all__ = ["FleetStatusWriter", "DEFAULT_STATUS_INTERVAL_S"]
+
+DEFAULT_STATUS_INTERVAL_S = 1.0
+
+# Observability seam: one status-snapshot write by the supervisor's
+# status thread. An `io` rule here is the disk-full/torn-workdir shape
+# the writer must absorb (status is never control); `raise` is surfaced
+# to the caller of write_once for the unit seam test. NOT write_path
+# (the single-process crash matrix arms a training worker, which never
+# runs a supervisor) and NOT distributed (the distributed matrix arms a
+# fleet MEMBER; this seam fires in the supervisor process).
+_FP_STATUS_WRITE = faults.register_point(
+    "fleet.status_write",
+    description="one supervisor status-snapshot write (file and/or the "
+    "HTTP cache refresh)",
+)
+
+
+class FleetStatusWriter:
+    """Publish the supervisor's fleet view on a cadence (daemon thread).
+
+    ``update(...)`` is the supervisor's push side (generation, exit
+    codes, deaths, relaunches); liveness and per-member heartbeat fields
+    are pulled from the shared filesystem at snapshot time, so the
+    status stays truthful even while the supervisor loop is blocked in a
+    wait. Use as a context manager or ``start()``/``stop()``.
+    """
+
+    def __init__(
+        self,
+        fleet_dir: str,
+        num_processes: int,
+        heartbeat_deadline_s: float,
+        status_file: Optional[str] = None,
+        port: Optional[int] = None,
+        telemetry_out: Optional[str] = None,
+        interval_s: float = DEFAULT_STATUS_INTERVAL_S,
+    ):
+        if interval_s <= 0:
+            raise ValueError("status interval_s must be > 0")
+        self.fleet_dir = fleet_dir
+        self.status_file = status_file
+        self.telemetry_out = telemetry_out
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._server = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._requested_port = port
+        self.port: Optional[int] = None
+        # supervisor-pushed state: written by the supervisor loop via
+        # update() (public API) and read by the status thread AND the
+        # HTTP handler threads — every access sits under the lock (L015)
+        self._lock = threading.Lock()
+        self._state: dict[str, Any] = {
+            "generation": 0,
+            "num_processes": int(num_processes),
+            "heartbeat_deadline_s": float(heartbeat_deadline_s),
+            "deaths": [],
+            # cumulative across relaunches: per-generation `deaths` is
+            # reset when a survivor fleet launches, but the run's loss
+            # record must survive in the snapshot (an operator reading
+            # the final status of a recovered run needs to see the loss)
+            "death_history": [],
+            "relaunches": 0,
+            "rcs": {},
+            "outcome": None,
+            "telemetry_out": telemetry_out,
+        }
+
+    # -- supervisor push side ------------------------------------------------
+
+    def update(self, **fields: Any) -> None:
+        """Merge supervisor-side facts (generation, rcs, deaths,
+        relaunches, outcome, num_processes) into the next snapshot."""
+        with self._lock:
+            self._state.update(fields)
+
+    # -- snapshot ------------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """One JSON-safe status document, computed from the pushed state
+        plus the live filesystem (heartbeat mtimes, telemetry tails)."""
+        import time
+
+        from photon_ml_tpu.parallel import multihost
+        from photon_ml_tpu.telemetry import identity
+        from photon_ml_tpu.telemetry.progress import tail_heartbeat_fields
+
+        with self._lock:
+            state = dict(self._state)
+        # already a float (ctor/update coerce); float() here would read
+        # as a device sync to the L013 walk this function is seeded into
+        deadline_s = state["heartbeat_deadline_s"]
+        # wall clock by necessity: liveness is measured against heartbeat
+        # file MTIMES (same contract as multihost.dead_peers)
+        now = time.time()  # photon: noqa[L006]
+        members: dict[str, Any] = {}
+        for pid in range(int(state["num_processes"])):
+            entry: dict[str, Any] = {
+                "rc": state["rcs"].get(pid, state["rcs"].get(str(pid))),
+                "lost": pid in (state.get("deaths") or []),
+            }
+            try:
+                mtime = os.path.getmtime(
+                    multihost.heartbeat_path(self.fleet_dir, pid)
+                )
+            except OSError:
+                entry["alive"] = False
+                entry["heartbeat_age_s"] = None
+            else:
+                age = max(now - mtime, 0.0)
+                entry["heartbeat_age_s"] = round(age, 3)
+                entry["alive"] = age <= deadline_s and entry["rc"] is None
+            telemetry_out = state.get("telemetry_out")
+            if telemetry_out is not None:
+                fields = tail_heartbeat_fields(
+                    identity.member_artifact_path(telemetry_out, pid),
+                    expect_proc=pid,
+                )
+                if fields is not None:
+                    entry["last_heartbeat"] = fields
+            members[str(pid)] = entry
+        doc: dict[str, Any] = {
+            "type": "fleet_status",
+            "wall_time": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(),
+            "generation": state["generation"],
+            "num_processes": state["num_processes"],
+            "deaths": state.get("deaths") or [],
+            "death_history": state.get("death_history") or [],
+            "deaths_total": len(state.get("death_history") or []),
+            "relaunches": state.get("relaunches", 0),
+            "outcome": state.get("outcome"),
+            "alive_members": sorted(
+                int(p) for p, e in members.items() if e.get("alive")
+            ),
+            "members": members,
+        }
+        return doc
+
+    def write_once(self) -> Optional[dict[str, Any]]:
+        """One snapshot -> status file (atomic). Returns the snapshot, or
+        None when the write failed (logged + counted, never fatal)."""
+        from photon_ml_tpu import telemetry
+
+        snap = self.snapshot()
+        if self.status_file is None:
+            return snap
+        from photon_ml_tpu.utils.atomic import atomic_write_json
+
+        try:
+            faults.fault_point(_FP_STATUS_WRITE)
+            atomic_write_json(
+                self.status_file, snap, indent=2, sort_keys=True,
+                default=str,
+            )
+        except OSError as e:
+            # InjectedIOError lands here too: status is observability,
+            # not control — the supervisor must keep supervising
+            telemetry.counter("fleet.status_write_errors").inc()
+            logger.warning("fleet status write failed: %s", e)
+            return None
+        telemetry.counter("fleet.status_writes").inc()
+        return snap
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetStatusWriter":
+        if self._thread is not None:
+            return self  # idempotent
+        if self._requested_port is not None:
+            self._start_server(self._requested_port)
+        if self.status_file is None:
+            # HTTP-only mode: every request computes its own fresh
+            # snapshot in the handler — a cadence thread would stat and
+            # tail every member's files each interval just to discard it
+            return self
+        self.write_once()  # first snapshot immediately, then the cadence
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-status", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.write_once()
+            except Exception:  # noqa: BLE001 — never kill supervision
+                logger.debug("fleet status probe failed", exc_info=True)
+
+    def _start_server(self, port: int) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        writer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path not in ("/", "/statusz"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = json.dumps(
+                        writer.snapshot(), indent=2, sort_keys=True,
+                        default=str,
+                    ).encode("utf-8")
+                except Exception as e:  # noqa: BLE001
+                    self.send_error(500, str(e)[:200])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet: operators poll this
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="fleet-status-http",
+            daemon=True,
+        )
+        self._server_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.shutdown()
+                self._server.server_close()
+            except OSError:
+                pass
+            self._server = None
+        if self._server_thread is not None:
+            self._server_thread.join(timeout=5.0)
+            self._server_thread = None
+        if self._thread is not None:
+            self._thread.join(timeout=max(5.0, self.interval_s * 4))
+            self._thread = None
+        self.write_once()  # final state (outcome/rcs) lands on disk
+
+    def __enter__(self) -> "FleetStatusWriter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -40,6 +40,7 @@ import logging
 import os
 import threading
 import time
+from contextlib import contextmanager
 from typing import Optional, Sequence
 
 import jax
@@ -397,6 +398,44 @@ def dead_peers(
     return dead
 
 
+@contextmanager
+def collective_wait(label: str):
+    """Time this process's blocking entry into a cross-process collective
+    and record it as collective-WAIT telemetry: a ``collective_wait`` span
+    (attrs ``label``/``wait_s``) plus the ``comms.wait_s`` histogram and
+    ``comms.wait_calls``/``comms.wait_seconds_total`` counters.
+
+    The point is fleet attribution, not bandwidth: at a barrier the LAST
+    member to arrive waits ~zero while everyone else's clock runs — so
+    the member whose total wait is near zero is the straggler the rest of
+    the fleet stood around for (telemetry.fleet_report names it from
+    exactly these counters). Single-process, the context is a no-op:
+    there is nobody to wait for, and recording zeros would pollute the
+    comms accounting.
+
+    Honesty limits (README "Fleet observability"): the window covers the
+    host-side dispatch of the collective program; where jax dispatches
+    asynchronously the enqueue returns early and the residue lands on the
+    next blocking fetch. The per-boundary ``fleet_any`` stop collective —
+    which ends in a host fetch — is always a true barrier measurement.
+    """
+    if jax.process_count() == 1:
+        yield
+        return
+    from photon_ml_tpu import telemetry
+
+    t0 = time.monotonic()
+    with telemetry.span("collective_wait", label=label) as s:
+        try:
+            yield
+        finally:
+            wait = time.monotonic() - t0
+            s.set_attr(wait_s=round(wait, 6))
+            telemetry.histogram("comms.wait_s").observe(wait)
+            telemetry.counter("comms.wait_calls").inc()
+            telemetry.counter("comms.wait_seconds_total").inc(wait)
+
+
 def fleet_any(flag: bool, mesh: Optional[Mesh] = None,
               axis: Optional[str] = None) -> bool:
     """Fleet-consistent OR of a per-process bool — the agreement that
@@ -421,8 +460,14 @@ def fleet_any(flag: bool, mesh: Optional[Mesh] = None,
     lo, hi = process_slice(n, mesh, resolved)
     local = np.full((hi - lo,), 1.0 if flag else 0.0, np.float32)
     arr = host_local_array(local, mesh, P(resolved), global_shape=(n,))
-    reduced = _fleet_any_program(mesh)(arr)
-    return bool(float(np.asarray(reduced.addressable_data(0))) > 0.0)
+    # the stop collective is the fleet's per-boundary barrier: the fetch
+    # below blocks until EVERY member has contributed its flag, so the
+    # elapsed time is this member's true wait on its slowest peer — the
+    # straggler-attribution signal the fleet report aggregates
+    with collective_wait("fleet_any"):
+        reduced = _fleet_any_program(mesh)(arr)
+        value = float(np.asarray(reduced.addressable_data(0)))
+    return bool(value > 0.0)
 
 
 _FLEET_ANY_CACHE: dict = {}

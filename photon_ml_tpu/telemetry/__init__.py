@@ -25,6 +25,12 @@ Five layers (ISSUE 1 gave emission; ISSUE 3 the interpretation):
   recompile attribution per executable; roofline peaks for MFU and
   bandwidth utilization; ``comms.*`` collective-bytes estimates (the
   run report's "Device utilization" section).
+- :mod:`photon_ml_tpu.telemetry.identity` / ``.fleet_report`` — fleet
+  observability (ISSUE 13): per-member artifact suffixing
+  (``trace.proc-0.jsonl``), process identity + epoch anchors in every
+  stream, and :class:`FleetReport`, which merges a fleet directory of
+  member streams into one report with collective-wait/straggler
+  attribution (``cli report --fleet``).
 
 Typical use::
 
@@ -48,7 +54,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from photon_ml_tpu.telemetry import memory, metrics, trace, xla  # noqa: F401
+from photon_ml_tpu.telemetry import identity, memory, metrics, trace, xla  # noqa: F401
+from photon_ml_tpu.telemetry.identity import member_artifact_path  # noqa: F401
 from photon_ml_tpu.telemetry.device import (  # noqa: F401
     install_compile_hooks,
     sync_fetch,
@@ -95,6 +102,8 @@ __all__ = [
     "perfetto_path",
     "Heartbeat",
     "memory",
+    "identity",
+    "member_artifact_path",
     "xla",
     "instrumented_jit",
     "record_collective",
@@ -122,15 +131,23 @@ def configure_from_env() -> None:
     """Honor ``PHOTON_TRACE_OUT`` / ``PHOTON_TELEMETRY_OUT`` env vars: the
     span sink opens immediately; the metrics snapshot flushes at process
     exit. Lets benchmarks and ad-hoc scripts opt in without new flags.
-    ``reset()`` fully undoes both (including the atexit hook)."""
+    ``reset()`` fully undoes both (including the atexit hook).
+
+    In a fleet (``PHOTON_PROC_ID`` set by the supervisor, or an
+    already-initialized multi-process jax) both paths are suffixed per
+    member (``trace.jsonl`` -> ``trace.proc-0.jsonl``) so N processes
+    pointed at the same env value write N artifact streams instead of
+    clobbering one file — the naming contract ``cli report --fleet``
+    globs (telemetry.identity / telemetry.fleet_report)."""
     trace_out = os.environ.get("PHOTON_TRACE_OUT")
     if trace_out:
-        configure(trace_out=trace_out)
+        configure(trace_out=identity.member_artifact_path(trace_out))
     metrics_out = os.environ.get("PHOTON_TELEMETRY_OUT")
     if metrics_out:
         import atexit
         import functools
 
+        metrics_out = identity.member_artifact_path(metrics_out)
         old = _env_state["atexit_flush"]
         if old is not None:
             atexit.unregister(old)

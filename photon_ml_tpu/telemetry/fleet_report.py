@@ -1,0 +1,623 @@
+"""Fleet reports: merge per-member telemetry into one answer.
+
+A multi-process fit writes ONE artifact stream per member
+(``trace.proc-0.jsonl``, ``telemetry.proc-1.jsonl``, … — the
+``telemetry.identity`` suffixing contract), and nothing used to merge
+them: reading a 2-process run meant two disjoint RunReports and no way to
+say who stalled whom. :class:`FleetReport` is the aggregation layer — the
+TPU-fleet analog of the Spark UI's per-executor task timelines:
+
+- **discovery**: glob a fleet directory for ``*.proc-<i>.jsonl`` streams,
+  classify each by its first record (``trace_header``/``span`` vs
+  ``metrics``/``heartbeat``), and build one :class:`RunReport` per member
+  — every derived view (MFU, comms fraction, phase trees) is reused, not
+  reimplemented;
+- **alignment**: each trace header records a monotonic<->epoch anchor
+  pair (``anchor_unix_s``/``monotonic_anchor``), so member-local span
+  times map onto one absolute timeline; residual clock skew is estimated
+  from the coordinated-checkpoint rendezvous (the ``checkpoint:save``
+  spans with ``coordinated=True`` end at the same barrier on every
+  member, so per-member deltas of those endpoints ARE the skew);
+- **attribution**: per-member rows (rows/s, MFU, comms fraction,
+  collective wait share, chunk progress, heartbeat gaps) plus the
+  straggler callout — at a barrier the member who arrives LAST waits
+  ~zero while everyone else's wait clock runs, so the member with the
+  minimum total ``comms.wait_seconds_total`` is the one the fleet stood
+  around for;
+- **degradation**: a member whose artifacts are missing or truncated
+  mid-line (the hard-killed-member case the distributed crash matrix
+  produces) renders as a partial row marked ``lost`` — never a crash,
+  never silently complete.
+
+Surfaced as ``python -m photon_ml_tpu.cli report --fleet <dir>``;
+``compare``/``--fail-on-regress`` gate the aggregated
+:meth:`FleetReport.key_metrics` through the same ``compare_metrics``
+machinery single-run reports use. Like RunReport, this module only READS
+artifacts — it never touches a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import glob as _glob
+import json
+import os
+import re
+import statistics
+from typing import Any, Mapping, Optional, Sequence
+
+from photon_ml_tpu.telemetry.report import (
+    KEY_METRIC_DIRECTIONS,
+    MetricDelta,
+    RunReport,
+    _compare_markdown,
+    _fmt,
+    _fmt_or_unknown,
+    _fmt_pct,
+    compare_metrics,
+)
+
+__all__ = [
+    "FleetMember",
+    "FleetReport",
+    "FLEET_KEY_METRIC_DIRECTIONS",
+    "FLEET_REPORT_FORMAT_VERSION",
+    "discover_member_streams",
+]
+
+FLEET_REPORT_FORMAT_VERSION = 1
+
+_PROC_RE = re.compile(r"\.proc-(\d+)\.jsonl$")
+_GEN_RE = re.compile(r"^gen(\d+)$")
+
+#: Aggregated fleet metrics and their goodness direction (the
+#: ``cli report --fleet --compare`` gate set). Single-run directions are
+#: inherited so a fleet baseline may also carry plain key metrics.
+FLEET_KEY_METRIC_DIRECTIONS: dict[str, int] = {
+    **KEY_METRIC_DIRECTIONS,
+    "fleet_rows_per_sec": +1,
+    "fleet_coeffs_per_sec": +1,
+    "fleet_collective_wait_fraction": -1,
+    "fleet_collective_wait_s": -1,
+    "fleet_mfu_spread": -1,
+    "fleet_lost_members": -1,
+    "fleet_heartbeat_gap_max_s": -1,
+    "fleet_clock_skew_max_s": -1,
+}
+
+#: Below this many seconds of fleet-wide wait spread the straggler callout
+#: stays silent — naming a "straggler" over scheduler jitter is noise.
+_STRAGGLER_MIN_SPREAD_S = 0.005
+
+
+def discover_member_streams(fleet_dir: str) -> dict[int, dict]:
+    """Map ``process_index -> {"trace": path, "telemetry": path,
+    "header": dict}`` for the per-member artifact streams under
+    ``fleet_dir`` (``header`` is the trace's leading ``trace_header``
+    record, captured during classification; absent on headerless
+    streams).
+
+    The naming contract is the ``identity.member_artifact_path`` suffix:
+    any ``*.proc-<i>.jsonl`` file belongs to member ``i``. Classification
+    reads the file's FIRST parseable record — ``trace_header``/``span``
+    means a trace stream, ``metrics``/``heartbeat`` a telemetry stream —
+    so renamed prefixes still sort correctly. When the directory itself
+    holds no member streams, the tools/fleet.py workdir layout is tried:
+    a ``telemetry/`` subdirectory, then the NEWEST ``gen<g>`` generation
+    directory under either (one directory = one generation's fleet;
+    relaunch generations renumber members) — so ``--fleet <workdir>``
+    works on a supervisor directory directly and reads the final
+    generation's run.
+    """
+    candidates = [fleet_dir, os.path.join(fleet_dir, "telemetry")]
+    for base in list(candidates):
+        gens = sorted(
+            (
+                d
+                for d in _glob.glob(os.path.join(base, "gen*"))
+                if os.path.isdir(d) and _GEN_RE.match(os.path.basename(d))
+            ),
+            key=lambda d: int(os.path.basename(d)[3:]),
+        )
+        if gens:
+            candidates.append(gens[-1])
+    out: dict[int, dict] = {}
+    for directory in candidates:
+        for path in sorted(_glob.glob(os.path.join(directory, "*.jsonl"))):
+            m = _PROC_RE.search(os.path.basename(path))
+            if not m:
+                continue
+            proc = int(m.group(1))
+            kind, first = _classify_stream(path)
+            if kind is None:
+                continue
+            entry = out.setdefault(proc, {})
+            entry.setdefault(kind, path)
+            if (
+                kind == "trace"
+                and entry["trace"] == path
+                and first.get("type") == "trace_header"
+            ):
+                # the header was just parsed for classification — carry
+                # it so load() need not re-open the file for it
+                entry["header"] = first
+        if out:
+            break
+    return out
+
+
+def _classify_stream(path: str) -> tuple[Optional[str], dict]:
+    """``("trace"|"telemetry"|None, first_record)`` from the first
+    parseable record (the record doubles as the trace header when it is
+    one — a truncated or headerless stream classifies by whatever leads
+    it)."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                kind = rec.get("type")
+                if kind in ("trace_header", "span"):
+                    return "trace", rec
+                if kind in ("metrics", "heartbeat"):
+                    return "telemetry", rec
+    except OSError:
+        return None, {}
+    return None, {}
+
+
+@dataclasses.dataclass
+class FleetMember:
+    """One fleet member's artifacts + the per-member derived row."""
+
+    process_index: int
+    trace_path: Optional[str] = None
+    telemetry_path: Optional[str] = None
+    report: RunReport = dataclasses.field(default_factory=RunReport)
+    header: dict = dataclasses.field(default_factory=dict)
+    lost: bool = False
+    #: estimated clock skew vs the reference member (seconds; 0 for the
+    #: reference itself or when no shared rendezvous exists)
+    clock_skew_s: float = 0.0
+    # derived-view memos: RunReport.key_metrics()/phase_tree() walk every
+    # span, and a fleet report consumes them from rows(), key_metrics(),
+    # markdown AND to_json — compute once per member (the underlying
+    # report never changes after load)
+    _km: Optional[dict] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _run_s: Optional[float] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    @property
+    def hostname(self) -> Optional[str]:
+        return self.header.get("hostname")
+
+    def key_metrics(self) -> dict[str, float]:
+        if self._km is None:
+            self._km = self.report.key_metrics()
+        return self._km
+
+    def _abs_time(self, ts: float) -> Optional[float]:
+        """Member-local tracer seconds -> absolute epoch seconds (skew-
+        corrected), or None without an anchor pair in the header."""
+        anchor_unix = self.header.get("anchor_unix_s")
+        anchor_mono = self.header.get("monotonic_anchor")
+        if anchor_unix is None or anchor_mono is None:
+            return None
+        return anchor_unix + (ts - anchor_mono) - self.clock_skew_s
+
+    def run_seconds(self) -> float:
+        """This member's total traced wall time (top-level phase sum)."""
+        if self._run_s is None:
+            tree = self.report.phase_tree()
+            self._run_s = sum(c.total_s for c in tree.children.values())
+        return self._run_s
+
+    def collective_wait_seconds(self) -> Optional[float]:
+        c = self.report.snapshot.get("counters", {})
+        value = c.get("comms.wait_seconds_total")
+        return None if value is None else float(value)
+
+    def heartbeat_gap_max_s(self) -> Optional[float]:
+        """Largest gap between consecutive heartbeat lines (uptime
+        deltas) — a long gap means the member went quiet mid-run."""
+        ups = [
+            hb.get("uptime_s")
+            for hb in self.report.heartbeats
+            if isinstance(hb.get("uptime_s"), (int, float))
+        ]
+        if len(ups) < 2:
+            return None
+        return max(b - a for a, b in zip(ups, ups[1:]))
+
+    def row(self) -> dict[str, Any]:
+        """The per-member report row (JSON-safe)."""
+        km = self.key_metrics()
+        counters = self.report.snapshot.get("counters", {})
+        du = self.report.device_utilization()
+        wait = self.collective_wait_seconds()
+        run_s = self.run_seconds()
+        last_hb = (
+            self.report.heartbeats[-1] if self.report.heartbeats else None
+        )
+        chunks = counters.get("streaming_chunks")
+        return {
+            "process_index": self.process_index,
+            "hostname": self.hostname,
+            "status": "lost" if self.lost else "ok",
+            "rows_per_sec": km.get("rows_per_sec"),
+            "coeffs_per_sec": km.get("coeffs_per_sec"),
+            "mfu": km.get("mfu"),
+            "comms_fraction": (
+                du.get("comms_fraction") if du is not None else None
+            ),
+            "collective_wait_s": wait,
+            "collective_wait_calls": counters.get("comms.wait_calls"),
+            "collective_wait_share": (
+                wait / run_s if wait is not None and run_s else None
+            ),
+            "chunks_done": None if chunks is None else int(chunks),
+            "run_seconds": round(run_s, 6) if run_s else None,
+            "heartbeats": len(self.report.heartbeats),
+            "heartbeat_gap_max_s": self.heartbeat_gap_max_s(),
+            "last_heartbeat": last_hb,
+            "clock_skew_s": round(self.clock_skew_s, 6),
+            "artifacts": {
+                "trace": self.trace_path,
+                "telemetry": self.telemetry_path,
+            },
+        }
+
+
+def _rendezvous_endpoints(member: FleetMember) -> dict[int, float]:
+    """``next_chunk -> absolute end time`` of this member's COORDINATED
+    checkpoint-save spans — the shared barrier events skew is estimated
+    from (every member leaves ``_save_coordinated`` within one quorum
+    poll of the rename landing)."""
+    out: dict[int, float] = {}
+    for s in member.report.spans:
+        if s.get("name") != "checkpoint:save":
+            continue
+        attrs = s.get("attrs") or {}
+        if not attrs.get("coordinated"):
+            continue
+        chunk = attrs.get("next_chunk")
+        if not isinstance(chunk, int):
+            continue
+        ts = s.get("ts")
+        dur = s.get("dur")
+        if not isinstance(ts, (int, float)) or not isinstance(
+            dur, (int, float)
+        ):
+            continue
+        end = member._abs_time(ts + dur)
+        if end is not None:
+            out[chunk] = end
+    return out
+
+
+@dataclasses.dataclass
+class FleetReport:
+    """Merged per-member telemetry for one fleet run."""
+
+    fleet_dir: str
+    members: list[FleetMember] = dataclasses.field(default_factory=list)
+    num_processes: int = 0
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def load(cls, fleet_dir: str) -> "FleetReport":
+        """Build from a directory of per-member artifact streams.
+
+        Degradation contract: missing/truncated/half-written artifacts
+        (the killed-member case) never raise — the member renders with
+        whatever survived, marked ``lost`` when its final metrics
+        snapshot is absent. An expected member with NO artifacts at all
+        (fleet size known from a peer's header) gets a synthesized
+        ``lost`` row."""
+        streams = discover_member_streams(fleet_dir)
+        members: list[FleetMember] = []
+        for proc in sorted(streams):
+            paths = streams[proc]
+            trace_path = paths.get("trace")
+            telemetry_path = paths.get("telemetry")
+            report = RunReport.load(
+                trace=trace_path, telemetry=telemetry_path
+            )
+            header = paths.get("header") or {}
+            member = FleetMember(
+                process_index=proc,
+                trace_path=trace_path,
+                telemetry_path=telemetry_path,
+                report=report,
+                header=header,
+            )
+            # a member that never flushed its final metrics snapshot died
+            # before atexit ran (os._exit / SIGKILL — the chaos shape):
+            # its spans/heartbeats are real but the run is incomplete
+            member.lost = not report.snapshot
+            members.append(member)
+        expected = 0
+        for member in members:
+            nproc = member.header.get("num_processes")
+            if isinstance(nproc, int):
+                expected = max(expected, nproc)
+        if members:
+            expected = max(expected, members[-1].process_index + 1)
+        present = {m.process_index for m in members}
+        for proc in range(expected):
+            if proc not in present:
+                members.append(
+                    FleetMember(process_index=proc, lost=True)
+                )
+        members.sort(key=lambda m: m.process_index)
+        report = cls(
+            fleet_dir=fleet_dir,
+            members=members,
+            num_processes=max(expected, len(members)),
+        )
+        report._estimate_skew()
+        return report
+
+    def _estimate_skew(self) -> None:
+        """Residual clock skew per member vs the first member with
+        rendezvous data, from shared coordinated-checkpoint endpoints.
+        Limits (README): resolution is one quorum poll (~50 ms) and a
+        fleet that never checkpointed coordinates carries skew 0 — the
+        anchor pair alone aligns its timelines."""
+        endpoints = {
+            m.process_index: _rendezvous_endpoints(m) for m in self.members
+        }
+        reference: Optional[int] = None
+        for proc in sorted(endpoints):
+            if endpoints[proc]:
+                reference = proc
+                break
+        if reference is None:
+            return
+        ref = endpoints[reference]
+        for member in self.members:
+            if member.process_index == reference:
+                continue
+            mine = endpoints[member.process_index]
+            shared = sorted(set(mine) & set(ref))
+            if not shared:
+                continue
+            member.clock_skew_s = statistics.median(
+                [mine[k] - ref[k] for k in shared]
+            )
+
+    # -- derived views -------------------------------------------------------
+
+    def merged_spans(self) -> list[dict]:
+        """Every member's spans on ONE absolute timeline: each record
+        gains ``process_index`` and ``abs_ts`` (skew-corrected epoch
+        seconds; absent without an anchor), sorted by absolute start."""
+        merged: list[dict] = []
+        for member in self.members:
+            for s in member.report.spans:
+                rec = dict(s)
+                rec["process_index"] = member.process_index
+                ts = s.get("ts")
+                if isinstance(ts, (int, float)):
+                    abs_ts = member._abs_time(ts)
+                    if abs_ts is not None:
+                        rec["abs_ts"] = round(abs_ts, 6)
+                merged.append(rec)
+        merged.sort(
+            key=lambda r: (
+                r.get("abs_ts") is None,
+                r.get("abs_ts") or 0.0,
+                r.get("process_index"),
+            )
+        )
+        return merged
+
+    def rows(self) -> list[dict[str, Any]]:
+        return [m.row() for m in self.members]
+
+    def lost_members(self) -> list[int]:
+        return [m.process_index for m in self.members if m.lost]
+
+    def straggler(self) -> Optional[dict[str, Any]]:
+        """Name the member the fleet waited on: minimum total collective
+        wait across members with wait data (the last to arrive at every
+        barrier waits ~zero). None when fewer than two members report
+        waits or the spread is below noise."""
+        waits = {
+            m.process_index: w
+            for m in self.members
+            if (w := m.collective_wait_seconds()) is not None
+        }
+        if len(waits) < 2:
+            return None
+        spread = max(waits.values()) - min(waits.values())
+        if spread < _STRAGGLER_MIN_SPREAD_S:
+            return None
+        straggler = min(waits, key=lambda p: waits[p])
+        return {
+            "process_index": straggler,
+            "wait_s": round(waits[straggler], 6),
+            "fleet_max_wait_s": round(max(waits.values()), 6),
+            "spread_s": round(spread, 6),
+            "waits_by_member": {
+                str(p): round(w, 6) for p, w in sorted(waits.items())
+            },
+        }
+
+    def key_metrics(self) -> dict[str, float]:
+        """The aggregated scalar summary ``compare()`` gates on."""
+        out: dict[str, float] = {
+            "fleet_members": float(self.num_processes),
+            "fleet_lost_members": float(len(self.lost_members())),
+        }
+        rates = [
+            m.key_metrics().get("rows_per_sec") for m in self.members
+        ]
+        rates = [r for r in rates if r]
+        if rates:
+            out["fleet_rows_per_sec"] = float(sum(rates))
+        coeff_rates = [
+            m.key_metrics().get("coeffs_per_sec") for m in self.members
+        ]
+        coeff_rates = [r for r in coeff_rates if r]
+        if coeff_rates:
+            out["fleet_coeffs_per_sec"] = float(sum(coeff_rates))
+        waits = [
+            w
+            for m in self.members
+            if (w := m.collective_wait_seconds()) is not None
+        ]
+        run_total = sum(m.run_seconds() for m in self.members)
+        if waits:
+            out["fleet_collective_wait_s"] = round(sum(waits), 6)
+            if run_total:
+                out["fleet_collective_wait_fraction"] = round(
+                    sum(waits) / run_total, 6
+                )
+        mfus = [
+            mfu
+            for m in self.members
+            if (mfu := m.key_metrics().get("mfu")) is not None
+        ]
+        if len(mfus) >= 2:
+            out["fleet_mfu_spread"] = round(max(mfus) - min(mfus), 6)
+        gaps = [
+            g
+            for m in self.members
+            if (g := m.heartbeat_gap_max_s()) is not None
+        ]
+        if gaps:
+            out["fleet_heartbeat_gap_max_s"] = round(max(gaps), 3)
+        skews = [abs(m.clock_skew_s) for m in self.members]
+        if any(skews):
+            out["fleet_clock_skew_max_s"] = round(max(skews), 6)
+        return out
+
+    def compare(
+        self,
+        baseline: Mapping[str, Any],
+        threshold: float = 0.2,
+    ) -> list[MetricDelta]:
+        """Diff aggregated key metrics against a baseline fleet-report
+        JSON (its ``key_metrics``) or a bare ``{metric: value}`` dict —
+        the same contract as ``RunReport.compare``."""
+        base = baseline.get("key_metrics", baseline)
+        return compare_metrics(
+            self.key_metrics(),
+            base,
+            threshold=threshold,
+            directions=FLEET_KEY_METRIC_DIRECTIONS,
+        )
+
+    # -- rendering -----------------------------------------------------------
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "type": "fleet_report",
+            "format_version": FLEET_REPORT_FORMAT_VERSION,
+            "generated": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(),
+            "fleet_dir": self.fleet_dir,
+            "num_processes": self.num_processes,
+            "lost_members": self.lost_members(),
+            "key_metrics": self.key_metrics(),
+            "members": self.rows(),
+            "straggler": self.straggler(),
+        }
+
+    def save_json(self, path: str) -> dict[str, Any]:
+        from photon_ml_tpu.utils.atomic import atomic_write_json
+
+        doc = self.to_json()
+        atomic_write_json(path, doc, indent=2, sort_keys=True, default=str)
+        return doc
+
+    def to_markdown(
+        self, deltas: Optional[Sequence[MetricDelta]] = None
+    ) -> str:
+        lines: list[str] = ["# Fleet report", ""]
+        lines.append(
+            f"_Fleet dir: `{self.fleet_dir}` — "
+            f"{self.num_processes} member(s)_"
+        )
+        lines.append("")
+        lost = self.lost_members()
+        if lost:
+            lines += [
+                f"> **Warning**: member(s) {lost} are **lost** — their "
+                "final metrics snapshot never landed (killed before the "
+                "atexit flush, or artifacts missing). Rows below render "
+                "whatever survived; fleet aggregates undercount.",
+                "",
+            ]
+
+        km = self.key_metrics()
+        if km:
+            lines += [
+                "## Fleet key metrics",
+                "",
+                "| metric | value |",
+                "|---|---|",
+            ]
+            for name, value in sorted(km.items()):
+                lines.append(f"| `{name}` | {_fmt(value)} |")
+            lines.append("")
+
+        lines += [
+            "## Members",
+            "",
+            "| proc | status | rows/s | MFU | comms | wait s | wait "
+            "share | chunks | beats | max gap s | skew s |",
+            "|---|---|---|---|---|---|---|---|---|---|---|",
+        ]
+        for row in self.rows():
+            lines.append(
+                f"| {row['process_index']}"
+                + (f" ({row['hostname']})" if row.get("hostname") else "")
+                + f" | {row['status']} | "
+                f"{_fmt_or_unknown(row['rows_per_sec'])} | "
+                f"{_fmt_pct(row['mfu'])} | "
+                f"{_fmt_pct(row['comms_fraction'])} | "
+                f"{_fmt_or_unknown(row['collective_wait_s'])} | "
+                f"{_fmt_pct(row['collective_wait_share'])} | "
+                f"{_fmt_or_unknown(row['chunks_done'])} | "
+                f"{row['heartbeats']} | "
+                f"{_fmt_or_unknown(row['heartbeat_gap_max_s'])} | "
+                f"{_fmt(row['clock_skew_s'])} |"
+            )
+        lines.append("")
+
+        straggler = self.straggler()
+        if straggler is not None:
+            lines += [
+                f"**Straggler: member {straggler['process_index']}** — "
+                f"it waited only {straggler['wait_s']:.3f}s at the "
+                "fleet's collectives while the slowest-waiting member "
+                f"stood by for {straggler['fleet_max_wait_s']:.3f}s "
+                "(low wait = last to arrive = the member everyone else "
+                "waited on).",
+                "",
+            ]
+        elif not lost:
+            lines += [
+                "No straggler callout: collective waits are balanced "
+                "(or unrecorded) across members.",
+                "",
+            ]
+
+        if deltas is not None:
+            lines += _compare_markdown(deltas)
+        return "\n".join(lines).rstrip() + "\n"

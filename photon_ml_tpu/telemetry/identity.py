@@ -1,0 +1,120 @@
+"""Fleet process identity for telemetry artifacts.
+
+Every telemetry primitive used to be process-global AND process-blind: a
+2-process gloo fit pointed both members' ``PHOTON_TRACE_OUT`` at the same
+file and the last writer won. This module is the one place the telemetry
+layer learns *which fleet member it is*, so that
+
+- artifact paths can be suffixed per member
+  (``trace.jsonl`` -> ``trace.proc-0.jsonl``, :func:`member_artifact_path`);
+- trace headers / metric snapshots / heartbeat lines can carry
+  ``process_index``/``hostname`` fields the fleet aggregator
+  (:mod:`photon_ml_tpu.telemetry.fleet_report`) attributes rows by.
+
+Identity resolution, in priority order:
+
+1. ``PHOTON_PROC_ID`` (and optional ``PHOTON_PROC_COUNT``) — set by the
+   fleet supervisor (tools/fleet.py) for each worker BEFORE launch, so
+   identity exists before (and without) jax ever importing;
+2. ``jax.process_index()`` — but only when jax is ALREADY imported and
+   multi-process: telemetry configuration must never be the thing that
+   initializes a backend;
+3. none: single-process runs keep unsuffixed paths and unchanged formats.
+
+Kept dependency-free (os/sys/socket only) so both ``trace`` and
+``metrics`` can import it without cycles.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+from typing import Optional
+
+__all__ = [
+    "ENV_PROC_ID",
+    "ENV_PROC_COUNT",
+    "fleet_process_index",
+    "fleet_process_count",
+    "hostname",
+    "member_artifact_path",
+]
+
+ENV_PROC_ID = "PHOTON_PROC_ID"
+ENV_PROC_COUNT = "PHOTON_PROC_COUNT"
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        value = int(raw)
+    except ValueError:
+        return None  # malformed env must not fail telemetry setup
+    return value if value >= 0 else None
+
+
+def fleet_process_index() -> Optional[int]:
+    """This process's fleet member index, or ``None`` outside a fleet.
+
+    ``PHOTON_PROC_ID`` wins (the supervisor's assignment — present before
+    jax exists); otherwise an already-imported multi-process jax is
+    consulted. Never imports jax itself.
+    """
+    env = _env_int(ENV_PROC_ID)
+    if env is not None:
+        return env
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        if jax.process_count() > 1:
+            return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — identity must never fail telemetry
+        return None
+    return None
+
+
+def fleet_process_count() -> Optional[int]:
+    """The fleet size this member believes in, or ``None`` when unknown
+    (same resolution order as :func:`fleet_process_index`)."""
+    env = _env_int(ENV_PROC_COUNT)
+    if env is not None:
+        return env
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        count = int(jax.process_count())
+    except Exception:  # noqa: BLE001
+        return None
+    return count if count > 1 else None
+
+
+def hostname() -> str:
+    try:
+        return socket.gethostname()
+    except OSError:
+        return "unknown"
+
+
+def member_artifact_path(path: str, proc: Optional[int] = None) -> str:
+    """Suffix an artifact path per fleet member: ``trace.jsonl`` ->
+    ``trace.proc-0.jsonl`` (suffix inserted before the final extension;
+    extensionless paths append ``.proc-0``).
+
+    ``proc`` defaults to :func:`fleet_process_index`; outside a fleet the
+    path is returned UNCHANGED, so single-process callers keep their
+    exact artifact names. Idempotent: an already-suffixed path (the
+    supervisor may pre-suffix) is left alone.
+    """
+    if proc is None:
+        proc = fleet_process_index()
+    if proc is None:
+        return path
+    base, ext = os.path.splitext(path)
+    if base.endswith(f".proc-{proc}"):
+        return path
+    return f"{base}.proc-{proc}{ext}"

@@ -236,7 +236,12 @@ class MetricsRegistry:
 
     def flush_jsonl(self, path: str) -> dict[str, Any]:
         """Append one ``{"type": "metrics", ...}`` line to ``path`` and
-        return the snapshot that was written."""
+        return the snapshot that was written. In a fleet the line carries
+        ``process_index``/``hostname`` so the aggregate report
+        (telemetry.fleet_report) can attribute it without trusting the
+        file name alone."""
+        from photon_ml_tpu.telemetry import identity
+
         snap = self.snapshot()
         line = {
             "type": "metrics",
@@ -245,6 +250,10 @@ class MetricsRegistry:
             ).isoformat(),
             "snapshot": snap,
         }
+        proc = identity.fleet_process_index()
+        if proc is not None:
+            line["process_index"] = proc
+            line["hostname"] = identity.hostname()
         with open(path, "a", encoding="utf-8") as fh:
             fh.write(json.dumps(line, default=str) + "\n")
         return snap

@@ -37,13 +37,14 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from typing import Any, Optional
 
-from photon_ml_tpu.telemetry import memory, metrics, trace, xla
+from photon_ml_tpu.telemetry import identity, memory, metrics, trace, xla
 
-__all__ = ["Heartbeat", "DEFAULT_INTERVAL_S"]
+__all__ = ["Heartbeat", "DEFAULT_INTERVAL_S", "tail_heartbeat_fields"]
 
 logger = logging.getLogger("photon_ml_tpu.telemetry.progress")
 
@@ -170,6 +171,13 @@ class Heartbeat:
                 "coeffs_total": coeffs,
                 "dropped_spans": metrics.counter("trace.dropped_spans").value,
             }
+            # fleet attribution: interleaved multi-process progress logs
+            # need to say WHOSE line this is. Field present only inside a
+            # fleet (PHOTON_PROC_ID / multi-process jax) — the
+            # single-process line format is pinned unchanged by tests
+            proc = identity.fleet_process_index()
+            if proc is not None:
+                line["proc"] = proc
             # device utilization over the beat window (ISSUE 5): live MFU
             # needs both cost analysis (flops counted) and a known device
             # peak; comms fraction needs a comms estimate — absent either,
@@ -266,3 +274,49 @@ class Heartbeat:
                 with self._lock:
                     self.jsonl_path = None
         return line
+
+
+def tail_heartbeat_fields(
+    path: str,
+    max_bytes: int = 65536,
+    expect_proc: Optional[int] = None,
+) -> Optional[dict[str, Any]]:
+    """The newest parseable ``{"type": "heartbeat", ...}`` line of a
+    telemetry JSONL — the fleet supervisor's live-status probe.
+
+    Reads only the file's last ``max_bytes`` (the supervisor polls every
+    member on a cadence; re-reading whole telemetry files would scale the
+    poll with run length), walks candidate lines newest-first, and skips
+    anything unparseable — a member killed mid-write leaves a truncated
+    final line, and the beat before it is still the freshest truth.
+
+    ``expect_proc`` makes the parser REQUIRE member attribution: lines
+    without a matching ``proc`` field are rejected, so a mis-pointed file
+    (or a single-process artifact polled as member i's) reads as "no
+    heartbeat" instead of silently attributing another member's progress.
+    Returns None when no acceptable heartbeat line exists. Pure file IO —
+    this runs on the supervisor's status thread and must never touch a
+    device (the static gate seeds it into the L013 sync walk).
+    """
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(size - max_bytes, 0))
+            tail = fh.read()
+    except OSError:
+        return None
+    for raw in reversed(tail.splitlines()):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            rec = json.loads(raw.decode("utf-8", errors="replace"))
+        except ValueError:
+            continue  # truncated / partial line: keep walking backward
+        if not isinstance(rec, dict) or rec.get("type") != "heartbeat":
+            continue
+        if expect_proc is not None and rec.get("proc") != expect_proc:
+            continue
+        return rec
+    return None

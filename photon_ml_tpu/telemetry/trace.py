@@ -36,6 +36,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Iterable, Iterator, Optional
 
+from photon_ml_tpu.telemetry import identity
+
 __all__ = [
     "Span",
     "Tracer",
@@ -150,14 +152,25 @@ class Tracer:
                 # mix incompatible monotonic timebases (and a second
                 # mid-file trace_header) into one Perfetto export
                 self._sink_fh = open(jsonl_path, "w", encoding="utf-8")
-                self._wall_anchor = datetime.datetime.now(
-                    datetime.timezone.utc
-                ).isoformat()
+                wall = datetime.datetime.now(datetime.timezone.utc)
+                self._wall_anchor = wall.isoformat()
                 header = {
                     "type": "trace_header",
                     "wall_time": self._wall_anchor,
                     "monotonic_anchor": round(time.monotonic() - self._anchor, 6),
+                    # the monotonic<->epoch anchor pair: a span at tracer
+                    # time `ts` happened at absolute epoch second
+                    # `anchor_unix_s + (ts - monotonic_anchor)` — the
+                    # alignment FleetReport merges member timelines on
+                    "anchor_unix_s": round(wall.timestamp(), 6),
+                    "hostname": identity.hostname(),
                 }
+                proc = identity.fleet_process_index()
+                if proc is not None:
+                    header["process_index"] = proc
+                    nproc = identity.fleet_process_count()
+                    if nproc is not None:
+                        header["num_processes"] = nproc
                 self._sink_fh.write(json.dumps(header) + "\n")
                 self._sink_fh.flush()
 

@@ -490,6 +490,26 @@ def test_distributed_matrix_tier1_row(tmp_path):
     assert entry["relaunches"] == 1  # resumed on the survivor
     assert entry["loss_delta"] < 1e-6
     assert entry["partial_certified"] == []  # zero partial checkpoints
+    # fleet-observability degradation over the row's REAL leftover
+    # artifact dirs (ISSUE 13), one per generation: in gen0 the
+    # hard-killed victim (proc 1, os._exit at the seam — no atexit
+    # metrics flush) AND the survivor that noticed the broken fleet
+    # (os._exit 76) both render `lost` — their runs genuinely never
+    # completed; the relaunched gen1 fleet's member renders `ok`. Never
+    # a crash, never silently complete.
+    from photon_ml_tpu.telemetry.fleet_report import FleetReport
+
+    telemetry_dir = os.path.join(
+        str(tmp_path), "checkpoint_peer_manifest", "telemetry"
+    )
+    gen0 = FleetReport.load(os.path.join(telemetry_dir, "gen0"))
+    assert 1 in gen0.lost_members()
+    rows = {r["process_index"]: r for r in gen0.rows()}
+    assert rows[1]["status"] == "lost"
+    json.dumps(gen0.to_json(), default=str)  # JSON-safe partial
+    gen1 = FleetReport.load(os.path.join(telemetry_dir, "gen1"))
+    assert gen1.lost_members() == []
+    assert [r["status"] for r in gen1.rows()] == ["ok"]
 
 
 @pytest.mark.chaos_distributed

@@ -1176,3 +1176,86 @@ class TestFaultCoverageL016:
             for p in driver.source_files(root)
         ]
         assert faultcov.run(files) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: fleet observability joins the analysis scope
+# ---------------------------------------------------------------------------
+
+
+_FLEET_OBS_TREE = {
+    "photon_ml_tpu/__init__.py": "",
+    "photon_ml_tpu/telemetry/__init__.py": "",
+    # the supervisor's tail parser with a PLANTED device sync: the status
+    # thread must never touch a device, so the L013 walk seeded at
+    # tail_heartbeat_fields has to flag it
+    "photon_ml_tpu/telemetry/progress.py": (
+        "import json\n\n"
+        "import numpy as np\n\n\n"
+        "def tail_heartbeat_fields(path, max_bytes=65536,\n"
+        "                          expect_proc=None):\n"
+        "    with open(path, 'rb') as fh:\n"
+        "        tail = fh.read()\n"
+        "    rec = json.loads(tail.splitlines()[-1])\n"
+        "    rec['rows'] = np.asarray(rec['rows'])\n"
+        "    return rec\n"
+    ),
+}
+
+
+class TestFleetObservabilityGate:
+    def test_status_seeds_are_registered(self):
+        from tools.analysis import hotpath
+
+        for seed in (
+            "photon_ml_tpu.telemetry.progress.tail_heartbeat_fields",
+            "photon_ml_tpu.parallel.fleet_status.FleetStatusWriter"
+            ".snapshot",
+            "photon_ml_tpu.parallel.fleet_status.FleetStatusWriter"
+            ".write_once",
+        ):
+            assert seed in hotpath.SYNC_SEEDS
+
+    def test_planted_sync_in_tail_parser_flagged(self, tmp_path):
+        res = analyze(tmp_path, _FLEET_OBS_TREE)
+        assert codes(res.findings) == ["L013"]
+        f = res.findings[0]
+        assert f.path == "photon_ml_tpu/telemetry/progress.py"
+        assert "np.asarray" in f.message
+        assert f.chain == ("telemetry.progress.tail_heartbeat_fields",)
+
+    def test_planted_sync_fails_the_real_cli(self, tmp_path):
+        write_tree(tmp_path, _FLEET_OBS_TREE)
+        proc = subprocess.run(
+            [sys.executable, CHECK, "--root", str(tmp_path), "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        l013 = [f for f in doc["findings"] if f["code"] == "L013"]
+        assert l013, doc["findings"]
+        assert l013[0]["path"] == "photon_ml_tpu/telemetry/progress.py"
+
+    def test_real_status_writer_passes_lock_discipline(self):
+        """The REAL FleetStatusWriter (a thread-spawning class with
+        supervisor-pushed shared state) carries no unlocked cross-thread
+        writes (L015), and no sync reachable from its seeds (L013)."""
+        from tools.analysis import hotpath, locks
+        from tools.analysis.callgraph import build_graph
+
+        rels = (
+            os.path.join("photon_ml_tpu", "parallel", "fleet_status.py"),
+            os.path.join("photon_ml_tpu", "parallel", "multihost.py"),
+            os.path.join("photon_ml_tpu", "telemetry", "progress.py"),
+            os.path.join("photon_ml_tpu", "telemetry", "identity.py"),
+        )
+        srcs = [core.load_source(rel, os.path.join(REPO, rel))
+                for rel in rels]
+        g = build_graph(srcs)
+        assert (
+            "photon_ml_tpu.parallel.fleet_status.FleetStatusWriter"
+            in g.classes
+        )
+        assert locks.run(g) == []
+        findings = hotpath.run(g, require_seeds=False)
+        assert [f for f in findings if f.code == "L013"] == []

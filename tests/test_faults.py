@@ -61,6 +61,9 @@ EXPECTED_POINTS = {
     "fleet.heartbeat",
     "checkpoint.peer_manifest",
     "parallel.collective.entry",
+    # fleet observability (supervisor-side: neither matrix — status is
+    # observability, never control; covered by tests/test_fleet_status)
+    "fleet.status_write",
 }
 
 WRITE_PATH_POINTS = [
@@ -92,6 +95,7 @@ def test_registry_catalog_is_complete_and_stable():
     import photon_ml_tpu.serving.nearline  # noqa: F401
     import photon_ml_tpu.serving.registry  # noqa: F401
     import photon_ml_tpu.parallel.distributed  # noqa: F401
+    import photon_ml_tpu.parallel.fleet_status  # noqa: F401
     import photon_ml_tpu.parallel.multihost  # noqa: F401
 
     registered = faults.registered_points()

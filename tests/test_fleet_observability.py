@@ -1,0 +1,186 @@
+"""ISSUE 13 acceptance: fleet observability end-to-end on a REAL
+2-process gloo fleet (the tools/fleet.py supervisor over the dryrun fit
+shape).
+
+One supervised run must prove the whole chain at once:
+
+- each member writes per-member SUFFIXED trace + telemetry artifacts with
+  its process identity recorded in the trace header (the
+  ``telemetry.identity`` naming contract applied via PHOTON_*_OUT);
+- ``cli report --fleet`` over the artifact dir renders ONE merged report
+  whose per-member rows, collective-wait attribution, and straggler
+  callout round-trip through JSON;
+- the supervisor's live status snapshot DURING the run shows both
+  members alive with fresh heartbeat fields (polled from the atomic
+  ``--status-file`` while the fit runs).
+
+Member 1 carries a per-boundary sleep (``chunk_sleep_proc=1``) so it
+arrives LAST at every ``fleet_any`` barrier — the deterministic
+straggler: its collective wait is near zero while member 0 stands by.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tools import fleet
+
+
+@pytest.fixture(scope="module")
+def fleet_obs_run(tmp_path_factory):
+    """One supervised 2-process gloo fleet with telemetry + live status;
+    shared by every assertion below (the run is the expensive part)."""
+    workdir = str(tmp_path_factory.mktemp("fleet_obs"))
+    status_file = os.path.join(workdir, "status.json")
+    snapshots: list[dict] = []
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            try:
+                with open(status_file, encoding="utf-8") as fh:
+                    snapshots.append(json.load(fh))
+            except (OSError, ValueError):
+                pass  # not written yet / atomic swap in flight elsewhere
+            time.sleep(0.15)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    try:
+        report = fleet.run_fleet(fleet.FleetSpec(
+            workdir=workdir,
+            num_processes=2,
+            devices_per_process=2,
+            # member 1 = the deterministic straggler: it sleeps BEFORE
+            # every fleet_any barrier, so member 0 stands waiting. The
+            # sleep must dwarf 2-core scheduling noise (supervisor +
+            # status + pytest threads contend with the workers): ~5 s of
+            # injected asymmetry across the 4 boundaries vs sub-second
+            # jitter per barrier
+            chunk_sleep_s=1.25,
+            chunk_sleep_proc=1,
+            progress_heartbeat_every_s=0.4,
+            status_file=status_file,
+            status_port=0,
+            status_interval_s=0.25,
+            timeout_s=300.0,
+        ))
+    finally:
+        stop.set()
+        poller.join(timeout=5.0)
+    assert report.get("ok"), json.dumps(report, default=str)[:2000]
+    return {"report": report, "snapshots": snapshots,
+            "status_file": status_file}
+
+
+def test_per_member_suffixed_artifacts_with_identity(fleet_obs_run):
+    tdir = fleet_obs_run["report"]["telemetry_dir"]
+    names = set(os.listdir(tdir))
+    assert {
+        "trace.proc-0.jsonl", "trace.proc-1.jsonl",
+        "telemetry.proc-0.jsonl", "telemetry.proc-1.jsonl",
+    } <= names
+    # no unsuffixed clobber target exists
+    assert "trace.jsonl" not in names and "telemetry.jsonl" not in names
+    for proc in (0, 1):
+        with open(os.path.join(tdir, f"trace.proc-{proc}.jsonl")) as fh:
+            header = json.loads(fh.readline())
+        assert header["type"] == "trace_header"
+        assert header["process_index"] == proc
+        assert header["num_processes"] == 2
+        assert isinstance(header["anchor_unix_s"], float)
+        assert isinstance(header["hostname"], str)
+        # final metrics snapshot carries the same identity
+        metrics_lines = [
+            json.loads(line)
+            for line in open(
+                os.path.join(tdir, f"telemetry.proc-{proc}.jsonl")
+            )
+            if line.strip()
+        ]
+        finals = [r for r in metrics_lines if r.get("type") == "metrics"]
+        assert finals and finals[-1]["process_index"] == proc
+        beats = [r for r in metrics_lines if r.get("type") == "heartbeat"]
+        assert beats and all(b["proc"] == proc for b in beats)
+
+
+def test_live_status_showed_both_members_alive(fleet_obs_run):
+    snapshots = fleet_obs_run["snapshots"]
+    assert snapshots, "the status file was never readable during the run"
+    both_alive = [
+        s for s in snapshots if s.get("alive_members") == [0, 1]
+    ]
+    assert both_alive, [s.get("alive_members") for s in snapshots[-5:]]
+    # fresh per-member heartbeat fields, correctly attributed
+    with_fields = [
+        s for s in both_alive
+        if all(
+            s["members"][str(p)].get("last_heartbeat", {}).get("proc") == p
+            for p in (0, 1)
+        )
+    ]
+    assert with_fields
+    member0 = with_fields[-1]["members"]["0"]
+    assert member0["heartbeat_age_s"] < 5.0
+    assert member0["last_heartbeat"]["seq"] >= 1
+    # the final snapshot records the completed outcome
+    final = json.loads(open(fleet_obs_run["status_file"]).read())
+    assert final["outcome"] == "complete"
+    assert final["deaths"] == []
+
+
+def test_cli_report_fleet_merges_run_with_straggler(fleet_obs_run, tmp_path):
+    from photon_ml_tpu.cli.report import main as report_main
+
+    tdir = fleet_obs_run["report"]["telemetry_dir"]
+    out_md = tmp_path / "fleet.md"
+    out_json = tmp_path / "fleet.json"
+    rc = report_main([
+        "--fleet", tdir, "--out", str(out_md), "--json", str(out_json),
+    ])
+    assert rc == 0
+    doc = json.loads(out_json.read_text())
+    assert doc["type"] == "fleet_report"
+    assert doc["lost_members"] == []
+    rows = {r["process_index"]: r for r in doc["members"]}
+    assert set(rows) == {0, 1}
+    for proc, row in rows.items():
+        assert row["status"] == "ok"
+        # collective-wait attribution recorded per member (fleet_any
+        # barriers + chunk-solve dispatch under jax.process_count()==2)
+        assert row["collective_wait_s"] is not None
+        assert row["collective_wait_calls"] >= 1
+        assert row["heartbeats"] >= 1
+        assert row["chunks_done"] == fleet.N_CHUNKS
+    # the slept member arrives last at every barrier => waits least =>
+    # is named the straggler; the prompt member accumulated real wait
+    straggler = doc["straggler"]
+    assert straggler is not None
+    assert straggler["process_index"] == 1
+    assert rows[0]["collective_wait_s"] > rows[1]["collective_wait_s"]
+    km = doc["key_metrics"]
+    assert km["fleet_collective_wait_s"] > 0
+    assert 0 < km["fleet_collective_wait_fraction"] <= 1
+    assert km["fleet_lost_members"] == 0
+    md = out_md.read_text()
+    assert "Straggler: member 1" in md
+
+    # the aggregated metrics gate: self-compare green, degraded baseline
+    # (much lower wait fraction) exits 3 under --fail-on-regress
+    assert report_main([
+        "--fleet", tdir, "--compare", str(out_json), "--fail-on-regress",
+    ]) == 0
+    worse = dict(km)
+    worse["fleet_collective_wait_fraction"] = (
+        km["fleet_collective_wait_fraction"] / 10.0
+    )
+    base = tmp_path / "strict_baseline.json"
+    base.write_text(json.dumps({"key_metrics": worse}))
+    assert report_main([
+        "--fleet", tdir, "--compare", str(base), "--fail-on-regress",
+    ]) == 3

@@ -1,0 +1,571 @@
+"""Fleet observability: identity suffixing, per-member artifact fields,
+the heartbeat tail parser, collective-wait attribution, and the
+FleetReport aggregation (telemetry/identity.py, telemetry/fleet_report.py).
+
+The real 2-process gloo end-to-end lives in
+tests/test_fleet_observability.py; these tests pin each layer's contract
+on synthetic artifacts, including the degraded killed-member case the
+distributed crash matrix produces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import identity
+from photon_ml_tpu.telemetry.fleet_report import (
+    FleetReport,
+    discover_member_streams,
+)
+from photon_ml_tpu.telemetry.progress import Heartbeat, tail_heartbeat_fields
+
+
+# ---------------------------------------------------------------------------
+# identity + per-member suffixing
+# ---------------------------------------------------------------------------
+
+
+def test_member_artifact_path_contract(monkeypatch):
+    monkeypatch.delenv(identity.ENV_PROC_ID, raising=False)
+    # outside a fleet: unchanged (single-process artifact names are pinned)
+    assert identity.member_artifact_path("a/trace.jsonl") == "a/trace.jsonl"
+    # explicit proc: suffix before the extension
+    assert (
+        identity.member_artifact_path("a/trace.jsonl", proc=2)
+        == "a/trace.proc-2.jsonl"
+    )
+    assert identity.member_artifact_path("report.md", 0) == "report.proc-0.md"
+    assert identity.member_artifact_path("noext", 1) == "noext.proc-1"
+    # idempotent: a pre-suffixed path is left alone
+    assert (
+        identity.member_artifact_path("a/trace.proc-2.jsonl", proc=2)
+        == "a/trace.proc-2.jsonl"
+    )
+
+
+def test_identity_env_resolution(monkeypatch):
+    monkeypatch.setenv(identity.ENV_PROC_ID, "3")
+    monkeypatch.setenv(identity.ENV_PROC_COUNT, "4")
+    assert identity.fleet_process_index() == 3
+    assert identity.fleet_process_count() == 4
+    assert (
+        identity.member_artifact_path("x/t.jsonl") == "x/t.proc-3.jsonl"
+    )
+    # malformed env degrades to "not a fleet", never raises
+    monkeypatch.setenv(identity.ENV_PROC_ID, "banana")
+    assert identity.fleet_process_index() is None
+    monkeypatch.delenv(identity.ENV_PROC_ID)
+    monkeypatch.delenv(identity.ENV_PROC_COUNT)
+    # single-process jax (the test env) is not a fleet either
+    assert identity.fleet_process_index() is None
+    assert identity.fleet_process_count() is None
+
+
+def test_configure_from_env_suffixes_per_member(tmp_path, monkeypatch):
+    monkeypatch.setenv(identity.ENV_PROC_ID, "1")
+    monkeypatch.setenv(identity.ENV_PROC_COUNT, "2")
+    monkeypatch.setenv("PHOTON_TRACE_OUT", str(tmp_path / "trace.jsonl"))
+    monkeypatch.setenv(
+        "PHOTON_TELEMETRY_OUT", str(tmp_path / "telemetry.jsonl")
+    )
+    telemetry.configure_from_env()
+    with telemetry.span("fit"):
+        pass
+    assert (tmp_path / "trace.proc-1.jsonl").exists()
+    assert not (tmp_path / "trace.jsonl").exists()
+    header = json.loads(
+        (tmp_path / "trace.proc-1.jsonl").read_text().splitlines()[0]
+    )
+    assert header["type"] == "trace_header"
+    assert header["process_index"] == 1
+    assert header["num_processes"] == 2
+    assert isinstance(header["hostname"], str)
+    # the monotonic<->epoch anchor pair fleet alignment rides on
+    assert isinstance(header["anchor_unix_s"], float)
+    assert "monotonic_anchor" in header
+
+
+def test_trace_header_single_process_has_no_member_fields(
+    tmp_path, monkeypatch
+):
+    monkeypatch.delenv(identity.ENV_PROC_ID, raising=False)
+    telemetry.configure(trace_out=str(tmp_path / "t.jsonl"))
+    header = json.loads(
+        (tmp_path / "t.jsonl").read_text().splitlines()[0]
+    )
+    assert "process_index" not in header
+    # hostname + anchor are ALWAYS recorded (harmless single-process,
+    # load-bearing for fleet alignment)
+    assert "hostname" in header and "anchor_unix_s" in header
+
+
+def test_metrics_flush_carries_member_identity(tmp_path, monkeypatch):
+    monkeypatch.setenv(identity.ENV_PROC_ID, "2")
+    telemetry.counter("progress.rows").inc(5)
+    out = tmp_path / "m.jsonl"
+    telemetry.flush_metrics(str(out))
+    line = json.loads(out.read_text().splitlines()[0])
+    assert line["process_index"] == 2
+    assert isinstance(line["hostname"], str)
+    # single-process lines stay identity-free (format pinned)
+    monkeypatch.delenv(identity.ENV_PROC_ID)
+    out2 = tmp_path / "m2.jsonl"
+    telemetry.flush_metrics(str(out2))
+    line2 = json.loads(out2.read_text().splitlines()[0])
+    assert "process_index" not in line2
+
+
+# ---------------------------------------------------------------------------
+# heartbeat proc field + tail parser
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_proc_field_only_inside_a_fleet(tmp_path, monkeypatch):
+    monkeypatch.delenv(identity.ENV_PROC_ID, raising=False)
+    hb = Heartbeat(interval=99.0)
+    line = hb.beat()
+    assert "proc" not in line  # single-process format pinned unchanged
+    monkeypatch.setenv(identity.ENV_PROC_ID, "1")
+    line = hb.beat()
+    assert line["proc"] == 1
+
+
+def test_tail_heartbeat_fields_reads_newest_valid_line(tmp_path):
+    path = tmp_path / "telemetry.proc-0.jsonl"
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"type": "metrics", "snapshot": {}}) + "\n")
+        for seq in (1, 2, 3):
+            fh.write(
+                json.dumps(
+                    {"type": "heartbeat", "seq": seq, "proc": 0,
+                     "uptime_s": seq * 1.0}
+                )
+                + "\n"
+            )
+        # a member hard-killed mid-write leaves a truncated last line
+        fh.write('{"type": "heartbeat", "seq": 4, "pro')
+    rec = tail_heartbeat_fields(str(path))
+    assert rec["seq"] == 3  # the truncated line is skipped, not fatal
+    assert tail_heartbeat_fields(str(path), expect_proc=0)["seq"] == 3
+    # attribution is REQUIRED when asked for: a mis-pointed file must
+    # read as silence, never as another member's progress
+    assert tail_heartbeat_fields(str(path), expect_proc=1) is None
+    assert tail_heartbeat_fields(str(tmp_path / "missing.jsonl")) is None
+
+
+def test_tail_heartbeat_fields_bounded_read(tmp_path):
+    path = tmp_path / "big.jsonl"
+    with open(path, "w") as fh:
+        for seq in range(5000):
+            fh.write(
+                json.dumps({"type": "heartbeat", "seq": seq, "proc": 0})
+                + "\n"
+            )
+    rec = tail_heartbeat_fields(str(path), max_bytes=512)
+    assert rec["seq"] == 4999  # newest line, from the bounded tail only
+
+
+# ---------------------------------------------------------------------------
+# collective-wait attribution
+# ---------------------------------------------------------------------------
+
+
+def test_collective_wait_noop_single_process():
+    from photon_ml_tpu.parallel import multihost
+
+    with multihost.collective_wait("test_label"):
+        pass
+    snap = telemetry.snapshot()
+    assert "comms.wait_calls" not in snap["counters"]
+    assert not telemetry.finished_spans("collective_wait")
+
+
+def test_collective_wait_records_span_and_histogram(monkeypatch):
+    from photon_ml_tpu.parallel import multihost
+
+    monkeypatch.setattr(multihost.jax, "process_count", lambda: 2)
+    with multihost.collective_wait("streaming_chunk_solve"):
+        pass
+    snap = telemetry.snapshot()
+    assert snap["counters"]["comms.wait_calls"] == 1
+    assert snap["counters"]["comms.wait_seconds_total"] >= 0.0
+    assert snap["histograms"]["comms.wait_s"]["count"] == 1
+    (span,) = telemetry.finished_spans("collective_wait")
+    assert span.attrs["label"] == "streaming_chunk_solve"
+    assert span.attrs["wait_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# FleetReport aggregation (synthetic member artifacts)
+# ---------------------------------------------------------------------------
+
+
+def _write_member(
+    directory,
+    proc: int,
+    *,
+    anchor_unix: float,
+    wait_s: float = None,
+    rows_per_sec: float = None,
+    mfu: float = None,
+    heartbeat_uptimes=(),
+    truncate_trace: bool = False,
+    write_metrics: bool = True,
+    rendezvous_end: float = None,
+):
+    """One member's artifact pair in the identity naming contract. The
+    truncate/no-metrics combination is EXACTLY the leftover shape a
+    hard-killed member (tools/chaos.py --fleet victim, os._exit 113)
+    produces: spans up to the death, a torn final line, no atexit flush."""
+    header = {
+        "type": "trace_header",
+        "wall_time": "2026-08-03T00:00:00+00:00",
+        "monotonic_anchor": 5.0,
+        "anchor_unix_s": anchor_unix,
+        "hostname": f"host{proc}",
+        "process_index": proc,
+        "num_processes": 2,
+    }
+    spans = [
+        {"type": "span", "id": 1, "parent": None, "name": "fit",
+         "ts": 6.0, "dur": 10.0, "thread": "MainThread", "attrs": {},
+         "events": []},
+    ]
+    if rendezvous_end is not None:
+        spans.append(
+            {"type": "span", "id": 2, "parent": 1,
+             "name": "checkpoint:save", "ts": rendezvous_end - 1.0,
+             "dur": 1.0, "thread": "MainThread",
+             "attrs": {"coordinated": True, "next_chunk": 1},
+             "events": []}
+        )
+    with open(
+        os.path.join(directory, f"trace.proc-{proc}.jsonl"), "w"
+    ) as fh:
+        fh.write(json.dumps(header) + "\n")
+        for s in spans:
+            fh.write(json.dumps(s) + "\n")
+        if truncate_trace:
+            fh.write('{"type": "span", "id": 99, "name": "torn')
+    with open(
+        os.path.join(directory, f"telemetry.proc-{proc}.jsonl"), "w"
+    ) as fh:
+        for i, up in enumerate(heartbeat_uptimes):
+            fh.write(
+                json.dumps(
+                    {"type": "heartbeat", "seq": i + 1, "proc": proc,
+                     "uptime_s": up}
+                )
+                + "\n"
+            )
+        if write_metrics:
+            counters = {"streaming_chunks": 4}
+            gauges = {}
+            if wait_s is not None:
+                counters["comms.wait_seconds_total"] = wait_s
+                counters["comms.wait_calls"] = 4
+            if rows_per_sec is not None:
+                gauges["progress.rows_per_sec"] = rows_per_sec
+            if mfu is not None:
+                # mfu derives from xla flops + peak + span time: fake the
+                # minimal counters/gauges RunReport needs
+                counters["xla.flops_total"] = mfu * 1e12 * 10.0
+                gauges["device.peak_flops"] = 1e12
+            fh.write(
+                json.dumps(
+                    {"type": "metrics",
+                     "wall_time": "2026-08-03T00:00:30+00:00",
+                     "process_index": proc,
+                     "snapshot": {"counters": counters, "gauges": gauges,
+                                  "histograms": {}}}
+                )
+                + "\n"
+            )
+
+
+def test_discover_member_streams_classifies_by_content(tmp_path):
+    _write_member(tmp_path, 0, anchor_unix=1000.0, wait_s=1.0)
+    streams = discover_member_streams(str(tmp_path))
+    assert set(streams) == {0}
+    assert streams[0]["trace"].endswith("trace.proc-0.jsonl")
+    assert streams[0]["telemetry"].endswith("telemetry.proc-0.jsonl")
+
+
+def test_fleet_report_rows_straggler_and_roundtrip(tmp_path):
+    # member 1 is the straggler: it waited least (everyone waited on it)
+    _write_member(
+        tmp_path, 0, anchor_unix=1000.0, wait_s=3.0, rows_per_sec=100.0,
+        mfu=0.30, heartbeat_uptimes=(1.0, 2.0, 3.0), rendezvous_end=9.0,
+    )
+    _write_member(
+        tmp_path, 1, anchor_unix=1002.0, wait_s=0.2, rows_per_sec=80.0,
+        mfu=0.20, heartbeat_uptimes=(1.0, 2.5), rendezvous_end=7.1,
+    )
+    report = FleetReport.load(str(tmp_path))
+    assert [m.process_index for m in report.members] == [0, 1]
+    assert report.lost_members() == []
+    # clock skew from the shared coordinated-save endpoint:
+    # abs end member1 = 1002 + (7.1 - 5) = 1004.1; member0 = 1000 + 4 = 1004
+    assert report.members[1].clock_skew_s == pytest.approx(0.1, abs=1e-6)
+
+    straggler = report.straggler()
+    assert straggler["process_index"] == 1
+    assert straggler["wait_s"] == pytest.approx(0.2)
+    assert straggler["fleet_max_wait_s"] == pytest.approx(3.0)
+
+    km = report.key_metrics()
+    assert km["fleet_rows_per_sec"] == pytest.approx(180.0)
+    assert km["fleet_collective_wait_s"] == pytest.approx(3.2)
+    # wait fraction over both members' traced run time (10 s each)
+    assert km["fleet_collective_wait_fraction"] == pytest.approx(
+        3.2 / 20.0, abs=1e-5
+    )
+    assert km["fleet_mfu_spread"] == pytest.approx(0.1, abs=1e-6)
+    assert km["fleet_lost_members"] == 0.0
+
+    # JSON round-trip: per-member rows + straggler + key metrics survive
+    doc = json.loads(json.dumps(report.to_json(), default=str))
+    assert doc["type"] == "fleet_report"
+    assert [r["process_index"] for r in doc["members"]] == [0, 1]
+    by_proc = {r["process_index"]: r for r in doc["members"]}
+    assert by_proc[0]["collective_wait_s"] == pytest.approx(3.0)
+    assert by_proc[0]["status"] == "ok"
+    assert by_proc[1]["hostname"] == "host1"
+    assert doc["straggler"]["process_index"] == 1
+    assert doc["key_metrics"]["fleet_rows_per_sec"] == pytest.approx(180.0)
+
+    md = report.to_markdown()
+    assert "Straggler: member 1" in md
+    assert "| 0 (host0) | ok |" in md
+
+
+def test_fleet_report_merged_spans_align_on_anchors(tmp_path):
+    _write_member(tmp_path, 0, anchor_unix=1000.0, rendezvous_end=9.0)
+    _write_member(tmp_path, 1, anchor_unix=1002.0, rendezvous_end=7.0)
+    report = FleetReport.load(str(tmp_path))
+    merged = report.merged_spans()
+    fits = [s for s in merged if s["name"] == "fit"]
+    assert {s["process_index"] for s in fits} == {0, 1}
+    # member 0 fit starts at 1000 + (6-5) = 1001; member 1 at
+    # 1002 + 1 - skew(1004-1004=0... rendezvous: m1=1002+2=1004, m0=1004)
+    by_proc = {s["process_index"]: s["abs_ts"] for s in fits}
+    assert by_proc[0] == pytest.approx(1001.0, abs=1e-3)
+    assert by_proc[1] == pytest.approx(1003.0, abs=1e-3)
+
+
+def test_fleet_report_degraded_killed_member_marked_lost(tmp_path):
+    """The chaos-matrix leftover shape: the victim's trace is truncated
+    mid-line and its final metrics snapshot never flushed (os._exit).
+    The report must render partial — member marked lost — never crash,
+    never silently read as complete."""
+    _write_member(
+        tmp_path, 0, anchor_unix=1000.0, wait_s=2.0, rows_per_sec=50.0,
+        heartbeat_uptimes=(1.0, 2.0),
+    )
+    _write_member(
+        tmp_path, 1, anchor_unix=1000.1, truncate_trace=True,
+        write_metrics=False, heartbeat_uptimes=(1.0,),
+    )
+    report = FleetReport.load(str(tmp_path))
+    assert report.lost_members() == [1]
+    rows = {r["process_index"]: r for r in report.rows()}
+    assert rows[1]["status"] == "lost"
+    assert rows[0]["status"] == "ok"
+    # the survivor's data still aggregates; the victim's surviving
+    # heartbeats still render
+    assert rows[1]["heartbeats"] == 1
+    km = report.key_metrics()
+    assert km["fleet_lost_members"] == 1.0
+    assert km["fleet_rows_per_sec"] == pytest.approx(50.0)
+    md = report.to_markdown()
+    assert "lost" in md
+    json.dumps(report.to_json(), default=str)  # JSON-safe throughout
+
+
+def test_fleet_report_member_with_no_artifacts_is_synthesized_lost(
+    tmp_path,
+):
+    """A member that never wrote ANYTHING (killed before its first span)
+    still gets a row: fleet size is known from a peer's header."""
+    _write_member(tmp_path, 0, anchor_unix=1000.0, wait_s=1.0)
+    report = FleetReport.load(str(tmp_path))
+    assert report.num_processes == 2
+    assert report.lost_members() == [1]
+    rows = {r["process_index"]: r for r in report.rows()}
+    assert rows[1]["artifacts"] == {"trace": None, "telemetry": None}
+
+
+def test_discover_falls_back_to_newest_generation_dir(tmp_path):
+    """`--fleet <workdir>` on a supervisor directory finds the NEWEST
+    generation's streams under telemetry/gen<g> (the tools/fleet.py
+    layout — relaunch generations renumber members, so generations
+    never share a directory)."""
+    gen0 = tmp_path / "telemetry" / "gen0"
+    gen1 = tmp_path / "telemetry" / "gen1"
+    gen0.mkdir(parents=True)
+    gen1.mkdir(parents=True)
+    _write_member(gen0, 0, anchor_unix=1000.0, wait_s=1.0)
+    _write_member(gen0, 1, anchor_unix=1000.0, wait_s=1.0)
+    _write_member(gen1, 0, anchor_unix=2000.0, wait_s=2.0)
+    streams = discover_member_streams(str(tmp_path))
+    assert set(streams) == {0}  # gen1: the survivor fleet only
+    assert "gen1" in streams[0]["trace"]
+    # pointing at a generation dir directly still works
+    assert set(discover_member_streams(str(gen0))) == {0, 1}
+
+
+def test_fleet_report_empty_dir_has_no_members(tmp_path):
+    report = FleetReport.load(str(tmp_path))
+    assert report.members == []
+    assert report.key_metrics()["fleet_members"] == 0.0
+
+
+def test_fleet_report_compare_gates_aggregated_metrics(tmp_path):
+    _write_member(
+        tmp_path, 0, anchor_unix=1000.0, wait_s=3.0, rows_per_sec=100.0,
+    )
+    _write_member(
+        tmp_path, 1, anchor_unix=1000.0, wait_s=0.5, rows_per_sec=100.0,
+    )
+    report = FleetReport.load(str(tmp_path))
+    # identical baseline: nothing regresses
+    deltas = report.compare(report.to_json())
+    assert deltas and not any(d.regressed for d in deltas)
+    # a baseline with much lower wait fraction: ours regressed (higher
+    # wait is WORSE — the lower-is-better direction)
+    km = report.key_metrics()
+    baseline = dict(km)
+    baseline["fleet_collective_wait_fraction"] = (
+        km["fleet_collective_wait_fraction"] / 10.0
+    )
+    regressed = {
+        d.metric for d in report.compare(baseline) if d.regressed
+    }
+    assert "fleet_collective_wait_fraction" in regressed
+    # and a baseline with much higher throughput: rows/s regressed
+    baseline = dict(km)
+    baseline["fleet_rows_per_sec"] = km["fleet_rows_per_sec"] * 10.0
+    regressed = {
+        d.metric for d in report.compare(baseline) if d.regressed
+    }
+    assert "fleet_rows_per_sec" in regressed
+
+
+# ---------------------------------------------------------------------------
+# cli report --fleet
+# ---------------------------------------------------------------------------
+
+
+def test_cli_report_fleet_renders_and_gates(tmp_path, capsys):
+    from photon_ml_tpu.cli.report import main as report_main
+
+    fleet_dir = tmp_path / "fleet_artifacts"
+    fleet_dir.mkdir()
+    _write_member(
+        fleet_dir, 0, anchor_unix=1000.0, wait_s=3.0, rows_per_sec=100.0,
+        heartbeat_uptimes=(1.0, 2.0),
+    )
+    _write_member(
+        fleet_dir, 1, anchor_unix=1000.0, wait_s=0.1, rows_per_sec=90.0,
+        heartbeat_uptimes=(1.0,),
+    )
+    out_md = tmp_path / "fleet.md"
+    out_json = tmp_path / "fleet.json"
+    rc = report_main([
+        "--fleet", str(fleet_dir), "--out", str(out_md),
+        "--json", str(out_json),
+    ])
+    assert rc == 0
+    md = out_md.read_text()
+    assert "# Fleet report" in md and "Straggler: member 1" in md
+    doc = json.loads(out_json.read_text())
+    assert doc["type"] == "fleet_report"
+    assert len(doc["members"]) == 2
+
+    # --compare --fail-on-regress on the aggregated key metrics: exit 3
+    # when the wait fraction blew up vs baseline
+    baseline = dict(doc["key_metrics"])
+    baseline["fleet_collective_wait_fraction"] /= 10.0
+    base_path = tmp_path / "baseline.json"
+    base_path.write_text(json.dumps({"key_metrics": baseline}))
+    rc = report_main([
+        "--fleet", str(fleet_dir), "--compare", str(base_path),
+        "--fail-on-regress",
+    ])
+    assert rc == 3
+    # self-compare passes
+    rc = report_main([
+        "--fleet", str(fleet_dir), "--compare", str(out_json),
+        "--fail-on-regress",
+    ])
+    assert rc == 0
+    capsys.readouterr()
+
+
+def test_cli_report_fleet_usage_errors(tmp_path, capsys):
+    from photon_ml_tpu.cli.report import main as report_main
+
+    with pytest.raises(SystemExit):
+        report_main(["--fleet", str(tmp_path), "--trace", "x.jsonl"])
+    # an empty directory is an error, not an empty report
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert report_main(["--fleet", str(empty)]) == 1
+    assert report_main(["--fleet", str(tmp_path / "missing")]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# cli train explicit-flag suffixing (satellite: env path and flag path
+# must agree on the member naming contract)
+# ---------------------------------------------------------------------------
+
+
+def test_train_explicit_artifact_flags_suffix_per_member(
+    tmp_path, monkeypatch
+):
+    """`cli train --trace-out/--telemetry-out/--report-out` under a fleet
+    identity writes per-member suffixed paths — the same contract
+    configure_from_env applies to PHOTON_*_OUT — instead of
+    last-writer-wins (the real 2-process gloo run is exercised in
+    tests/test_fleet_observability.py via the env path)."""
+    from photon_ml_tpu.cli.train import run
+
+    data = tmp_path / "train.libsvm"
+    lines = []
+    for i in range(32):
+        label = i % 2
+        lines.append(f"{label} 1:{(i % 5) * 0.2:.1f} 2:{(i % 3) * 0.5:.1f}")
+    data.write_text("\n".join(lines) + "\n")
+    config = {
+        "task": "logistic",
+        "input": {"format": "libsvm", "paths": str(data)},
+        "coordinates": {
+            "fixed": {
+                "shard_name": "features",
+                "optimizer": {"max_iterations": 3},
+            }
+        },
+        "num_iterations": 1,
+        "heartbeat": False,
+        "trace_out": str(tmp_path / "run.trace.jsonl"),
+        "telemetry_out": str(tmp_path / "run.telemetry.jsonl"),
+        "report_out": str(tmp_path / "run.report.md"),
+    }
+    monkeypatch.setenv(identity.ENV_PROC_ID, "1")
+    monkeypatch.setenv(identity.ENV_PROC_COUNT, "2")
+    summary = run(config)
+    assert (tmp_path / "run.trace.proc-1.jsonl").exists()
+    assert not (tmp_path / "run.trace.jsonl").exists()
+    assert (tmp_path / "run.telemetry.proc-1.jsonl").exists()
+    assert summary["report"] == str(tmp_path / "run.report.proc-1.md")
+    assert (tmp_path / "run.report.proc-1.md").exists()
+    assert (tmp_path / "run.report.proc-1.json").exists()
+    header = json.loads(
+        (tmp_path / "run.trace.proc-1.jsonl").read_text().splitlines()[0]
+    )
+    assert header["process_index"] == 1

@@ -1,0 +1,168 @@
+"""Live supervisor status (photon_ml_tpu/parallel/fleet_status.py):
+snapshot semantics, atomic writes, the ``fleet.status_write`` fault seam,
+and the HTTP arm. The seam's failure contract is the load-bearing part:
+status is observability, never control — an unwritable status file must
+not take the supervisor down with it."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from photon_ml_tpu import faults, telemetry
+from photon_ml_tpu.parallel import multihost
+from photon_ml_tpu.parallel.fleet_status import FleetStatusWriter
+
+
+def _touch_heartbeat(fleet_dir: str, pid: int) -> None:
+    os.makedirs(fleet_dir, exist_ok=True)
+    path = multihost.heartbeat_path(fleet_dir, pid)
+    with open(path, "a"):
+        os.utime(path, None)
+
+
+def test_snapshot_liveness_from_heartbeat_mtimes(tmp_path):
+    fleet_dir = str(tmp_path / "fleet")
+    _touch_heartbeat(fleet_dir, 0)  # fresh
+    _touch_heartbeat(fleet_dir, 1)
+    # stale member 1: beat "30s ago"
+    past = time.time() - 30.0
+    os.utime(multihost.heartbeat_path(fleet_dir, 1), (past, past))
+    writer = FleetStatusWriter(
+        fleet_dir=fleet_dir, num_processes=3, heartbeat_deadline_s=5.0,
+    )
+    snap = writer.snapshot()
+    members = snap["members"]
+    assert members["0"]["alive"] is True
+    assert members["0"]["heartbeat_age_s"] < 5.0
+    assert members["1"]["alive"] is False  # stale beyond deadline
+    assert members["1"]["heartbeat_age_s"] >= 29.0
+    assert members["2"]["alive"] is False  # never beat
+    assert members["2"]["heartbeat_age_s"] is None
+    assert snap["alive_members"] == [0]
+    assert snap["type"] == "fleet_status"
+
+
+def test_snapshot_exited_member_not_alive_and_update_merges(tmp_path):
+    fleet_dir = str(tmp_path / "fleet")
+    _touch_heartbeat(fleet_dir, 0)
+    writer = FleetStatusWriter(
+        fleet_dir=fleet_dir, num_processes=1, heartbeat_deadline_s=5.0,
+    )
+    writer.update(rcs={0: 113}, deaths=[0], generation=1, relaunches=1,
+                  death_history=[{"generation": 0, "process_id": 0}])
+    snap = writer.snapshot()
+    # a fresh heartbeat file does NOT make an exited member alive
+    assert snap["members"]["0"]["alive"] is False
+    assert snap["members"]["0"]["rc"] == 113
+    assert snap["members"]["0"]["lost"] is True
+    assert snap["generation"] == 1 and snap["relaunches"] == 1
+    assert snap["deaths_total"] == 1
+    # the cumulative record survives a per-generation deaths=[] reset
+    # (run_fleet pushes it; a recovered run's FINAL snapshot must still
+    # say a member was lost along the way)
+    writer.update(deaths=[], generation=2)
+    snap = writer.snapshot()
+    assert snap["deaths"] == []
+    assert snap["death_history"] == [{"generation": 0, "process_id": 0}]
+    assert snap["deaths_total"] == 1
+
+
+def test_snapshot_includes_member_heartbeat_fields(tmp_path):
+    fleet_dir = str(tmp_path / "fleet")
+    _touch_heartbeat(fleet_dir, 0)
+    telemetry_out = str(tmp_path / "telemetry.jsonl")
+    with open(str(tmp_path / "telemetry.proc-0.jsonl"), "w") as fh:
+        fh.write(json.dumps(
+            {"type": "heartbeat", "seq": 7, "proc": 0, "rows_per_s": 9.0}
+        ) + "\n")
+    writer = FleetStatusWriter(
+        fleet_dir=fleet_dir, num_processes=1, heartbeat_deadline_s=5.0,
+        telemetry_out=telemetry_out,
+    )
+    snap = writer.snapshot()
+    hb = snap["members"]["0"]["last_heartbeat"]
+    assert hb["seq"] == 7 and hb["rows_per_s"] == 9.0
+
+
+def test_write_once_is_atomic_json(tmp_path):
+    fleet_dir = str(tmp_path / "fleet")
+    _touch_heartbeat(fleet_dir, 0)
+    status_file = str(tmp_path / "status.json")
+    writer = FleetStatusWriter(
+        fleet_dir=fleet_dir, num_processes=1, heartbeat_deadline_s=5.0,
+        status_file=status_file,
+    )
+    snap = writer.write_once()
+    assert snap is not None
+    on_disk = json.loads(open(status_file).read())
+    assert on_disk["alive_members"] == [0]
+    # atomic-write discipline: no tmp debris next to the snapshot
+    assert not os.path.exists(status_file + ".tmp")
+    assert telemetry.snapshot()["counters"]["fleet.status_writes"] == 1
+
+
+def test_status_write_fault_seam_io_is_absorbed(tmp_path):
+    """An `io` rule at fleet.status_write (disk flaking on the status
+    file) is absorbed: write_once returns None, counts the error, and
+    the NEXT write succeeds — status failures never stop supervision."""
+    fleet_dir = str(tmp_path / "fleet")
+    _touch_heartbeat(fleet_dir, 0)
+    status_file = str(tmp_path / "status.json")
+    writer = FleetStatusWriter(
+        fleet_dir=fleet_dir, num_processes=1, heartbeat_deadline_s=5.0,
+        status_file=status_file,
+    )
+    faults.install_plan(faults.FaultPlan(
+        [faults.FaultRule("fleet.status_write", action="io", nth=1)]
+    ))
+    try:
+        assert writer.write_once() is None  # injected write failure
+        assert not os.path.exists(status_file)
+        snap = telemetry.snapshot()["counters"]
+        assert snap["fleet.status_write_errors"] == 1
+        assert snap["faults.injected.fleet.status_write"] == 1
+        assert writer.write_once() is not None  # next cadence recovers
+        assert json.loads(open(status_file).read())["alive_members"] == [0]
+    finally:
+        faults.clear_plan()
+
+
+def test_status_writer_thread_and_http_server(tmp_path):
+    fleet_dir = str(tmp_path / "fleet")
+    _touch_heartbeat(fleet_dir, 0)
+    status_file = str(tmp_path / "status.json")
+    writer = FleetStatusWriter(
+        fleet_dir=fleet_dir, num_processes=1, heartbeat_deadline_s=5.0,
+        status_file=status_file, port=0, interval_s=0.05,
+    )
+    with writer:
+        assert writer.port  # ephemeral port bound
+        deadline = time.monotonic() + 5.0
+        while not os.path.exists(status_file):
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        url = f"http://127.0.0.1:{writer.port}/statusz"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            doc = json.loads(resp.read())
+        assert doc["type"] == "fleet_status"
+        assert doc["alive_members"] == [0]
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{writer.port}/nope", timeout=5
+            )
+        writer.update(outcome="complete")
+    # stop() writes the final state
+    assert json.loads(open(status_file).read())["outcome"] == "complete"
+
+
+def test_status_writer_rejects_bad_interval(tmp_path):
+    with pytest.raises(ValueError, match="interval_s"):
+        FleetStatusWriter(
+            fleet_dir=str(tmp_path), num_processes=1,
+            heartbeat_deadline_s=5.0, interval_s=0.0,
+        )

@@ -316,3 +316,44 @@ def test_gate_skips_multichip_metrics_missing_from_baseline(capsys):
     assert "multichip_glm_rows_per_sec: new metric" in err
     assert "skipped" in err
     assert "truncated, not gated" in err
+
+
+def test_gate_fleet_observability_metrics_lower_is_better(capsys):
+    """The fleet_* observability metrics regress UPWARD (more waiting,
+    wider MFU spread = worse) and skip-with-note against baselines that
+    predate them — the established new-metric gate path."""
+    import bench_multichip
+    import bench_suite
+
+    assert "fleet_collective_wait_fraction" in bench_multichip.MULTICHIP_METRICS
+    assert "fleet_mfu_spread" in bench_multichip.MULTICHIP_METRICS
+    baseline = {
+        "fleet_collective_wait_fraction": 0.1,
+        "fleet_mfu_spread": 0.05,
+    }
+    # a RISE is the regression
+    rc = bench_suite.run_gate(
+        {"fleet_collective_wait_fraction": 0.5, "fleet_mfu_spread": 0.05},
+        baseline, threshold=0.2,
+    )
+    assert rc == bench_suite.GATE_EXIT_CODE
+    capsys.readouterr()
+    # a drop (less waiting) passes
+    rc = bench_suite.run_gate(
+        {"fleet_collective_wait_fraction": 0.05, "fleet_mfu_spread": 0.01},
+        baseline, threshold=0.2,
+    )
+    assert rc == 0
+    capsys.readouterr()
+    # baselines predating the fleet metrics: skipped with a note
+    rc = bench_suite.run_gate(
+        {
+            "fleet_collective_wait_fraction": 0.5,
+            "linreg_tron_1Mx10K_rows_per_sec_per_chip": 100.0,
+        },
+        {"linreg_tron_1Mx10K_rows_per_sec_per_chip": 100.0},
+        threshold=0.2,
+    )
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "fleet_collective_wait_fraction: new metric" in err

@@ -48,6 +48,13 @@ SYNC_SEEDS = (
     # the event-loop request path: a sync here stalls EVERY connection
     "photon_ml_tpu.serving.aio.AsyncScoringServer._route",
     "photon_ml_tpu.serving.aio.AsyncScoringServer._score",
+    # fleet observability (ISSUE 13): the supervisor's status thread and
+    # its telemetry tail parser are pure-filesystem monitors — a device
+    # sync here would couple "is the fleet alive?" to a possibly-wedged
+    # device, exactly when the operator needs the answer most
+    "photon_ml_tpu.telemetry.progress.tail_heartbeat_fields",
+    "photon_ml_tpu.parallel.fleet_status.FleetStatusWriter.snapshot",
+    "photon_ml_tpu.parallel.fleet_status.FleetStatusWriter.write_once",
 )
 
 #: The sanctioned device->host crossing: its body is the accounted fetch.

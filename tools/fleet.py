@@ -32,9 +32,22 @@ An external SIGTERM to ONE member (preemption) propagates through the
 same boundary agreement: every member writes the coordinated final
 checkpoint and exits 75 — interrupted, not relaunched.
 
+Fleet observability (ISSUE 13): workers are launched with
+``PHOTON_PROC_ID``/``PHOTON_TRACE_OUT``/``PHOTON_TELEMETRY_OUT`` so each
+member writes its OWN suffixed artifact stream, one directory per
+generation (``<workdir>/telemetry/gen<g>/trace.proc-<i>.jsonl``, … —
+relaunches renumber members, so generations must not share files) plus
+progress heartbeats — the input of ``cli report --fleet``; ``--status-file`` /
+``--status-port`` publish the live supervisor snapshot an operator polls
+(member liveness from heartbeat mtimes, last heartbeat fields per
+member, deaths/relaunches, generation —
+``photon_ml_tpu.parallel.fleet_status``).
+
 CLI::
 
     python -m tools.fleet --workdir /tmp/fleet                # supervise
+    python -m tools.fleet --workdir /tmp/fleet \
+        --status-file /tmp/fleet/status.json --status-port 0  # + live status
     python -m tools.fleet --worker --proc 0 --nproc 2 ...     # (internal)
 
 tools/chaos.py drives this harness for the DISTRIBUTED crash matrix:
@@ -131,6 +144,10 @@ class FleetSpec:
     sigterm_process: int = 0
     #: test-only: stretch each chunk boundary so mid-fit signals land
     chunk_sleep_s: float = 0.0
+    #: which member the chunk sleep applies to (-1 = all) — sleeping ONE
+    #: member makes it arrive last at every fleet_any barrier, i.e. a
+    #: deterministic straggler for the collective-wait attribution tests
+    chunk_sleep_proc: int = -1
     #: how a lost host is recognized: "exit_code" marks a member lost the
     #: moment it exits with the injection code 113; "heartbeat" ignores
     #: that fast path and waits for the member's ``proc-<i>.alive`` file
@@ -138,10 +155,49 @@ class FleetSpec:
     #: ``fleet.heartbeat`` row runs this mode so staleness detection is
     #: itself crash-proven)
     detect_by: str = "exit_code"
+    #: per-member telemetry artifact streams (fleet observability): when
+    #: True, every worker gets PHOTON_PROC_ID/PHOTON_TRACE_OUT/
+    #: PHOTON_TELEMETRY_OUT pointed into ``telemetry_dir`` (default
+    #: <workdir>/telemetry), so the run leaves trace.proc-<i>.jsonl +
+    #: telemetry.proc-<i>.jsonl behind — the input of
+    #: ``cli report --fleet``
+    telemetry: bool = True
+    telemetry_dir: Optional[str] = None
+    #: worker-side progress-heartbeat cadence (the telemetry JSONL lines
+    #: the live status tail-parses; distinct from the liveness-file touch)
+    progress_heartbeat_every_s: float = 1.0
+    #: live supervisor status (photon_ml_tpu.parallel.fleet_status): a
+    #: JSON snapshot written atomically to status_file and/or served on
+    #: http://127.0.0.1:<status_port>/statusz every status_interval_s
+    status_file: Optional[str] = None
+    status_port: Optional[int] = None
+    status_interval_s: float = 1.0
+
+    def resolved_telemetry_dir(self) -> Optional[str]:
+        if not self.telemetry:
+            return None
+        return self.telemetry_dir or os.path.join(self.workdir, "telemetry")
+
+    def generation_telemetry_dir(self, generation: int) -> Optional[str]:
+        """One artifact directory PER GENERATION (``telemetry/gen0``, …):
+        a relaunched fleet renumbers its members, so an unqualified path
+        would let the new proc 0 truncate the DEAD member's stream and
+        FleetReport would read the killed member as complete. One
+        directory = one generation's fleet is the aggregation contract
+        (``cli report --fleet <dir>/gen<g>``)."""
+        d = self.resolved_telemetry_dir()
+        return None if d is None else os.path.join(d, f"gen{generation}")
+
+    def telemetry_out_base(self, generation: int) -> Optional[str]:
+        """The UNSUFFIXED telemetry JSONL path generation ``g``'s workers
+        point PHOTON_TELEMETRY_OUT at (identity suffixes it per member);
+        also what the status writer tail-parses."""
+        d = self.generation_telemetry_dir(generation)
+        return None if d is None else os.path.join(d, "telemetry.jsonl")
 
 
 def _worker_env(
-    spec: FleetSpec, proc: int, armed: bool
+    spec: FleetSpec, proc: int, nproc: int, armed: bool, generation: int
 ) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -157,6 +213,18 @@ def _worker_env(
     env.pop("PHOTON_FAULT_PLAN", None)
     if armed and spec.victim_plan is not None:
         env["PHOTON_FAULT_PLAN"] = json.dumps(spec.victim_plan)
+    # fleet identity + per-member artifact streams: identity BEFORE jax
+    # imports (telemetry.identity reads PHOTON_PROC_ID), artifact env
+    # suffixed per member by telemetry.configure_from_env in the worker
+    env["PHOTON_PROC_ID"] = str(proc)
+    env["PHOTON_PROC_COUNT"] = str(nproc)
+    telemetry_dir = spec.generation_telemetry_dir(generation)
+    if telemetry_dir is not None:
+        env["PHOTON_TRACE_OUT"] = os.path.join(telemetry_dir, "trace.jsonl")
+        env["PHOTON_TELEMETRY_OUT"] = spec.telemetry_out_base(generation)
+    else:
+        env.pop("PHOTON_TRACE_OUT", None)
+        env.pop("PHOTON_TELEMETRY_OUT", None)
     return env
 
 
@@ -175,6 +243,9 @@ def _launch_generation(
 ) -> list[_Member]:
     fleet_dir = os.path.join(spec.workdir, "fleet")
     os.makedirs(fleet_dir, exist_ok=True)
+    telemetry_dir = spec.generation_telemetry_dir(generation)
+    if telemetry_dir is not None:
+        os.makedirs(telemetry_dir, exist_ok=True)
     # stale liveness files from the previous generation must not mask a
     # new member's death (mtime staleness is the signal)
     for name in os.listdir(fleet_dir):
@@ -199,12 +270,15 @@ def _launch_generation(
             "--port", str(port), "--dir", spec.workdir,
             "--quorum-timeout", str(spec.quorum_timeout_s),
             "--heartbeat-every", str(spec.heartbeat_every_s),
+            "--progress-heartbeat-every",
+            str(spec.progress_heartbeat_every_s),
             "--chunk-sleep", str(spec.chunk_sleep_s),
+            "--chunk-sleep-proc", str(spec.chunk_sleep_proc),
         ]
         with open(out_path, "wb") as out, open(err_path, "wb") as err:
             proc = subprocess.Popen(
                 argv,
-                env=_worker_env(spec, pid, armed),
+                env=_worker_env(spec, pid, nproc, armed, generation),
                 cwd=_repo_root(),
                 stdout=out,
                 stderr=err,
@@ -223,7 +297,8 @@ def _signal_all(members: list[_Member], sig) -> None:
 
 
 def _supervise_generation(
-    spec: FleetSpec, generation: int, nproc: int, deadline: float
+    spec: FleetSpec, generation: int, nproc: int, deadline: float,
+    status=None,
 ) -> dict:
     """Run one fleet generation to completion; the per-generation record
     (exit codes, detected deaths, whether escalation was needed)."""
@@ -233,6 +308,12 @@ def _supervise_generation(
     members = _launch_generation(
         spec, generation, nproc, arm_victim=generation == 0
     )
+    if status is not None:
+        # per-generation state resets; the cumulative death_history is
+        # run_fleet's to maintain (it survives relaunches)
+        status.update(generation=generation, num_processes=nproc,
+                      rcs={}, deaths=[], outcome=None,
+                      telemetry_out=spec.telemetry_out_base(generation))
     started = time.monotonic()
     sigterm_sent = False
     sigterm_anchor: Optional[float] = None
@@ -332,6 +413,15 @@ def _supervise_generation(
                 for m in alive:
                     escalated.append(m.process_id)
                 _signal_all(members, signal.SIGKILL)
+            if status is not None:
+                # keep the live snapshot truthful mid-generation: exit
+                # codes and detected deaths as they land (liveness itself
+                # is pulled from heartbeat mtimes by the status thread)
+                status.update(
+                    rcs={m.process_id: m.rc for m in members
+                         if m.rc is not None},
+                    deaths=[m.process_id for m in members if m.lost_host],
+                )
             if not alive:
                 break
             time.sleep(0.05)
@@ -393,34 +483,80 @@ def run_fleet(spec: FleetSpec) -> dict:
     generations = []
     relaunches = 0
     report: dict = {"workdir": spec.workdir, "generations": generations}
-    while True:
-        gen = _supervise_generation(spec, len(generations), nproc, deadline)
-        generations.append(gen)
-        if gen.get("deaths"):
-            telemetry.counter("recovery.fleet_member_deaths").inc(
-                len(gen["deaths"])
+    status = None
+    if spec.status_file is not None or spec.status_port is not None:
+        from photon_ml_tpu.parallel.fleet_status import FleetStatusWriter
+
+        status = FleetStatusWriter(
+            fleet_dir=os.path.join(spec.workdir, "fleet"),
+            num_processes=nproc,
+            heartbeat_deadline_s=spec.heartbeat_deadline_s,
+            status_file=spec.status_file,
+            port=spec.status_port,
+            telemetry_out=spec.telemetry_out_base(0),
+            interval_s=spec.status_interval_s,
+        ).start()
+        report["status_port"] = status.port
+        report["status_file"] = spec.status_file
+    death_history: list = []
+    try:
+        while True:
+            gen = _supervise_generation(
+                spec, len(generations), nproc, deadline, status=status
             )
-        if gen["outcome"] == "complete":
-            report.update(ok=True, interrupted=False)
-            break
-        if gen["outcome"] == "interrupted":
-            report.update(ok=False, interrupted=True)
-            break
-        if gen["outcome"] in ("timeout", "failed") and not gen.get("deaths"):
-            report.update(ok=False, interrupted=False)
-            break
-        survivors = nproc - len(gen["deaths"])
-        if survivors < 1 or relaunches >= spec.max_relaunches:
-            report.update(ok=False, interrupted=False)
-            break
-        relaunches += 1
-        telemetry.counter("recovery.fleet_relaunches").inc()
-        nproc = survivors
+            generations.append(gen)
+            death_history.extend(
+                {"generation": gen["generation"], "process_id": pid}
+                for pid in gen.get("deaths") or ()
+            )
+            if status is not None:
+                status.update(
+                    rcs=gen["rcs"], deaths=gen.get("deaths") or [],
+                    death_history=list(death_history),
+                    outcome=gen["outcome"],
+                )
+            if gen.get("deaths"):
+                telemetry.counter("recovery.fleet_member_deaths").inc(
+                    len(gen["deaths"])
+                )
+            if gen["outcome"] == "complete":
+                report.update(ok=True, interrupted=False)
+                break
+            if gen["outcome"] == "interrupted":
+                report.update(ok=False, interrupted=True)
+                break
+            if gen["outcome"] in ("timeout", "failed") and not gen.get(
+                "deaths"
+            ):
+                report.update(ok=False, interrupted=False)
+                break
+            survivors = nproc - len(gen["deaths"])
+            if survivors < 1 or relaunches >= spec.max_relaunches:
+                report.update(ok=False, interrupted=False)
+                break
+            relaunches += 1
+            telemetry.counter("recovery.fleet_relaunches").inc()
+            if status is not None:
+                status.update(relaunches=relaunches)
+            nproc = survivors
+    finally:
+        if status is not None:
+            status.stop()
     report["relaunches"] = relaunches
     report["deaths_total"] = sum(
         len(g.get("deaths") or ()) for g in generations
     )
     report["final_path"] = os.path.join(spec.workdir, "final.npy")
+    if spec.resolved_telemetry_dir() is not None:
+        # one artifact dir PER GENERATION (relaunches renumber members);
+        # `telemetry_dir` points at the newest generation's — the one a
+        # completed run's fleet report reads
+        dirs = [
+            spec.generation_telemetry_dir(g)
+            for g in range(len(generations))
+        ]
+        report["telemetry_dirs"] = dirs
+        report["telemetry_dir"] = dirs[-1]
     return report
 
 
@@ -472,10 +608,15 @@ def _worker_main(args) -> int:
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
-    from photon_ml_tpu import faults
+    from photon_ml_tpu import faults, telemetry
     from photon_ml_tpu.parallel import multihost
 
     faults.warn_if_armed()
+    # per-member artifact streams: PHOTON_PROC_ID is already in this
+    # worker's env (set by the supervisor BEFORE jax existed), so the
+    # trace/telemetry sinks open per-member suffixed files and the trace
+    # header records this member's identity + epoch anchor
+    telemetry.configure_from_env()
     if args.nproc > 1:
         multihost.initialize(
             multihost.DistributedConfig(
@@ -487,6 +628,17 @@ def _worker_main(args) -> int:
             )
         )
         assert jax.process_count() == args.nproc
+    # the progress heartbeat starts only AFTER the distributed client is
+    # up: a beat probes memory.hbm_stats() -> jax.devices(), and
+    # initializing the backend while jax.distributed.initialize is still
+    # rendezvousing would wedge the fleet on local-only devices
+    progress_heartbeat = None
+    telemetry_out = os.environ.get("PHOTON_TELEMETRY_OUT")
+    if telemetry_out and args.progress_heartbeat_every > 0:
+        progress_heartbeat = telemetry.Heartbeat(
+            interval=args.progress_heartbeat_every,
+            jsonl_path=telemetry.member_artifact_path(telemetry_out),
+        ).start()
     heartbeat = multihost.HeartbeatWriter(
         os.path.join(args.dir, "fleet"),
         args.proc,
@@ -496,6 +648,8 @@ def _worker_main(args) -> int:
         return _worker_fit(args, np)
     finally:
         heartbeat.stop()
+        if progress_heartbeat is not None:
+            progress_heartbeat.stop()
 
 
 def _worker_fit(args, np) -> int:
@@ -569,7 +723,7 @@ def _worker_fit(args, np) -> int:
         start_chunk = 0
 
     def should_stop() -> bool:
-        if args.chunk_sleep > 0:
+        if args.chunk_sleep > 0 and args.chunk_sleep_proc in (-1, args.proc):
             time.sleep(args.chunk_sleep)
         # fleet-consistent agreement: every member sees the same verdict
         # at the same boundary, so nobody sails alone into a collective
@@ -636,13 +790,32 @@ def main(argv=None) -> int:
     parser.add_argument("--dir", help="fleet working directory")
     parser.add_argument("--quorum-timeout", type=float, default=4.0)
     parser.add_argument("--heartbeat-every", type=float, default=0.25)
+    parser.add_argument("--progress-heartbeat-every", type=float,
+                        default=1.0,
+                        help="worker progress-heartbeat cadence into the "
+                        "per-member telemetry JSONL (0 disables)")
     parser.add_argument("--chunk-sleep", type=float, default=0.0)
+    parser.add_argument("--chunk-sleep-proc", type=int, default=-1)
     parser.add_argument("--workdir", help="supervisor working directory")
     parser.add_argument("--num-processes", type=int, default=2)
     parser.add_argument("--devices-per-process", type=int, default=2)
     parser.add_argument("--max-relaunches", type=int, default=2)
     parser.add_argument("--json", dest="json_out",
                         help="write the supervisor report to this path")
+    parser.add_argument("--status-file",
+                        help="write an atomic live-status JSON snapshot "
+                        "here on a cadence (member liveness, last "
+                        "heartbeat fields, deaths/relaunches, generation)")
+    parser.add_argument("--status-port", type=int,
+                        help="serve the live-status snapshot on "
+                        "http://127.0.0.1:PORT/statusz (0 = ephemeral "
+                        "port, reported in the supervisor JSON)")
+    parser.add_argument("--status-interval", type=float, default=1.0,
+                        help="seconds between status snapshots")
+    parser.add_argument("--no-telemetry", action="store_true",
+                        help="disable the per-member trace/telemetry "
+                        "artifact streams (on by default under "
+                        "<workdir>/telemetry)")
     args = parser.parse_args(argv)
     if args.worker:
         if not args.dir:
@@ -662,6 +835,11 @@ def main(argv=None) -> int:
         num_processes=args.num_processes,
         devices_per_process=args.devices_per_process,
         max_relaunches=args.max_relaunches,
+        telemetry=not args.no_telemetry,
+        progress_heartbeat_every_s=args.progress_heartbeat_every,
+        status_file=args.status_file,
+        status_port=args.status_port,
+        status_interval_s=args.status_interval,
     ))
     if args.json_out:
         with open(args.json_out, "w", encoding="utf-8") as fh:
