@@ -1,0 +1,290 @@
+"""Time-to-fresh-model: incremental warm-start retrain vs full retrain
+at a 5% daily delta on the GLMix bench shape (ISSUE 14 acceptance:
+``freshness_speedup`` >= 10x on TPU).
+
+Measures, on the bench_game GLMix shape (FE sparse shard + per-user RE
+shard):
+
+  1. one FULL fit over the combined data (yesterday ∪ today's delta) —
+     the Spark-cadence baseline that re-solves every entity, and
+  2. the INCREMENTAL path: warm-start from yesterday's checkpoint,
+     delta-scan the touched 5% of users, re-solve only their RE lanes
+     (untouched lanes bit-identical, zero-touched buckets skipped) while
+     the FE refreshes over the combined stream,
+
+and reports ``freshness_speedup`` = full_s / incremental_s. The detail
+block carries the STRUCTURAL evidence the tier-1 gate rides on
+(lanes solved vs skipped, bucket solves vs skips, touched fraction) and
+a ``quality_gap``: |validation AUC(incremental) − AUC(from-scratch)|,
+asserted < 0.02 — speed that costs model quality is not freshness.
+
+On non-TPU backends the problem shrinks and the line carries
+``"simulated": true`` — wall-clock ratios are only meaningful on TPU;
+the structural lane accounting is platform-independent.
+
+Budget: ``PHOTON_BENCH_BUDGET_S`` honored; skipped phases emit valid
+``"truncated": true`` lines.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+FRESHNESS_METRICS = ("freshness_speedup",)
+
+DELTA_FRACTION = 0.05
+QUALITY_TOL = 0.02
+
+
+def _glmix_data(rng, n_rows, n_users, fe_features, fe_nnz, re_features):
+    nnz = n_rows * fe_nnz
+    fe_rows = np.repeat(np.arange(n_rows, dtype=np.int64), fe_nnz)
+    fe_cols = rng.integers(0, fe_features, size=nnz)
+    fe_vals = rng.normal(size=nnz)
+    users = rng.integers(0, n_users, size=n_rows)
+    Xu = rng.normal(size=(n_rows, re_features))
+    return fe_vals, fe_rows, fe_cols, users, Xu
+
+
+def _build_dataset(fe_vals, fe_rows, fe_cols, users, Xu, y,
+                   fe_features):
+    from photon_ml_tpu.game import build_game_dataset
+    from photon_ml_tpu.ops.sparse import SparseBatch
+
+    n = len(y)
+    fe_batch = SparseBatch.from_coo(
+        values=fe_vals, rows=fe_rows, cols=fe_cols, labels=y,
+        num_features=fe_features,
+    )
+    ru_rows, ru_cols = np.nonzero(Xu)
+    re_batch = SparseBatch.from_coo(
+        values=Xu[ru_rows, ru_cols], rows=ru_rows, cols=ru_cols,
+        labels=y, num_features=Xu.shape[1],
+    )
+    return build_game_dataset(
+        response=y,
+        feature_shards={"global": fe_batch, "user": re_batch},
+        id_columns={"userId": users},
+    )
+
+
+def run_freshness(deadline=None) -> dict[str, float | None]:
+    from bench_suite import truncated_line
+
+    def truncated():
+        print(truncated_line("freshness_speedup"), flush=True)
+        return {"freshness_speedup": None}
+
+    if deadline is not None and time.monotonic() > deadline:
+        return truncated()
+
+    import dataclasses
+
+    import jax
+
+    from photon_ml_tpu import incremental, telemetry
+    from photon_ml_tpu.game import (
+        FixedEffectConfig,
+        GameConfig,
+        GameEstimator,
+        RandomEffectConfig,
+    )
+    from photon_ml_tpu.game.checkpoint import CheckpointSpec
+    from photon_ml_tpu.game.coordinate_descent import (
+        ValidationSpec,
+        _evaluate,
+    )
+    from photon_ml_tpu.optim import (
+        OptimizerConfig,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+    )
+
+    telemetry.configure_from_env()
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # the bench_game GLMix shape, split 95/5 base/delta
+        n_rows, n_users, fe_features, fe_nnz, re_f = (
+            1_000_000, 100_000, 10_000, 20, 10
+        )
+    else:
+        n_rows, n_users, fe_features, fe_nnz, re_f = (
+            40_000, 2_000, 1_000, 10, 6
+        )
+
+    rng = np.random.default_rng(0)
+    fe_vals, fe_rows, fe_cols, users, Xu = _glmix_data(
+        rng, n_rows, n_users, fe_features, fe_nnz, re_f
+    )
+    w_true = rng.normal(size=fe_features) * 0.5
+    wu_true = rng.normal(size=(n_users, re_f)) * 0.5
+    margins = np.zeros(n_rows)
+    np.add.at(margins, fe_rows, fe_vals * w_true[fe_cols])
+    margins += np.einsum("ij,ij->i", Xu, wu_true[users])
+    y = (rng.random(n_rows) < 1.0 / (1.0 + np.exp(-margins))).astype(
+        np.float64
+    )
+
+    # the delta: the LAST rows, restricted to 5% of the users — today's
+    # events touch a small entity subset, the production cadence shape
+    touched_users = rng.choice(
+        n_users, size=max(int(n_users * DELTA_FRACTION), 1), replace=False
+    )
+    n_delta = n_rows // 20
+    delta_lo = n_rows - n_delta
+    users = users.copy()
+    users[delta_lo:] = touched_users[
+        rng.integers(0, len(touched_users), n_delta)
+    ]
+
+    def slice_data(lo, hi):
+        keep = (fe_rows >= lo) & (fe_rows < hi)
+        return _build_dataset(
+            fe_vals[keep], fe_rows[keep] - lo, fe_cols[keep],
+            users[lo:hi], Xu[lo:hi], y[lo:hi], fe_features,
+        )
+
+    base_data = slice_data(0, delta_lo)
+    comb_data = slice_data(0, n_rows)
+    delta_data = slice_data(delta_lo, n_rows)
+    # validation holdout drawn from the same generator
+    nv = max(n_rows // 20, 1000)
+    Xv_fe_rows = np.repeat(np.arange(nv, dtype=np.int64), fe_nnz)
+    Xv_fe_cols = rng.integers(0, fe_features, size=nv * fe_nnz)
+    Xv_fe_vals = rng.normal(size=nv * fe_nnz)
+    uv = rng.integers(0, n_users, nv)
+    Xv_u = rng.normal(size=(nv, re_f))
+    mv = np.zeros(nv)
+    np.add.at(mv, Xv_fe_rows, Xv_fe_vals * w_true[Xv_fe_cols])
+    mv += np.einsum("ij,ij->i", Xv_u, wu_true[uv])
+    yv = (rng.random(nv) < 1.0 / (1.0 + np.exp(-mv))).astype(np.float64)
+    val_data = _build_dataset(
+        Xv_fe_vals, Xv_fe_rows, Xv_fe_cols, uv, Xv_u, yv, fe_features
+    )
+
+    opt = OptimizerConfig(
+        max_iterations=20,
+        tolerance=1e-7,
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    re_opt = dataclasses.replace(opt, optimizer_type=OptimizerType.NEWTON)
+    config = GameConfig(
+        task="logistic",
+        coordinates={
+            "fixed": FixedEffectConfig(shard_name="global", optimizer=opt),
+            "perUser": RandomEffectConfig(
+                shard_name="user", id_name="userId", optimizer=re_opt
+            ),
+        },
+        num_iterations=2,
+        evaluators=["auc"],
+    )
+
+    workdir = tempfile.mkdtemp(prefix="bench_freshness_")
+    try:
+        # --- yesterday's fit -> checkpoint (untimed: it already ran) ---
+        ckpt = f"{workdir}/base-ckpt"
+        GameEstimator(config).fit(
+            base_data,
+            checkpoint_spec=CheckpointSpec(directory=ckpt, resume=False),
+        )
+        if deadline is not None and time.monotonic() > deadline:
+            return truncated()
+
+        # --- the full-retrain baseline over the combined data ---
+        # (fresh estimator: no warm coordinate caches; one prior fit has
+        # already compiled the solver family, so this times solves)
+        t0 = time.perf_counter()
+        ref = GameEstimator(config).fit(comb_data)
+        full_s = time.perf_counter() - t0
+        if deadline is not None and time.monotonic() > deadline:
+            return truncated()
+
+        # --- the incremental path ---
+        counters0 = dict(telemetry.snapshot()["counters"])
+        t0 = time.perf_counter()
+        ws = incremental.load_warm_start(ckpt)
+        scan = incremental.scan_delta(
+            delta_data, {"userId": ws.model.models["perUser"].vocab}
+        )
+        res = GameEstimator(config).fit_incremental(
+            comb_data, ws, delta=scan
+        )
+        inc_s = time.perf_counter() - t0
+        speedup = full_s / max(inc_s, 1e-9)
+
+        spec = ValidationSpec(data=val_data, evaluators=["auc"])
+        auc_inc = _evaluate(res.model, spec)["auc"]
+        auc_ref = _evaluate(ref.model, spec)["auc"]
+        quality_gap = abs(auc_inc - auc_ref)
+        assert quality_gap < QUALITY_TOL, (
+            f"incremental model lost quality: AUC {auc_inc:.4f} vs "
+            f"from-scratch {auc_ref:.4f} (gap {quality_gap:.4f} >= "
+            f"{QUALITY_TOL})"
+        )
+        # structural speedup: the re-solved lane share must match the
+        # delta, platform-independently
+        lane_share = res.lanes_solved / max(
+            res.lanes_solved + res.lanes_skipped, 1
+        )
+        counters1 = telemetry.snapshot()["counters"]
+        print(
+            json.dumps(
+                {
+                    "metric": "freshness_speedup",
+                    "value": round(speedup, 3),
+                    "unit": "x",
+                    "vs_baseline": None,
+                    "detail": {
+                        "full_retrain_s": round(full_s, 3),
+                        "time_to_fresh_s": round(inc_s, 3),
+                        "rows": n_rows,
+                        "users": n_users,
+                        "delta_fraction": DELTA_FRACTION,
+                        "touched_fraction": round(
+                            max(
+                                c.touched_fraction
+                                for c in scan.coordinates.values()
+                            ),
+                            4,
+                        ),
+                        "lanes_solved": res.lanes_solved,
+                        "lanes_skipped": res.lanes_skipped,
+                        "lane_solve_share": round(lane_share, 4),
+                        "bucket_solves": res.bucket_solves,
+                        "buckets_skipped": res.buckets_skipped,
+                        "new_entities": res.new_entities,
+                        "quality_gap_auc": round(quality_gap, 5),
+                        "incremental_auc": round(float(auc_inc), 4),
+                        "from_scratch_auc": round(float(auc_ref), 4),
+                        "warm_restores": int(
+                            counters1.get("incremental.warm_restores", 0)
+                            - counters0.get("incremental.warm_restores", 0)
+                        ),
+                        "platform": jax.devices()[0].platform,
+                        "simulated": not on_tpu,
+                    },
+                }
+            ),
+            flush=True,
+        )
+        return {"freshness_speedup": round(speedup, 3)}
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main():
+    from bench_suite import budget_deadline
+
+    run_freshness(deadline=budget_deadline())
+
+
+if __name__ == "__main__":
+    main()

@@ -426,6 +426,14 @@ def main(argv=None) -> int:
         "with a note",
     )
     parser.add_argument(
+        "--freshness",
+        action="store_true",
+        help="also run bench_freshness.py (incremental warm-start retrain "
+        "vs full retrain at a 5%% delta — time-to-fresh-model speedup "
+        "with a quality-parity assertion) and include freshness_speedup "
+        "in the gate; baselines that predate it skip with a note",
+    )
+    parser.add_argument(
         "--serving",
         action="store_true",
         help="also run bench_serving.py's sustained-load SLO sweep "
@@ -465,6 +473,10 @@ def main(argv=None) -> int:
         from bench_ingest import run_ingest
 
         results.update(run_ingest(deadline=deadline))
+    if args.freshness:
+        from bench_freshness import run_freshness
+
+        results.update(run_freshness(deadline=deadline))
     if args.serving:
         from bench_serving import run_serving_slo
 

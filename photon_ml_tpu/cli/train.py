@@ -271,6 +271,186 @@ def _parse_checkpoint_spec(config: Mapping) -> Optional[CheckpointSpec]:
     return CheckpointSpec(**spec)
 
 
+_WARM_START_KEYS = {
+    "dir", "delta_paths", "registry_dir", "base_version",
+    "lambda_factors", "lambda_points", "lambda_span", "metric", "policy",
+}
+
+
+def _parse_warm_start(config: Mapping) -> Optional[dict]:
+    """Config key ``"warm_start"`` (the ``--warm-start``/``--delta``
+    flags): ``{"dir": <base checkpoint/model dir>, "delta_paths": [...],
+    "registry_dir": ..., "lambda_points"/"lambda_span" or an explicit
+    "lambda_factors" list, "metric", "policy", "base_version"}``."""
+    spec = config.get("warm_start")
+    if not spec:
+        return None
+    if isinstance(spec, str):
+        spec = {"dir": spec}
+    spec = dict(spec)
+    if "dir" not in spec:
+        raise ValueError("warm_start config needs a 'dir' key")
+    unknown = set(spec) - _WARM_START_KEYS
+    if unknown:
+        raise ValueError(
+            f"unknown warm_start config keys: {sorted(unknown)}"
+        )
+    if config.get("sweep"):
+        raise ValueError(
+            "warm_start and sweep are mutually exclusive — the "
+            "incremental path runs its own local λ sweep "
+            '(warm_start {"lambda_points": N, "lambda_span": S})'
+        )
+    return spec
+
+
+def _run_incremental(
+    config: Mapping,
+    warm: dict,
+    estimator: GameEstimator,
+    train_data,
+    validation_data,
+    index_maps,
+    output_dir,
+    mesh,
+    checkpoint_spec,
+    guard,
+    stop,
+) -> dict:
+    """The warm-start branch of the train pipeline: load the base,
+    scan the delta, run the selective refresh, optionally publish with
+    lineage. Returns the freshness summary block."""
+    from photon_ml_tpu.incremental import (
+        load_warm_start,
+        local_lambda_factors,
+        publish_incremental,
+        scan_delta,
+    )
+
+    with timed("warm-start restore"):
+        ws = load_warm_start(warm["dir"], mesh=mesh)
+    if ws.model is None:
+        from photon_ml_tpu.incremental import WarmStartError
+
+        raise WarmStartError(
+            f"{warm['dir']} holds a streamed coefficient-table "
+            "checkpoint, not a full GAME model — the train CLI "
+            "warm-starts coordinate descent; streamed tables warm-start "
+            "StreamingRandomEffectTrainer via the API "
+            "(incremental.load_warm_start + "
+            "ShardedCoefficientTable.from_coefficients)"
+        )
+    delta_scan = None
+    delta_paths = list(warm.get("delta_paths") or ())
+    if delta_paths:
+        base_vocabs = {}
+        for sub in ws.model.models.values():
+            id_name = getattr(sub, "id_name", None)
+            vocab = getattr(sub, "vocab", None)
+            if id_name is not None and vocab is not None:
+                base_vocabs[id_name] = vocab
+        if base_vocabs:
+            with timed("delta scan"):
+                # the delta IS re-decoded here (it was already read as
+                # the combined stream's suffix) — only its id columns
+                # are needed, and at the 5%-of-base scale a delta is by
+                # premise, the second decode is bounded by that fraction
+                delta_spec = {**config["input"], "paths": delta_paths}
+                delta_spec.pop("ingest", None)  # scan is host-side
+                # delta paths are explicit shards, never daily dirs
+                delta_spec.pop("date_range", None)
+                delta_spec.pop("date_range_days_ago", None)
+                delta_data, _ = read_input(
+                    delta_spec, index_maps=index_maps
+                )
+                delta_scan = scan_delta(
+                    delta_data, base_vocabs, paths=delta_paths
+                )
+    factors = warm.get("lambda_factors")
+    if factors is None and warm.get("lambda_points"):
+        factors = local_lambda_factors(
+            points=int(warm["lambda_points"]),
+            span=float(warm.get("lambda_span", 4.0)),
+        )
+    with timed("incremental fit"):
+        result = estimator.fit_incremental(
+            train_data,
+            ws,
+            delta=delta_scan,
+            validation_data=validation_data,
+            output_dir=output_dir,
+            mesh=mesh,
+            lambda_factors=factors,
+            metric=warm.get("metric"),
+            policy=warm.get("policy", "best"),
+            guard=guard,
+            checkpoint_spec=checkpoint_spec,
+            should_stop=stop if checkpoint_spec is not None else None,
+        )
+    if warm.get("registry_dir"):
+        if not index_maps:
+            raise ValueError(
+                "publishing an incremental model needs index maps (avro "
+                "input builds them; libsvm input cannot publish)"
+            )
+        with timed("registry publish"):
+            result.published_version = publish_incremental(
+                warm["registry_dir"],
+                result.model,
+                index_maps,
+                result.lineage,
+                delta=result.delta,
+                base_version=warm.get("base_version"),
+                selection=result.selection,
+            )
+    freshness = {
+        "base": result.lineage.to_json(),
+        "lanes_solved": result.lanes_solved,
+        "lanes_skipped": result.lanes_skipped,
+        "bucket_solves": result.bucket_solves,
+        "buckets_skipped": result.buckets_skipped,
+        "new_entities": result.new_entities,
+        "time_to_fresh_s": round(result.seconds, 3),
+        "best_metric": result.best_metric,
+    }
+    if result.delta is not None:
+        freshness["delta"] = result.delta.to_json()
+    if result.selection is not None:
+        freshness["selection"] = result.selection.to_json()
+    if result.published_version:
+        freshness["published_version"] = result.published_version
+    return freshness
+
+
+def _persist_feature_artifacts(output_dir, index_maps, train_data) -> None:
+    """Persist the feature space next to the saved models (final/ and
+    best/ feature-indexes — scoring must reproduce training-time feature
+    ids, the prepareFeatureMaps/PalDB analog) plus the per-shard feature
+    statistics (writeBasicStatistics analog). Shared by the plain fit
+    and the incremental warm-start branch so a refreshed model dir
+    carries exactly the artifacts a trained one does."""
+    import os
+
+    with timed("save index maps"):
+        for shard, imap in index_maps.items():
+            for sub in ("final", "best"):
+                imap.save(
+                    os.path.join(output_dir, sub, "feature-indexes", shard)
+                )
+    from photon_ml_tpu.data.avro import write_feature_summary
+    from photon_ml_tpu.data.stats import summarize
+
+    with timed("save feature summaries"):
+        stats_dir = os.path.join(output_dir, "feature-stats")
+        os.makedirs(stats_dir, exist_ok=True)
+        for shard, imap in index_maps.items():
+            write_feature_summary(
+                os.path.join(stats_dir, f"{shard}.avro"),
+                summarize(train_data.batch_for(shard)),
+                imap,
+            )
+
+
 def _parse_guard_spec(config: Mapping) -> Optional[GuardSpec]:
     """Config key ``"guard"``: true (default — divergence recovery on),
     false to disable, or an object overriding GuardSpec fields (defaults
@@ -378,6 +558,7 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     output_dir = output_dir or config.get("output_dir")
     checkpoint_spec = _parse_checkpoint_spec(config)
     guard = _parse_guard_spec(config)
+    warm = _parse_warm_start(config)
     if config.get("sweep"):
         # the vmapped sweep path has no checkpoint/resume or mesh support
         # yet; accepting the keys and silently not honoring them is worse
@@ -421,8 +602,28 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     if telemetry_out:
         telemetry_out = telemetry.member_artifact_path(telemetry_out)
 
+    input_spec = dict(config["input"])
+    if warm and warm.get("delta_paths"):
+        # the combined stream: yesterday's shards ∪ today's delta. The
+        # deterministic planner keeps yesterday's chunk ids/offsets
+        # stable under the appended files (the resume contract).
+        paths = input_spec.get("paths")
+        if isinstance(paths, str):
+            paths = [paths]
+        dr = input_spec.pop("date_range", None)
+        dr_ago = input_spec.pop("date_range_days_ago", None)
+        if dr or dr_ago:
+            # expand the BASE daily directories here, before appending:
+            # delta files are explicit shards, not daily dirs — expanding
+            # the combined list would silently drop them
+            from photon_ml_tpu.data.paths import expand_input_paths
+
+            paths = expand_input_paths(
+                list(paths), date_range=dr, date_range_days_ago=dr_ago
+            )
+        input_spec["paths"] = list(paths) + list(warm["delta_paths"])
     with timed("read training data"):
-        train_data, index_maps = read_input(config["input"])
+        train_data, index_maps = read_input(input_spec)
     validation_data = None
     if config.get("validation"):
         with timed("read validation data"):
@@ -481,6 +682,35 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
                 )
             _maybe_write_report(config, summary, trace_out, telemetry_out)
             return summary
+        if warm:
+            # incremental warm-start refresh INSTEAD of a full fit:
+            # selective RE re-solve over the combined stream, lineage
+            # recorded end to end (cli/train._run_incremental)
+            freshness = _run_incremental(
+                config, warm, estimator, train_data, validation_data,
+                index_maps, output_dir, mesh, checkpoint_spec, guard,
+                stop,
+            )
+            summary = {
+                "freshness": freshness,
+                "best_metric": freshness.get("best_metric"),
+                "output_dir": output_dir,
+                "num_rows": train_data.num_rows,
+            }
+            if output_dir is not None and index_maps is not None:
+                _persist_feature_artifacts(
+                    output_dir, index_maps, train_data
+                )
+            if telemetry_out:
+                summary["telemetry"] = telemetry.flush_metrics(
+                    telemetry_out
+                )
+            if trace_out:
+                telemetry.export_chrome_trace(
+                    trace_out, telemetry.perfetto_path(trace_out)
+                )
+            _maybe_write_report(config, summary, trace_out, telemetry_out)
+            return summary
         with timed("fit"):
             result = estimator.fit(
                 train_data,
@@ -514,30 +744,7 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
             heartbeat.stop()
 
     if output_dir is not None and index_maps is not None:
-        # persist the feature space next to the models so scoring reproduces
-        # training-time feature ids (prepareFeatureMaps / PalDB analog)
-        import os
-
-        with timed("save index maps"):
-            for shard, imap in index_maps.items():
-                for sub in ("final", "best"):
-                    imap.save(
-                        os.path.join(output_dir, sub, "feature-indexes", shard)
-                    )
-        # per-shard feature statistics (calculateAndSaveFeatureShardStats /
-        # writeBasicStatistics analog)
-        from photon_ml_tpu.data.avro import write_feature_summary
-        from photon_ml_tpu.data.stats import summarize
-
-        with timed("save feature summaries"):
-            stats_dir = os.path.join(output_dir, "feature-stats")
-            os.makedirs(stats_dir, exist_ok=True)
-            for shard, imap in index_maps.items():
-                write_feature_summary(
-                    os.path.join(stats_dir, f"{shard}.avro"),
-                    summarize(train_data.batch_for(shard)),
-                    imap,
-                )
+        _persist_feature_artifacts(output_dir, index_maps, train_data)
 
     summary = {
         "output_dir": output_dir,
@@ -619,6 +826,38 @@ def main(argv=None) -> int:
         "ModelRegistry hot-swap (config sweep.registry_dir)",
     )
     parser.add_argument(
+        "--warm-start",
+        metavar="DIR",
+        help="incremental retrain: warm-start every coordinate from this "
+        "base artifact (a --checkpoint-dir step checkpoint, a streamed "
+        "chunk checkpoint, or a saved model dir) instead of fitting from "
+        "scratch; with --delta, only the touched random-effect lanes "
+        "re-solve (config key warm_start.dir)",
+    )
+    parser.add_argument(
+        "--delta",
+        action="append",
+        metavar="PATH",
+        help="delta shard(s) appended to the input paths (repeatable); "
+        "their interned entity-id columns drive the touched-lane mask — "
+        "requires --warm-start (config warm_start.delta_paths)",
+    )
+    parser.add_argument(
+        "--refresh-registry-dir",
+        metavar="DIR",
+        help="publish the refreshed model here via publish_version with "
+        "the lineage record (base checkpoint, delta digest) in metadata "
+        "(config warm_start.registry_dir)",
+    )
+    parser.add_argument(
+        "--lambda-points",
+        type=int,
+        help="run a local descending-λ sweep of this many lanes around "
+        "the incumbent regularization during an incremental retrain, "
+        "selected by sweep.select policies (needs a validation input; "
+        "config warm_start.lambda_points)",
+    )
+    parser.add_argument(
         "--ingest-workers",
         type=int,
         help="read Avro input through the out-of-core ingest pipeline "
@@ -678,6 +917,27 @@ def main(argv=None) -> int:
                 "grid: pass --sweep lambda=... (or config sweep.grid)"
             )
         config["sweep"] = sweep_cfg
+    if (
+        args.warm_start or args.delta or args.refresh_registry_dir
+        or args.lambda_points is not None
+    ):
+        ws = dict(config.get("warm_start") or {})
+        if args.warm_start:
+            ws["dir"] = args.warm_start
+        if args.delta:
+            ws["delta_paths"] = list(ws.get("delta_paths") or ()) + list(
+                args.delta
+            )
+        if args.refresh_registry_dir:
+            ws["registry_dir"] = args.refresh_registry_dir
+        if args.lambda_points is not None:
+            ws["lambda_points"] = args.lambda_points
+        if "dir" not in ws:
+            parser.error(
+                "--delta/--refresh-registry-dir/--lambda-points need "
+                "--warm-start (or a config warm_start.dir)"
+            )
+        config["warm_start"] = ws
     if args.ingest_workers is not None or args.prefetch_depth is not None:
         inp = dict(config.get("input") or {})
         ing = inp.get("ingest")

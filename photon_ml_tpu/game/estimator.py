@@ -503,6 +503,81 @@ class GameEstimator:
             )
         return fit
 
+    def fit_incremental(
+        self,
+        data: GameDataset,
+        warm_start,
+        delta=None,
+        validation_data: Optional[GameDataset] = None,
+        output_dir: Optional[str] = None,
+        mesh: Optional[Mesh] = None,
+        num_iterations: Optional[int] = None,
+        lambda_factors=None,
+        metric: Optional[str] = None,
+        policy: str = "best",
+        rel_tol: float = 0.01,
+        guard: Optional["GuardSpec"] = None,
+        checkpoint_spec: Optional["CheckpointSpec"] = None,
+        should_stop=None,
+    ):
+        """Delta-aware warm-start refresh over the COMBINED data.
+
+        ``warm_start`` (:func:`photon_ml_tpu.incremental.load_warm_start`)
+        seeds every coordinate from the base model — per-entity rows
+        re-homed by entity value, so vocabulary growth zero-inits only
+        genuinely new entities. With ``delta``
+        (:func:`photon_ml_tpu.incremental.scan_delta`), random-effect
+        coordinates re-solve ONLY the touched entities' lanes (untouched
+        rows stay bit-identical; zero-touched bucket solves are skipped
+        entirely) while the fixed effect refreshes over all rows.
+
+        ``lambda_factors`` (descending multipliers, e.g. from
+        :func:`photon_ml_tpu.incremental.local_lambda_factors`) runs a
+        small local λ sweep around the incumbent regularization, each
+        lane path-warm-started from its more-regularized neighbor, and
+        selects with the ``sweep.select`` policies (needs
+        ``validation_data``).
+
+        Returns :class:`photon_ml_tpu.incremental.IncrementalFitResult`.
+        """
+        from photon_ml_tpu.incremental.refit import run_incremental_fit
+
+        result = run_incremental_fit(
+            self,
+            data,
+            warm_start,
+            delta=delta,
+            validation_data=validation_data,
+            mesh=mesh,
+            num_iterations=num_iterations,
+            lambda_factors=lambda_factors,
+            metric=metric,
+            policy=policy,
+            rel_tol=rel_tol,
+            guard=guard,
+            checkpoint_spec=checkpoint_spec,
+            should_stop=should_stop,
+        )
+        if output_dir is not None:
+            from photon_ml_tpu.data.model_store import save_game_model
+            from photon_ml_tpu.incremental.publish import lineage_record
+
+            meta = {
+                "config": _config_metadata(self.config),
+                "best_metric": result.best_metric,
+                "lineage": lineage_record(result.lineage,
+                                          delta=result.delta),
+            }
+            save_game_model(
+                result.model, os.path.join(output_dir, "final"),
+                extra_metadata=meta,
+            )
+            save_game_model(
+                result.best_model, os.path.join(output_dir, "best"),
+                extra_metadata=meta,
+            )
+        return result
+
     def fit_sweep(
         self,
         data: GameDataset,

@@ -1,0 +1,104 @@
+"""Registry publishing with lineage: a served version names its training
+ancestor.
+
+Nearline-published versions (serving.nearline) and sweep winners already
+carry provenance fragments; incremental retrains complete the picture —
+every version published here records a ``lineage`` block in its
+``model-metadata.json``:
+
+    {"lineage": {"base_version": "v-00000003",
+                 "warm_start_checkpoint": "/ckpt/base",
+                 "base_kind": "step", "base_step": 1,
+                 "base_digest": "sha256...",
+                 "delta_digest": "sha256...",
+                 "delta_rows": 50000, "touched_fraction": 0.05}}
+
+``serving.registry.publish_version(lineage=...)`` stores it, the
+``ScoringEngine`` loads it, ``/healthz`` serves it, and the RunReport
+"Freshness" section renders the training-side view — so "which data made
+this model" is answerable from either end.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from photon_ml_tpu import faults, telemetry
+
+# Injection seam: fires BEFORE the registry version assembly begins. A
+# kill here (or anywhere inside publish_version's tmp-then-rename
+# protocol) must leave the registry with no partial version and the
+# warm-start base checkpoint untouched — the incremental crash row.
+FP_PUBLISH = faults.register_point(
+    "incremental.publish",
+    description="before an incremental retrain assembles its registry "
+    "version (tmp-then-rename; a kill leaves no partial version)",
+)
+
+
+def lineage_record(
+    lineage,
+    delta=None,
+    base_version: Optional[str] = None,
+) -> dict:
+    """The JSON-safe lineage block registry metadata carries."""
+    out: dict = {
+        "warm_start_checkpoint": lineage.checkpoint_dir,
+        "base_kind": lineage.kind,
+    }
+    if base_version is not None:
+        out["base_version"] = base_version
+    if lineage.step is not None:
+        out["base_step"] = int(lineage.step)
+    if lineage.next_chunk is not None:
+        out["base_next_chunk"] = int(lineage.next_chunk)
+    if lineage.digest is not None:
+        out["base_digest"] = lineage.digest
+    if delta is not None:
+        out["delta_digest"] = delta.digest
+        out["delta_rows"] = int(delta.delta_rows)
+        out["delta_paths"] = list(delta.paths)
+        fractions = [
+            c.touched_fraction for c in delta.coordinates.values()
+        ]
+        if fractions:
+            out["touched_fraction"] = round(max(fractions), 6)
+    return out
+
+
+def publish_incremental(
+    registry_dir: str,
+    model,
+    index_maps: Mapping,
+    lineage,
+    delta=None,
+    base_version: Optional[str] = None,
+    extra_metadata: Optional[dict] = None,
+    selection=None,
+) -> str:
+    """Atomically publish an incremental retrain's model as the next
+    registry version, lineage in metadata. Returns the version path.
+
+    ``base_version`` (optional): the registry version the base model was
+    serving as, when known — closes the ancestor chain for nearline
+    consumers. ``selection``: the local λ sweep's
+    :class:`~photon_ml_tpu.sweep.select.SweepSelection`, recorded like
+    the sweep exporter records it.
+    """
+    from photon_ml_tpu.serving.registry import publish_version
+
+    faults.fault_point(FP_PUBLISH)
+    meta = dict(extra_metadata or {})
+    if selection is not None:
+        meta["sweep_selection"] = selection.to_json()
+    path = publish_version(
+        registry_dir,
+        model,
+        index_maps,
+        extra_metadata=meta,
+        lineage=lineage_record(
+            lineage, delta=delta, base_version=base_version
+        ),
+    )
+    telemetry.counter("incremental.published_versions").inc()
+    return path

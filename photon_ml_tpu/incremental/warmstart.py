@@ -1,0 +1,301 @@
+"""Warm-start loading: yesterday's checkpoint becomes today's starting
+table, on whatever mesh today's run has.
+
+Three base-artifact kinds are recognized (:func:`detect_warm_start_kind`):
+
+- ``"step"`` — a coordinate-descent checkpoint directory
+  (``step-NNNNNNNN/`` dirs from :class:`~photon_ml_tpu.game.checkpoint.
+  CheckpointManager`): the full GAME model restores via the manager's
+  newest-valid-fallback walk.
+- ``"streaming"`` — a sharded streamed-table checkpoint
+  (``chunk-NNNNNNNN/`` dirs from ``StreamingCheckpointManager``): the
+  coefficient table restores ELASTICALLY straight onto the training mesh
+  via ``restore_placed()`` (per-device shard reads, no host
+  materialization) and is wrapped with
+  ``ShardedCoefficientTable.from_coefficients`` — no zero-init +
+  overwrite.
+- ``"model"`` — a saved model directory (``model-metadata.json``): the
+  ``final/`` / ``best/`` layout the training driver writes.
+
+Vocabulary growth: a delta stream introduces entities the base run never
+saw, so the current index map can hold MORE entities than the
+checkpoint. :func:`grow_entity_rows` appends zero-initialized rows while
+keeping existing rows bit-identical; an entity count that cannot divide
+the target mesh's model axis raises the same typed
+:class:`~photon_ml_tpu.parallel.sharding.ElasticPlacementError` elastic
+restore uses, listing the legal axis sizes.
+
+Every load records a :class:`BaseLineage` — the base checkpoint's
+identity (directory, kind, cursor, content digest) — which publishing
+threads into registry version metadata so a served model is traceable to
+its training ancestor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+
+from photon_ml_tpu import faults, telemetry
+from photon_ml_tpu.parallel import sharding as psharding
+
+logger = logging.getLogger("photon_ml_tpu.incremental")
+
+# Injection seam: the warm-start restore entry. An `io` rule here is the
+# transient flaky-read shape (the base dir lives on shared storage); a
+# kill here must leave the BASE checkpoint untouched — the restore path
+# only ever reads it.
+FP_WARM_RESTORE = faults.register_point(
+    "incremental.warm_restore",
+    description="entry of a warm-start checkpoint restore (read-only: "
+    "the base checkpoint is never written)",
+)
+
+
+class WarmStartError(RuntimeError):
+    """The warm-start directory is unusable for an incremental fit; the
+    message names the directory and what was expected there."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BaseLineage:
+    """Identity of the base artifact an incremental fit started from.
+
+    ``digest`` is a sha256 over the certifying manifest/metadata file of
+    the newest restored state — enough to prove later that the base was
+    not mutated by the incremental run (the crash-row test keys on it),
+    and to make two publishes from the same base recognizably siblings.
+    """
+
+    checkpoint_dir: str
+    kind: str  # "step" | "streaming" | "model"
+    step: Optional[int] = None
+    next_chunk: Optional[int] = None
+    digest: Optional[str] = None
+
+    def to_json(self) -> dict:
+        out = {"checkpoint_dir": self.checkpoint_dir, "kind": self.kind}
+        if self.step is not None:
+            out["step"] = int(self.step)
+        if self.next_chunk is not None:
+            out["next_chunk"] = int(self.next_chunk)
+        if self.digest is not None:
+            out["digest"] = self.digest
+        return out
+
+
+@dataclasses.dataclass
+class WarmStart:
+    """A loaded base artifact, ready to seed an incremental fit.
+
+    ``model`` is set for ``step``/``model`` kinds (the full GAME model
+    coordinate descent warm-starts from). ``table`` is set for the
+    ``streaming`` kind — the elastically placed
+    :class:`~photon_ml_tpu.game.streaming.ShardedCoefficientTable` a
+    streamed trainer continues from at ``next_chunk``.
+    """
+
+    lineage: BaseLineage
+    model: Optional[object] = None  # GameModel
+    table: Optional[object] = None  # ShardedCoefficientTable
+    variances: Optional[object] = None  # device array when checkpointed
+    next_chunk: int = 0
+
+
+def _digest_file(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def detect_warm_start_kind(directory: str) -> str:
+    """Classify a warm-start directory by its certifying artifacts."""
+    if not os.path.isdir(directory):
+        raise WarmStartError(
+            f"warm-start directory does not exist: {directory}"
+        )
+    if os.path.exists(os.path.join(directory, "model-metadata.json")):
+        return "model"
+    names = os.listdir(directory)
+    if any(n.startswith("step-") for n in names):
+        return "step"
+    if any(n.startswith("chunk-") for n in names):
+        return "streaming"
+    raise WarmStartError(
+        f"{directory} holds neither a saved model (model-metadata.json), "
+        "a step checkpoint (step-*/), nor a streamed-table checkpoint "
+        "(chunk-*/) — nothing to warm-start from"
+    )
+
+
+def load_warm_start(
+    directory: str,
+    mesh=None,
+    axis: Optional[str] = None,
+) -> WarmStart:
+    """Load the base artifact under ``directory`` for a warm start.
+
+    The ``streaming`` kind restores the sharded table ELASTICALLY onto
+    ``mesh`` (``restore_placed`` → ``ShardedCoefficientTable
+    .from_coefficients``): a checkpoint written across 8 shards warm-
+    starts a 4-device (or single-device) retrain with no host gather.
+    ``step``/``model`` kinds return the full GAME model; both fall back
+    past corrupt newest states exactly like their restore paths do.
+
+    Read-only by construction: nothing under ``directory`` is created,
+    cleared, or rewritten — the base checkpoint survives any crash of
+    the incremental run.
+    """
+    faults.fault_point(FP_WARM_RESTORE)
+    kind = detect_warm_start_kind(directory)
+    with telemetry.span("incremental:warm_restore", kind=kind):
+        if kind == "streaming":
+            return _load_streaming(directory, mesh, axis)
+        if kind == "step":
+            return _load_step(directory)
+        return _load_model_dir(directory)
+
+
+def _load_streaming(directory: str, mesh, axis) -> WarmStart:
+    from photon_ml_tpu.game.checkpoint import StreamingCheckpointManager
+    from photon_ml_tpu.game.streaming import ShardedCoefficientTable
+
+    mgr = StreamingCheckpointManager.open_for_restore(directory)
+    restored = mgr.restore_placed(mesh=mesh, axis=axis)
+    if restored is None:
+        raise WarmStartError(
+            f"{directory}: no valid streamed checkpoint to warm-start from"
+        )
+    table = ShardedCoefficientTable.from_coefficients(
+        restored.coefficients, mesh=mesh, axis=axis
+    )
+    # digest the newest VALID chunk's manifest — the one restore used
+    chunk_name = f"chunk-{restored.next_chunk:08d}"
+    digest = _digest_file(os.path.join(directory, chunk_name,
+                                       "manifest.json"))
+    telemetry.counter("incremental.warm_restores").inc()
+    return WarmStart(
+        lineage=BaseLineage(
+            checkpoint_dir=os.path.abspath(directory),
+            kind="streaming",
+            next_chunk=int(restored.next_chunk),
+            digest=digest,
+        ),
+        table=table,
+        variances=restored.variances,
+        next_chunk=int(restored.next_chunk),
+    )
+
+
+def _load_step(directory: str) -> WarmStart:
+    from photon_ml_tpu.game.checkpoint import (
+        CheckpointManager,
+        CheckpointSpec,
+    )
+
+    mgr = CheckpointManager(CheckpointSpec(directory=directory))
+    state = mgr.restore()
+    if state is None:
+        raise WarmStartError(
+            f"{directory}: no valid step checkpoint to warm-start from"
+        )
+    from photon_ml_tpu.game.checkpoint import _step_dirname
+
+    digest = _digest_file(
+        os.path.join(directory, _step_dirname(state.step), "manifest.json")
+    )
+    telemetry.counter("incremental.warm_restores").inc()
+    return WarmStart(
+        lineage=BaseLineage(
+            checkpoint_dir=os.path.abspath(directory),
+            kind="step",
+            step=int(state.step),
+            digest=digest,
+        ),
+        model=state.model,
+    )
+
+
+def _load_model_dir(directory: str) -> WarmStart:
+    from photon_ml_tpu.data.model_store import ModelLoadError, load_game_model
+
+    try:
+        model = load_game_model(directory)
+    except ModelLoadError as e:
+        raise WarmStartError(
+            f"{directory}: unloadable saved model ({e})"
+        ) from e
+    digest = _digest_file(os.path.join(directory, "model-metadata.json"))
+    telemetry.counter("incremental.warm_restores").inc()
+    return WarmStart(
+        lineage=BaseLineage(
+            checkpoint_dir=os.path.abspath(directory),
+            kind="model",
+            digest=digest,
+        ),
+        model=model,
+    )
+
+
+def grow_entity_rows(
+    coefficients,
+    num_entities: int,
+    mesh=None,
+    axis: Optional[str] = None,
+):
+    """Expand an ``[N_old, K]`` table to ``[num_entities, K]`` for a
+    grown vocabulary: rows ``[0, N_old)`` stay **bit-identical**, new
+    rows are zero-initialized (the same init a never-seen entity gets).
+
+    With ``mesh`` the grown table is committed entity-sharded; a target
+    entity count that does not divide the model axis raises the shared
+    typed :class:`~photon_ml_tpu.parallel.sharding.ElasticPlacementError`
+    naming the sizes that CAN hold it (an operator error, never a
+    corrupt-skip). Shrinking is refused — dropping trained rows silently
+    would be data loss.
+    """
+    n_old, k = (int(d) for d in coefficients.shape)
+    num_entities = int(num_entities)
+    if num_entities < n_old:
+        raise WarmStartError(
+            f"cannot shrink a warm-start table from {n_old} to "
+            f"{num_entities} entities — the vocabulary may only grow"
+        )
+    grow = num_entities - n_old
+    if mesh is None:
+        if grow == 0:
+            return coefficients
+        return jnp.concatenate(
+            [coefficients, jnp.zeros((grow, k), coefficients.dtype)], axis=0
+        )
+    sharding = psharding.entity_sharding(mesh, axis)
+    resolved = sharding.spec[0]
+    n_dev = psharding.axis_size(mesh, resolved)
+    if num_entities % n_dev:
+        raise psharding.entity_axis_mismatch(
+            num_entities, resolved, n_dev, what="hold the grown vocabulary"
+        )
+
+    # non-donating jitted pad with the sharded out layout: GSPMD moves
+    # each old row to its new owner, new rows materialize as zeros on
+    # their shard — no host copy of either table. multi_shape: one fresh
+    # closure per (grow, table) by design, not a recompile storm.
+    def pad(w):
+        return jnp.pad(w, ((0, grow), (0, 0)))
+
+    grown = telemetry.instrumented_jit(
+        pad,
+        name="incremental_grow_rows",
+        multi_shape=True,
+        out_shardings=sharding,
+    )(coefficients)
+    if grow:
+        telemetry.counter("incremental.grown_entities").inc(grow)
+    return grown

@@ -298,11 +298,16 @@ class ScoringEngine:
         version: str = "unversioned",
         mesh=None,
         entity_axis: Optional[str] = None,
+        lineage: Optional[dict] = None,
     ):
         if max_row_nnz < 1:
             raise ValueError("max_row_nnz must be >= 1")
         self.model = model
         self.version = version
+        # training-ancestry record from the version's metadata (published
+        # via publish_version(lineage=...)); surfaced on /healthz so a
+        # running model names its warm-start checkpoint and delta
+        self.lineage = lineage
         self.max_batch = int(max_batch)
         self.max_row_nnz = int(max_row_nnz)
         self.task = model.task
@@ -536,6 +541,16 @@ class ScoringEngine:
             model = _restore_re_coordinate(
                 model, coord, ckpt_dir, mesh=mesh, entity_axis=entity_axis
             )
+        try:
+            from photon_ml_tpu.data.model_store import (
+                load_game_model_metadata,
+            )
+
+            lineage = (
+                load_game_model_metadata(model_dir).get("extra") or {}
+            ).get("lineage")
+        except (OSError, ValueError):
+            lineage = None  # metadata already validated by the load above
         return cls(
             model,
             index_maps=index_maps,
@@ -544,6 +559,7 @@ class ScoringEngine:
             version=version or os.path.basename(os.path.normpath(model_dir)),
             mesh=mesh,
             entity_axis=entity_axis,
+            lineage=lineage,
         )
 
     # -- request assembly ----------------------------------------------------

@@ -89,6 +89,7 @@ def publish_version(
     index_maps: Mapping,
     version: Optional[int] = None,
     extra_metadata: Optional[dict] = None,
+    lineage: Optional[dict] = None,
 ) -> str:
     """Atomically publish ``model`` as the next registry version.
 
@@ -96,10 +97,20 @@ def publish_version(
     is REQUIRED: the registry refuses versions without a pinned feature
     space. The version directory is assembled in a ``.tmp-v-*`` sibling
     and renamed into place — watchers see the complete version or nothing.
+
+    ``lineage`` (optional): a JSON-safe training-ancestry record
+    (``base_version``, ``warm_start_checkpoint``, delta digest — see
+    ``incremental.publish.lineage_record``) stored under the metadata
+    ``"lineage"`` key; the loaded engine carries it and ``/healthz``
+    serves it, so a running version is traceable to the checkpoint and
+    delta that produced it.
     """
     from photon_ml_tpu.data.index_map import IndexMap
     from photon_ml_tpu.data.model_store import save_game_model
 
+    if lineage is not None:
+        extra_metadata = dict(extra_metadata or {})
+        extra_metadata["lineage"] = dict(lineage)
     if not index_maps:
         raise ValueError(
             "index_maps is required: a served version must pin the training "

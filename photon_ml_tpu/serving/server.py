@@ -192,6 +192,10 @@ class ScoringService:
             state["entity_axis"] = engine.entity_axis
         if getattr(engine, "nearline_seq", 0):
             state["nearline_seq"] = engine.nearline_seq
+        if getattr(engine, "lineage", None):
+            # training ancestry of the served version (incremental
+            # retrains: base checkpoint + delta digest, registry lineage)
+            state["lineage"] = engine.lineage
         if engine.warm:
             # per-batch-bucket compile time + cost from the executable
             # registry (telemetry.xla) — which bucket executables exist,

@@ -412,6 +412,9 @@ class RunReport:
         ingest_rate = gauges.get("ingest.rows_per_sec")
         if ingest_rate is not None:
             out["ingest_rows_per_sec"] = float(ingest_rate)
+        ttf = gauges.get("incremental.time_to_fresh_s")
+        if ttf is not None:
+            out["time_to_fresh_s"] = float(ttf)
         du = self.device_utilization()
         if du is not None and du.get("mfu") is not None:
             out["mfu"] = float(du["mfu"])
@@ -717,6 +720,7 @@ class RunReport:
             "ingestion": self.ingestion_summary(),
             "serving": self.serving_summary(),
             "recovery": self.recovery_summary(),
+            "freshness": self.freshness_summary(),
             "counters": counters,
             "gauges": self.snapshot.get("gauges", {}),
             "histograms": self.snapshot.get("histograms", {}),
@@ -783,6 +787,7 @@ class RunReport:
         lines += self._ingestion_markdown()
         lines += self._serving_markdown()
         lines += self._recovery_markdown()
+        lines += self._freshness_markdown()
         lines += self._memory_markdown()
         lines += self._coordinates_markdown()
         lines += self._sweep_markdown()
@@ -1089,6 +1094,123 @@ class RunReport:
         if unseen:
             out.append(
                 f"- {unseen} unseen-entity row(s) served fixed-effect-only"
+            )
+        out.append("")
+        return out
+
+    def freshness_summary(self) -> Optional[dict[str, Any]]:
+        """The incremental-retrain accounting, or None when the run was
+        not an incremental fit.
+
+        Answers the continuous-freshness questions: what base did this
+        model start from (the ``incremental_fit`` span's lineage attrs),
+        how much of the entity space did the delta touch, how many RE
+        lanes actually re-solved vs kept their converged coefficients
+        bit-identical (lane/bucket skip counters — the structural
+        speedup evidence), and how long retrain-to-fresh-model took.
+        """
+        c = self.snapshot.get("counters", {})
+        g = self.snapshot.get("gauges", {})
+        fit_spans = [
+            s for s in self.spans if s.get("name") == "incremental_fit"
+        ]
+        keys = (
+            "incremental.lanes_solved", "incremental.lanes_skipped",
+            "incremental.bucket_solves", "incremental.buckets_skipped",
+            "incremental.touched_entities", "incremental.warm_restores",
+            "incremental.grown_entities",
+            "incremental.published_versions", "incremental.fits",
+        )
+        if not fit_spans and not any(c.get(k) for k in keys):
+            return None
+        out: dict[str, Any] = {
+            k.split(".", 1)[1]: int(c.get(k, 0)) for k in keys if k in c
+        }
+        frac = g.get("incremental.touched_fraction")
+        if frac is not None:
+            out["touched_fraction"] = float(frac)
+        per_coord = {
+            name[len("incremental.touched_fraction."):]: float(v)
+            for name, v in g.items()
+            if name.startswith("incremental.touched_fraction.")
+        }
+        if per_coord:
+            out["touched_fraction_by_coordinate"] = per_coord
+        ttf = g.get("incremental.time_to_fresh_s")
+        if ttf is not None:
+            out["time_to_fresh_s"] = float(ttf)
+        if fit_spans:
+            # the newest incremental_fit span carries the lineage attrs
+            attrs = fit_spans[-1].get("attrs") or {}
+            base = {
+                k: v for k, v in attrs.items()
+                if k in ("base", "kind", "base_digest", "base_step",
+                         "delta_digest", "delta_rows", "touched_fraction")
+            }
+            if base:
+                out["base"] = base
+        solved = out.get("lanes_solved", 0)
+        skipped = out.get("lanes_skipped", 0)
+        if solved or skipped:
+            out["lanes_solved_fraction"] = round(
+                solved / max(solved + skipped, 1), 6
+            )
+        return out
+
+    def _freshness_markdown(self) -> list[str]:
+        fresh = self.freshness_summary()
+        if fresh is None:
+            return []
+        out = ["## Freshness", ""]
+        base = fresh.get("base") or {}
+        if base.get("base"):
+            line = f"- warm-started from `{base['base']}`"
+            if base.get("kind"):
+                line += f" ({base['kind']}"
+                if base.get("base_step") is not None:
+                    line += f", step {base['base_step']}"
+                line += ")"
+            out.append(line)
+            if base.get("base_digest"):
+                out.append(f"  - base digest `{base['base_digest'][:16]}…`")
+        if base.get("delta_digest"):
+            line = f"- delta digest `{base['delta_digest'][:16]}…`"
+            if base.get("delta_rows") is not None:
+                line += f", {int(base['delta_rows'])} delta row(s)"
+            out.append(line)
+        touched = fresh.get("touched_entities")
+        if touched is not None:
+            line = f"- touched entities: {touched}"
+            if fresh.get("touched_fraction") is not None:
+                line += f" ({_fmt_pct(fresh['touched_fraction'])})"
+            out.append(line)
+        grown = fresh.get("grown_entities", 0)
+        if grown:
+            out.append(f"- {grown} new entity row(s) zero-initialized "
+                       "(vocabulary growth)")
+        solved = fresh.get("lanes_solved", 0)
+        skipped = fresh.get("lanes_skipped", 0)
+        if solved or skipped:
+            out.append(
+                f"- RE lanes re-solved: **{solved}**; kept bit-identical: "
+                f"**{skipped}** "
+                f"({_fmt_pct(fresh.get('lanes_solved_fraction'))} of lanes "
+                "solved)"
+            )
+        bs = fresh.get("bucket_solves", 0)
+        bsk = fresh.get("buckets_skipped", 0)
+        if bs or bsk:
+            out.append(
+                f"- bucket solves dispatched: {bs}; skipped entirely "
+                f"(zero touched entities): {bsk}"
+            )
+        ttf = fresh.get("time_to_fresh_s")
+        if ttf is not None:
+            out.append(f"- time-to-fresh-model: {ttf:.2f} s")
+        published = fresh.get("published_versions", 0)
+        if published:
+            out.append(
+                f"- {published} version(s) published with lineage metadata"
             )
         out.append("")
         return out

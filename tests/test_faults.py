@@ -64,6 +64,14 @@ EXPECTED_POINTS = {
     # fleet observability (supervisor-side: neither matrix — status is
     # observability, never control; covered by tests/test_fleet_status)
     "fleet.status_write",
+    # incremental warm-start retrains (plain points — the warm restore
+    # and delta scan are read-only, and the publish rides the registry's
+    # tmp-then-rename; the incremental crash row in
+    # tests/test_incremental.py kills at incremental.publish and proves
+    # the base checkpoint and registry stay intact)
+    "incremental.warm_restore",
+    "incremental.delta_scan",
+    "incremental.publish",
 }
 
 WRITE_PATH_POINTS = [
@@ -97,6 +105,7 @@ def test_registry_catalog_is_complete_and_stable():
     import photon_ml_tpu.parallel.distributed  # noqa: F401
     import photon_ml_tpu.parallel.fleet_status  # noqa: F401
     import photon_ml_tpu.parallel.multihost  # noqa: F401
+    import photon_ml_tpu.incremental  # noqa: F401
 
     registered = faults.registered_points()
     assert set(registered) == EXPECTED_POINTS

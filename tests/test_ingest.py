@@ -116,6 +116,42 @@ def test_planner_deterministic_and_block_aligned(tmp_path, rng):
         assert file_plans[-1].byte_end == meta.file_bytes
 
 
+def test_planner_stable_when_shard_list_grows(tmp_path, rng):
+    """The incremental-retrain contract (ISSUE 14): appending delta
+    files to the shard list must keep every OLD chunk's id, byte range,
+    and global row offset — "yesterday's data ∪ today's delta" replays
+    yesterday's prefix identically, so a checkpoint's next_chunk cursor
+    stays valid across the grown list."""
+    paths = _write_shards(tmp_path, rng, n_rows=900, n_files=2,
+                          block_records=100)
+    _, plans_old = plan_chunks(paths, chunk_rows=250)
+    (tmp_path / "delta").mkdir()
+    delta = _write_shards(tmp_path / "delta", rng, n_rows=300, n_files=1,
+                          block_records=100)
+    _, plans_new = plan_chunks(paths + delta, chunk_rows=250)
+    assert len(plans_new) > len(plans_old)
+    # the old plan IS a prefix of the grown plan, field for field
+    assert plans_new[: len(plans_old)] == plans_old
+    # appended chunks continue ids and row offsets gap-free
+    off = sum(p.n_rows for p in plans_old)
+    for i, p in enumerate(plans_new[len(plans_old):]):
+        assert p.index == len(plans_old) + i
+        assert p.row_start == off
+        off += p.n_rows
+    # per-host splits of the shared prefix are unchanged: the resume
+    # contract holds for every fleet member under the grown file list
+    from photon_ml_tpu.ingest import plans_for_host
+
+    for nproc in (2, 3):
+        for pid in range(nproc):
+            old_split = plans_for_host(plans_old, pid, nproc)
+            new_split = [
+                p for p in plans_for_host(plans_new, pid, nproc)
+                if p.index < len(plans_old)
+            ]
+            assert new_split == old_split
+
+
 def test_planner_rejects_corrupt_sync(tmp_path, rng):
     [path] = _write_shards(tmp_path, rng, n_rows=300, n_files=1)
     data = bytearray(open(path, "rb").read())

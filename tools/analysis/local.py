@@ -72,6 +72,11 @@ L011_HOT_DIRS = (
     # bare jax.jit there (and any sync reachable from it, L013) would be
     # invisible on exactly the path the overlap benches gate
     os.path.join("photon_ml_tpu", "ingest") + os.sep,
+    # incremental warm-start retrains: the masked-lane re-solves and the
+    # vocabulary-growth row expansion run on the training hot path — a
+    # bare jax.jit there would hide exactly the solve-count structure
+    # bench_freshness gates the ≥10× time-to-fresh claim on
+    os.path.join("photon_ml_tpu", "incremental") + os.sep,
 )
 L011_HOT_FILES = {
     os.path.join("photon_ml_tpu", "serving", "engine.py"),
