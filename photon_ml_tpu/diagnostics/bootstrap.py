@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu import telemetry
 from photon_ml_tpu.diagnostics.evaluation import evaluate
 from photon_ml_tpu.models.glm import make_model
 from photon_ml_tpu.ops.objective import make_objective
@@ -162,11 +163,15 @@ def bootstrap_train(
     res = solver(
         obj, batch, jnp.asarray(sample_weights, jnp.float32), w0, l1, constraints
     )
-    W = np.asarray(res.w)  # [B, d], optimization (normalized) space
+    # [B, d] coefficient matrix, fetched ONCE through the accounted
+    # crossing (lint L019: a bare np.asarray here would be an invisible
+    # device->host sync); optimization (normalized) space
+    W = telemetry.sync_fetch(res.w, label="bootstrap_coefficients")
     if normalization is not None:
         # models live in original space (createModel parity)
-        W = np.asarray(
-            jax.vmap(normalization.transform_model_coefficients)(res.w)
+        W = telemetry.sync_fetch(
+            jax.vmap(normalization.transform_model_coefficients)(res.w),
+            label="bootstrap_coefficients",
         )
 
     coef_summaries = [CoefficientSummary.of(W[:, j]) for j in range(W.shape[1])]

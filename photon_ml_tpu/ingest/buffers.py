@@ -162,6 +162,11 @@ class BufferRing:
         return len(self._all)
 
     def acquire(self) -> StagingBuffer:
+        # a registered L017 BORROWED-memory source: the returned slot is
+        # recycled the moment release() runs, so its arrays must never
+        # reach a donated jit argument un-laundered (the dataflow gate
+        # tracks this; renaming acquire fails the gate with W002 —
+        # tools/analysis/dataflow.py::RING_SOURCES)
         faults.fault_point(_FP_RING_ACQUIRE)
         with self._cv:
             waited = self._cv.wait_for(

@@ -216,7 +216,13 @@ def _owned_copy(array):
     preserved — the copy is per-device, no cross-device traffic). Without
     donation an executable's outputs can never alias its inputs, so the
     result is safe to hand to donating updates no matter where the input
-    buffers came from."""
+    buffers came from.
+
+    This function (with :func:`place_entity_rows`) is a registered L017
+    SANITIZER: the dataflow gate treats its result as owned and stops
+    tracking borrowed host memory through it. Renaming it fails the gate
+    with W002 (``tools/analysis/dataflow.py::COPY_SANITIZERS``) rather
+    than silently laundering nothing."""
     from photon_ml_tpu import telemetry  # lazy: keep sharding importable solo
 
     global _OWNED_COPY_JIT

@@ -1,0 +1,1011 @@
+"""Fixture-driven coverage for the interprocedural dataflow gate
+(ISSUE 15): L017 donation safety, L018 lock-order cycles, L019
+unsanctioned host transfer, the chain-dedupe, and ``--changed``.
+
+Every rule gets planted-defect positives (asserted through the REAL
+``tools/check.py`` CLI where the acceptance criteria demand it) and
+sanctioned-idiom negatives; the taint engine's interprocedural
+propagation (arguments/returns one call level deep) gets direct units;
+and a donated-mmap defect planted in a COPY of the real
+``photon_ml_tpu/ingest/assemble.py`` flips the CLI to exit 1 naming the
+flow chain — the PR 10 bug class can no longer land silently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.analysis import core, dataflow, driver, locks
+from tools.analysis.callgraph import build_graph
+
+CHECK = os.path.join(REPO, "tools", "check.py")
+
+
+def write_tree(tmp_path, files: dict):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+    return tmp_path
+
+
+def analyze(tmp_path, files: dict, **kw):
+    write_tree(tmp_path, files)
+    kw.setdefault("require_seeds", False)
+    return driver.analyze(str(tmp_path), **kw)
+
+
+def graph_of(tmp_path, files: dict):
+    write_tree(tmp_path, files)
+    srcs = []
+    for rel in files:
+        if rel.startswith("photon_ml_tpu/") and rel.endswith(".py"):
+            srcs.append(core.load_source(rel, str(tmp_path / rel)))
+    return build_graph(srcs)
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def run_cli(root):
+    proc = subprocess.run(
+        [sys.executable, CHECK, "--root", str(root), "--json"],
+        capture_output=True, text=True, timeout=180,
+    )
+    return proc, json.loads(proc.stdout)
+
+
+# the instrumented_jit shim every fixture resolves through (mirrors the
+# real re-export surface: telemetry/__init__ re-exports xla's wrapper)
+_XLA_SHIM = {
+    "photon_ml_tpu/__init__.py": "",
+    "photon_ml_tpu/telemetry/__init__.py": (
+        "from photon_ml_tpu.telemetry.xla import instrumented_jit\n"
+    ),
+    "photon_ml_tpu/telemetry/xla.py": (
+        "def instrumented_jit(fn=None, name=None, multi_shape=False,"
+        " **kw):\n"
+        "    return fn\n"
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# L017 donation safety
+# ---------------------------------------------------------------------------
+
+
+def _donation_tree(
+    source_stmt: str, arg: str, extra_imports: str = ""
+) -> dict:
+    """The ingest-assembler idiom: a factory returning a donating
+    executable, called with ``arg`` in the donated slot."""
+    files = dict(_XLA_SHIM)
+    files["photon_ml_tpu/ingest/__init__.py"] = ""
+    files["photon_ml_tpu/ingest/spill.py"] = (
+        "import numpy as np\n"
+        f"{extra_imports}"
+        "from photon_ml_tpu import telemetry\n\n"
+        "SPILL_DTYPE = np.float32\n\n\n"
+        "def _writer(donate):\n"
+        "    def write(buf, v, off):\n"
+        "        return buf\n"
+        "    return telemetry.instrumented_jit(\n"
+        "        write, name='spill_write',\n"
+        "        donate_argnums=(0,) if donate else (),\n"
+        "    )\n\n\n"
+        "def resume(path, v, off):\n"
+        f"    {source_stmt}\n"
+        f"    return _writer(True)({arg}, v, off)\n"
+    )
+    return files
+
+
+class TestDonationSafetyL017:
+    def test_mmap_load_into_donated_slot_flagged(self, tmp_path):
+        res = analyze(
+            tmp_path,
+            _donation_tree("buf = np.load(path, mmap_mode='r')", "buf"),
+        )
+        assert codes(res.findings) == ["L017"]
+        f = res.findings[0]
+        assert f.path == "photon_ml_tpu/ingest/spill.py"
+        assert "np.load(mmap_mode=...)" in f.message
+        assert "donated argument 0" in f.message
+        assert "spill_write" in f.message
+        # the flow chain names the binding hop
+        assert "`buf`" in f.message
+
+    def test_frombuffer_into_direct_jax_jit_donation(self, tmp_path):
+        files = dict(_XLA_SHIM)
+        files["photon_ml_tpu/m.py"] = """
+            import jax
+            import numpy as np
+
+
+            def write(buf):
+                return buf
+
+
+            def push(raw):
+                view = np.frombuffer(raw, np.uint8)
+                fn = jax.jit(write, donate_argnums=(0,))
+                return fn(view)
+        """
+        res = analyze(tmp_path, files)
+        assert codes(res.findings) == ["L017"]
+        assert "np.frombuffer" in res.findings[0].message
+
+    def test_view_of_parameter_donated_flagged(self, tmp_path):
+        res = analyze(
+            tmp_path,
+            _donation_tree("buf = v[:128]", "buf"),
+        )
+        assert codes(res.findings) == ["L017"]
+        assert "view/slice of parameter `v`" in res.findings[0].message
+
+    def test_interprocedural_borrow_through_callee_donation(self, tmp_path):
+        """The caller holds the mmap; the DONATION happens one call away
+        inside a helper — the finding stitches the two."""
+        files = dict(_XLA_SHIM)
+        files["photon_ml_tpu/io2.py"] = """
+            import numpy as np
+
+            from photon_ml_tpu import telemetry
+
+
+            def _writer():
+                def write(buf):
+                    return buf
+                return telemetry.instrumented_jit(
+                    write, name='w', donate_argnums=(0,)
+                )
+
+
+            def commit(table):
+                return _writer()(table)
+
+
+            def restore(path):
+                base = np.load(path, mmap_mode='r')
+                return commit(base)
+        """
+        res = analyze(tmp_path, files)
+        assert codes(res.findings) == ["L017"]
+        f = res.findings[0]
+        assert f.chain == ("io2.restore", "io2.commit")
+        assert "donates it" in f.message
+
+    def test_sanctioned_copy_launders(self, tmp_path):
+        for launder, imports in (
+            ("buf = jnp.array(np.load(path, mmap_mode='r'), copy=True)",
+             "import jax.numpy as jnp\n"),
+            ("buf = np.load(path, mmap_mode='r').copy()", ""),
+            ("buf = np.array(np.load(path, mmap_mode='r'))", ""),
+        ):
+            files = _donation_tree(launder, "buf", extra_imports=imports)
+            res = analyze(tmp_path, files)
+            assert res.findings == [], (launder, codes(res.findings))
+
+    def test_owned_buffer_donation_clean(self, tmp_path):
+        # the real assembler donates buffers IT allocated — no taint
+        files = _donation_tree(
+            "buf = jnp.zeros(128)", "buf",
+            extra_imports="import jax.numpy as jnp\n",
+        )
+        res = analyze(tmp_path, files)
+        assert res.findings == []
+
+    def test_non_donated_slot_clean(self, tmp_path):
+        # borrowed memory in a NON-donated argument is fine (the
+        # executable reads it; nothing frees it)
+        res = analyze(
+            tmp_path,
+            _donation_tree("v = np.load(path, mmap_mode='r')", "off"),
+        )
+        assert res.findings == []
+
+    def test_noqa_suppresses_l017(self, tmp_path):
+        files = _donation_tree("buf = np.load(path, mmap_mode='r')", "buf")
+        files["photon_ml_tpu/ingest/spill.py"] = files[
+            "photon_ml_tpu/ingest/spill.py"
+        ].replace(
+            "return _writer(True)(buf, v, off)",
+            "return _writer(True)(buf, v, off)  # photon: noqa[L017]",
+        )
+        res = analyze(tmp_path, files)
+        assert res.findings == []
+
+    def test_planted_defect_fails_real_cli_with_chain(self, tmp_path):
+        write_tree(
+            tmp_path,
+            _donation_tree("buf = np.load(path, mmap_mode='r')", "buf"),
+        )
+        proc, doc = run_cli(tmp_path)
+        assert proc.returncode == 1
+        (finding,) = doc["findings"]
+        assert finding["code"] == "L017"
+        assert finding["chain"] == ["ingest.spill.resume"]
+        assert "np.load(mmap_mode=...)" in finding["message"]
+        assert "`buf`" in finding["message"]  # the complete flow chain
+
+
+# ---------------------------------------------------------------------------
+# L018 lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+def _lock_tree(publish_body: str) -> dict:
+    """The serving topology in miniature: the engine's version lock vs
+    the registry's lock; ``publish_body`` decides whether the registry
+    calls back into the engine WHILE holding its own lock (a cycle) or
+    after releasing it (a consistent order)."""
+    return {
+        "photon_ml_tpu/__init__.py": "",
+        "photon_ml_tpu/serving/__init__.py": "",
+        "photon_ml_tpu/serving/engine.py": """
+            import threading
+
+            from photon_ml_tpu.serving.registry import ModelRegistry
+
+
+            class ScoringEngine:
+                def __init__(self):
+                    self._version_lock = threading.Lock()
+                    self._registry = ModelRegistry()
+
+                def swap(self):
+                    with self._version_lock:
+                        self._registry.refresh()
+
+                def bump_seq(self):
+                    with self._version_lock:
+                        pass
+        """,
+        "photon_ml_tpu/serving/registry.py": (
+            """
+            import threading
+
+
+            def _engine_of(source) -> "ScoringEngine":
+                from photon_ml_tpu.serving.engine import ScoringEngine
+                return source
+
+
+            class ModelRegistry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def refresh(self):
+                    with self._lock:
+                        pass
+
+"""
+            + publish_body
+        ),
+    }
+
+
+_CYCLE_PUBLISH = """\
+                def publish(self, source):
+                    with self._lock:
+                        engine = _engine_of(source)
+                        engine.bump_seq()
+"""
+
+_ORDERED_PUBLISH = """\
+                def publish(self, source):
+                    with self._lock:
+                        pass
+                    engine = _engine_of(source)
+                    engine.bump_seq()
+"""
+
+_CYCLE_TREE = _lock_tree(_CYCLE_PUBLISH)
+
+
+class TestLockOrderL018:
+    def test_opposite_order_cycle_flagged(self, tmp_path):
+        res = analyze(tmp_path, _CYCLE_TREE)
+        assert codes(res.findings) == ["L018"]
+        msg = res.findings[0].message
+        assert "lock-order cycle" in msg
+        assert "ScoringEngine._version_lock" in msg
+        assert "ModelRegistry._lock" in msg
+        # both acquisition legs are named with their call chains
+        assert "ScoringEngine.swap -> " in msg
+        assert "ModelRegistry.publish -> " in msg
+
+    def test_consistent_order_clean(self, tmp_path):
+        # the registry releases its lock BEFORE calling back into the
+        # engine: same locks, no cycle
+        res = analyze(tmp_path, _lock_tree(_ORDERED_PUBLISH))
+        assert res.findings == []
+
+    def test_self_reacquire_through_helper_flagged(self, tmp_path):
+        files = dict(_XLA_SHIM)
+        files["photon_ml_tpu/h.py"] = """
+            import threading
+
+
+            class Holder:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+        """
+        res = analyze(tmp_path, files)
+        assert codes(res.findings) == ["L018"]
+        assert "re-acquired while held" in res.findings[0].message
+
+    def test_lexical_nesting_is_an_order_not_a_cycle(self, tmp_path):
+        files = dict(_XLA_SHIM)
+        files["photon_ml_tpu/n.py"] = """
+            import threading
+
+
+            class TwoLocks:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def both(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def both_again(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+        """
+        res = analyze(tmp_path, files)
+        assert res.findings == []  # same order everywhere: no cycle
+
+    def test_call_in_with_item_is_a_held_call(self, tmp_path):
+        """`with self._lock, helper():` runs ``helper`` while the first
+        item's lock is held — its acquisitions are order edges too
+        (code-review regression)."""
+        files = dict(_XLA_SHIM)
+        files["photon_ml_tpu/w.py"] = """
+            import threading
+
+
+            class A:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b = B(self)
+
+                def fwd(self):
+                    with self._a_lock, self._b.use():
+                        pass
+
+                def poke(self):
+                    with self._a_lock:
+                        pass
+
+
+            class B:
+                def __init__(self, a):
+                    self._b_lock = threading.Lock()
+                    self._a = a
+
+                def use(self):
+                    with self._b_lock:
+                        pass
+                    return open("/dev/null")
+
+                def back(self, a: "A"):
+                    with self._b_lock:
+                        a.poke()
+        """
+        res = analyze(tmp_path, files)
+        assert "L018" in codes(res.findings)
+
+    def test_lexical_opposite_orders_cycle(self, tmp_path):
+        files = dict(_XLA_SHIM)
+        files["photon_ml_tpu/n.py"] = """
+            import threading
+
+
+            class TwoLocks:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+
+                def ab(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+
+                def ba(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """
+        res = analyze(tmp_path, files)
+        assert codes(res.findings) == ["L018"]
+
+    def test_cycle_fails_real_cli(self, tmp_path):
+        write_tree(tmp_path, _CYCLE_TREE)
+        proc, doc = run_cli(tmp_path)
+        assert proc.returncode == 1
+        l018 = [f for f in doc["findings"] if f["code"] == "L018"]
+        assert len(l018) == 1
+        assert "_version_lock" in l018[0]["message"]
+        assert "_lock" in l018[0]["message"]
+
+    def test_real_serving_lock_graph_is_acyclic(self):
+        """The REAL serving/nearline/registry/fleet lock topology: locks
+        exist (nodes), and the order graph has no cycles — the shipped
+        tree passes with the rule armed."""
+        rels = [
+            os.path.join("photon_ml_tpu", "serving", "engine.py"),
+            os.path.join("photon_ml_tpu", "serving", "registry.py"),
+            os.path.join("photon_ml_tpu", "serving", "nearline.py"),
+            os.path.join("photon_ml_tpu", "serving", "batcher.py"),
+            os.path.join("photon_ml_tpu", "serving", "server.py"),
+            os.path.join("photon_ml_tpu", "parallel", "fleet_status.py"),
+            os.path.join("photon_ml_tpu", "telemetry", "progress.py"),
+        ]
+        srcs = [core.load_source(rel, os.path.join(REPO, rel))
+                for rel in rels]
+        g = build_graph(srcs)
+        stats: dict = {}
+        findings = locks.run_lock_order(g, stats)
+        assert stats["nodes"] >= 4  # engine/registry/nearline/fleet locks
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# L019 unsanctioned host transfer
+# ---------------------------------------------------------------------------
+
+
+def _transfer_tree(body: str, extra_imports: str = "") -> dict:
+    files = dict(_XLA_SHIM)
+    files["photon_ml_tpu/score.py"] = (
+        f"{extra_imports}"
+        "from photon_ml_tpu import telemetry\n\n\n"
+        "def _scorer():\n"
+        "    def run(x):\n"
+        "        return x\n"
+        "    return telemetry.instrumented_jit(run, name='score')\n\n\n"
+        "def evaluate(batch):\n"
+        f"    {body}\n"
+    )
+    return files
+
+
+class TestHostTransferL019:
+    def test_float_of_jitted_result_flagged(self, tmp_path):
+        res = analyze(
+            tmp_path,
+            _transfer_tree(
+                "scores = _scorer()(batch)\n    return float(scores)"
+            ),
+        )
+        assert codes(res.findings) == ["L019"]
+        f = res.findings[0]
+        assert "float()" in f.message
+        assert "result of jitted `score`" in f.message
+
+    def test_asarray_and_tolist_flagged(self, tmp_path):
+        res = analyze(
+            tmp_path,
+            _transfer_tree(
+                "scores = _scorer()(batch)\n"
+                "    a = np.asarray(scores)\n"
+                "    return scores.tolist(), a",
+                extra_imports="import numpy as np\n",
+            ),
+        )
+        assert codes(res.findings) == ["L019", "L019"]
+        msgs = " | ".join(f.message for f in res.findings)
+        assert "np.asarray" in msgs and ".tolist()" in msgs
+
+    def test_comparison_in_branch_flagged(self, tmp_path):
+        res = analyze(
+            tmp_path,
+            _transfer_tree(
+                "scores = _scorer()(batch)\n"
+                "    if scores > 0:\n"
+                "        return 1\n"
+                "    return 0"
+            ),
+        )
+        assert codes(res.findings) == ["L019"]
+        assert "comparison in a branch condition" in res.findings[0].message
+
+    def test_shape_comparison_and_is_none_clean(self, tmp_path):
+        # array METADATA and identity checks are host-side bookkeeping,
+        # not transfers — the false positives the audit flushed out
+        res = analyze(
+            tmp_path,
+            _transfer_tree(
+                "scores = _scorer()(batch)\n"
+                "    if scores.shape[0] > 4:\n"
+                "        return 1\n"
+                "    if scores is not None:\n"
+                "        return 2\n"
+                "    return scores"
+            ),
+        )
+        assert res.findings == []
+
+    def test_sync_fetch_pass_through_clean(self, tmp_path):
+        files = _transfer_tree(
+            "scores = _scorer()(batch)\n"
+            "    host = sync_fetch(scores, label='scores')\n"
+            "    return float(host)",
+            extra_imports=(
+                "from photon_ml_tpu.telemetry.device import sync_fetch\n"
+            ),
+        )
+        files["photon_ml_tpu/telemetry/device.py"] = (
+            "import numpy as np\n\n\n"
+            "def sync_fetch(x, label=None):\n"
+            "    return np.asarray(x)\n"
+        )
+        res = analyze(tmp_path, files)
+        assert res.findings == []
+
+    def test_interprocedural_device_result_through_helper(self, tmp_path):
+        """The jitted call is hidden in a helper; its RETURN carries the
+        device taint into the caller's float()."""
+        res = analyze(
+            tmp_path,
+            _transfer_tree(
+                "return float(_solve(batch))\n\n\n"
+                "def _solve(batch):\n"
+                "    return _scorer()(batch)"
+            ),
+        )
+        assert codes(res.findings) == ["L019"]
+        assert "via `_solve`" in res.findings[0].message
+
+    def test_param_sink_inside_callee_flagged_at_caller(self, tmp_path):
+        """The SINK is inside the callee (it floats its parameter); the
+        caller hands it a jitted result — flagged with both sides."""
+        res = analyze(
+            tmp_path,
+            _transfer_tree(
+                "scores = _scorer()(batch)\n"
+                "    return _log_scalar(scores)\n\n\n"
+                "def _log_scalar(v):\n"
+                "    return float(v)"
+            ),
+        )
+        assert codes(res.findings) == ["L019"]
+        f = res.findings[0]
+        assert "inside `_log_scalar`" in f.message
+        assert f.chain == ("score.evaluate", "score._log_scalar")
+
+    def test_plain_float_without_device_source_clean(self, tmp_path):
+        res = analyze(
+            tmp_path,
+            _transfer_tree("return float(len(batch))"),
+        )
+        assert res.findings == []
+
+    def test_planted_transfer_fails_real_cli(self, tmp_path):
+        write_tree(
+            tmp_path,
+            _transfer_tree(
+                "scores = _scorer()(batch)\n    return float(scores)"
+            ),
+        )
+        proc, doc = run_cli(tmp_path)
+        assert proc.returncode == 1
+        (finding,) = doc["findings"]
+        assert finding["code"] == "L019"
+        assert finding["chain"] == ["score.evaluate"]
+
+
+# ---------------------------------------------------------------------------
+# Taint-propagation units (the engine itself)
+# ---------------------------------------------------------------------------
+
+
+class TestTaintPropagation:
+    def _summaries(self, tmp_path, files):
+        g = graph_of(tmp_path, files)
+        summaries = {}
+        for qname, fn in sorted(g.functions.items()):
+            flow = dataflow._FunctionFlow(g, fn, {}, dataflow.Stats())
+            summaries[qname] = flow.run()
+        for qname, fn in sorted(g.functions.items()):
+            flow = dataflow._FunctionFlow(
+                g, fn, summaries, dataflow.Stats()
+            )
+            summaries[qname] = flow.run()
+        return g, summaries
+
+    def test_returns_borrowed_summary(self, tmp_path):
+        g, summaries = self._summaries(
+            tmp_path,
+            {
+                "photon_ml_tpu/__init__.py": "",
+                "photon_ml_tpu/m.py": (
+                    "import numpy as np\n\n\n"
+                    "def open_base(path):\n"
+                    "    return np.load(path, mmap_mode='r')\n"
+                ),
+            },
+        )
+        ret = summaries["photon_ml_tpu.m.open_base"].returns
+        assert any(t.kind == dataflow.BORROWED for t in ret)
+
+    def test_returns_view_of_param_summary(self, tmp_path):
+        g, summaries = self._summaries(
+            tmp_path,
+            {
+                "photon_ml_tpu/__init__.py": "",
+                "photon_ml_tpu/m.py": (
+                    "def head(a, n):\n"
+                    "    return a[:n]\n"
+                ),
+            },
+        )
+        ret = summaries["photon_ml_tpu.m.head"].returns
+        borrowed = [t for t in ret if t.kind == dataflow.BORROWED]
+        assert borrowed and borrowed[0].param == 0
+
+    def test_param_donation_summary(self, tmp_path):
+        files = dict(_XLA_SHIM)
+        files["photon_ml_tpu/m.py"] = """
+            from photon_ml_tpu import telemetry
+
+
+            def _w():
+                def write(buf):
+                    return buf
+                return telemetry.instrumented_jit(
+                    write, name='w', donate_argnums=(0,)
+                )
+
+
+            def commit(table):
+                return _w()(table)
+        """
+        g, summaries = self._summaries(tmp_path, files)
+        dons = summaries["photon_ml_tpu.m.commit"].param_donations
+        assert 0 in dons
+
+    def test_branch_join_keeps_both_taints(self, tmp_path):
+        # `x` is borrowed on ONE branch: the join must keep the taint
+        files = _donation_tree(
+            "buf = v\n"
+            "    if off:\n"
+            "        buf = np.load(path, mmap_mode='r')",
+            "buf",
+        )
+        import tools.analysis.driver as drv
+
+        write_tree(tmp_path, files)
+        res = drv.analyze(str(tmp_path), require_seeds=False)
+        assert codes(res.findings) == ["L017"]
+
+    def test_sanitizer_kills_taint_on_reassignment(self, tmp_path):
+        files = _donation_tree(
+            "buf = np.load(path, mmap_mode='r')\n"
+            "    buf = buf.copy()",
+            "buf",
+        )
+        res = analyze(tmp_path, files)
+        assert res.findings == []
+
+    def test_tuple_unpacking_distributes_taint(self, tmp_path):
+        files = _donation_tree(
+            "buf, other = np.load(path, mmap_mode='r'), 1",
+            "buf",
+        )
+        res = analyze(tmp_path, files)
+        assert codes(res.findings) == ["L017"]
+
+    def test_copy_false_is_not_a_sanitizer(self, tmp_path):
+        # np.array(x, copy=False) ALIASES — the taint must flow through
+        # (code-review regression)
+        files = _donation_tree(
+            "buf = np.array(np.load(path, mmap_mode='r'), copy=False)",
+            "buf",
+        )
+        res = analyze(tmp_path, files)
+        assert codes(res.findings) == ["L017"]
+
+    def test_element_write_does_not_disown_the_array(self, tmp_path):
+        # `buf[0] = 0` mutates without disowning: the frombuffer taint
+        # survives to the donation (code-review regression)
+        files = _donation_tree(
+            "buf = np.frombuffer(path, np.uint8)\n"
+            "    buf[0] = 0",
+            "buf",
+        )
+        res = analyze(tmp_path, files)
+        assert codes(res.findings) == ["L017"]
+
+    def test_while_condition_sees_loop_carried_device_taint(self, tmp_path):
+        # the canonical convergence loop: `while err > tol:` where err
+        # is re-bound to a jitted result INSIDE the body (code-review
+        # regression — the test re-executes every iteration)
+        res = analyze(
+            tmp_path,
+            _transfer_tree(
+                "err = 1.0\n"
+                "    while err > 0.5:\n"
+                "        err = _scorer()(batch)\n"
+                "    return err"
+            ),
+        )
+        assert "L019" in codes(res.findings)
+
+
+# ---------------------------------------------------------------------------
+# Chain dedupe (driver satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestChainDedupe:
+    def test_multiple_chains_report_once_with_shortest(self, tmp_path):
+        """One impure traced helper reached from TWO jit registrations:
+        one finding, shortest chain, alternates counted."""
+        files = dict(_XLA_SHIM)
+        files["photon_ml_tpu/s.py"] = """
+            import time
+
+            import jax
+
+
+            def _tick(x):
+                return x * time.time()
+
+
+            def direct():
+                return jax.jit(_tick)
+
+
+            def nested():
+                def run(x):
+                    return _tick(x) + 1
+                return jax.jit(run)
+        """
+        res = analyze(tmp_path, files)
+        l014 = [f for f in res.findings if f.code == "L014"]
+        assert len(l014) == 1
+        f = l014[0]
+        assert f.chain == ("s._tick",)  # the shortest of the two
+        assert f.alternates >= 1
+        assert "alternate call chain" in f.render()
+
+    def test_distinct_sites_not_merged(self, tmp_path):
+        files = dict(_XLA_SHIM)
+        files["photon_ml_tpu/s.py"] = """
+            import time
+
+            import jax
+
+
+            def _tick(x):
+                print("x"); return x * time.time()
+
+
+            def direct():
+                return jax.jit(_tick)
+        """
+        res = analyze(tmp_path, files)
+        l014 = [f for f in res.findings if f.code == "L014"]
+        # wall clock + print: two DIFFERENT sites on one line stay apart
+        assert len(l014) == 2
+
+
+# ---------------------------------------------------------------------------
+# --changed (fast pre-commit scope)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def git_tree(tmp_path):
+    files = {
+        "photon_ml_tpu/__init__.py": "",
+        "photon_ml_tpu/util.py": "def helper(x):\n    return x\n",
+        "photon_ml_tpu/caller.py": (
+            "from photon_ml_tpu.util import helper\n\n\n"
+            "def use(x):\n    return helper(x)\n"
+        ),
+        "photon_ml_tpu/standalone.py": (
+            "import os\n"  # an L001 in an UNRELATED file
+        ),
+    }
+    write_tree(tmp_path, files)
+    env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    for cmd in (
+        ["git", "init", "-q"],
+        ["git", "add", "-A"],
+        ["git", "commit", "-qm", "seed"],
+    ):
+        subprocess.run(cmd, cwd=tmp_path, env=env, check=True,
+                       capture_output=True)
+    return tmp_path
+
+
+class TestChangedScope:
+    def test_changed_file_and_dependents_in_scope(self, git_tree):
+        # introduce a finding in util.py (changed) and leave the
+        # unrelated standalone.py finding untouched (pre-existing)
+        (git_tree / "photon_ml_tpu" / "util.py").write_text(
+            "import json\n\n\ndef helper(x):\n    return x\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, CHECK, "--root", str(git_tree),
+             "--changed", "HEAD", "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        doc = json.loads(proc.stdout)
+        assert proc.returncode == 1
+        scope = set(doc["changed_scope"])
+        assert "photon_ml_tpu/util.py" in scope
+        # caller.py calls into the changed file: a DEPENDENT, in scope
+        assert "photon_ml_tpu/caller.py" in scope
+        assert "photon_ml_tpu/standalone.py" not in scope
+        assert [f["path"] for f in doc["findings"]] == [
+            "photon_ml_tpu/util.py"
+        ]
+
+    def test_unchanged_tree_is_clean_and_fast_scope_is_empty(self, git_tree):
+        proc = subprocess.run(
+            [sys.executable, CHECK, "--root", str(git_tree),
+             "--changed", "HEAD", "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        doc = json.loads(proc.stdout)
+        assert proc.returncode == 0
+        assert doc["changed_scope"] == []
+        assert doc["findings"] == []  # standalone.py L001 out of scope
+
+    def test_w002_survives_changed_scope(self, git_tree):
+        """Renaming a registered sanitizer must fail even the scoped
+        pre-commit run: W002 is pass-config health, never scoped out
+        (code-review regression)."""
+        write_tree(git_tree, {"photon_ml_tpu/__init__.py": ""})
+        res = driver.analyze(
+            str(git_tree), require_seeds=True,
+            changed={"photon_ml_tpu/util.py"},
+        )
+        assert "W002" in codes(res.findings)
+
+    def test_write_baseline_with_changed_is_rejected(self, git_tree):
+        proc = subprocess.run(
+            [sys.executable, CHECK, "--root", str(git_tree),
+             "--changed", "HEAD", "--write-baseline",
+             str(git_tree / "b.json")],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode != 0
+        assert "full tree" in proc.stderr
+        assert not (git_tree / "b.json").exists()
+
+    def test_full_tree_behavior_unchanged(self, git_tree):
+        # without --changed the pre-existing L001 still fails the gate
+        proc = subprocess.run(
+            [sys.executable, CHECK, "--root", str(git_tree), "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        doc = json.loads(proc.stdout)
+        assert proc.returncode == 1
+        assert "changed_scope" not in doc
+        assert [f["path"] for f in doc["findings"]] == [
+            "photon_ml_tpu/standalone.py"
+        ]
+
+
+# ---------------------------------------------------------------------------
+# W002: the sanitizer/ring-source tables must keep resolving
+# ---------------------------------------------------------------------------
+
+
+class TestDataflowSeedGuard:
+    def test_missing_sanitizer_is_w002_on_real_trees(self, tmp_path):
+        """With ``require_seeds=True`` (the real repo), a tree where the
+        registered L017 sanitizers do not resolve fails with W002 — a
+        rename of `_owned_copy` must not silently launder nothing."""
+        write_tree(tmp_path, {"photon_ml_tpu/__init__.py": ""})
+        res = driver.analyze(str(tmp_path), require_seeds=True)
+        msgs = [f.message for f in res.findings if f.code == "W002"]
+        assert any("COPY_SANITIZERS" in m for m in msgs), msgs
+        assert any("RING_SOURCES" in m for m in msgs), msgs
+
+    def test_real_tree_sanitizers_resolve(self):
+        srcs = []
+        for rel in (
+            os.path.join("photon_ml_tpu", "parallel", "sharding.py"),
+            os.path.join("photon_ml_tpu", "ingest", "buffers.py"),
+        ):
+            srcs.append(core.load_source(rel, os.path.join(REPO, rel)))
+        g = build_graph(srcs)
+        for qname in sorted(
+            dataflow.COPY_SANITIZERS | dataflow.RING_SOURCES
+        ):
+            assert qname in g.functions, qname
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: a donated-mmap defect planted in the REAL ingest module
+# ---------------------------------------------------------------------------
+
+
+class TestPlantedRealTreeDefect:
+    def test_donated_mmap_in_real_assembler_fails_gate(self, tmp_path):
+        """Copy the real package, plant the PR 10 bug class in
+        ``ingest/assemble.py`` (an mmap'd spill resume donated into the
+        real ``_chunk_writer``), and prove the REAL CLI exits 1 naming
+        the complete flow chain."""
+        shutil.copytree(
+            os.path.join(REPO, "photon_ml_tpu"),
+            tmp_path / "photon_ml_tpu",
+            ignore=shutil.ignore_patterns("__pycache__"),
+        )
+        target = tmp_path / "photon_ml_tpu" / "ingest" / "assemble.py"
+        target.write_text(
+            target.read_text()
+            + textwrap.dedent(
+                """
+
+                def _resume_from_spill(spill_path, asm):
+                    vals = np.load(spill_path, mmap_mode="r")
+                    writer = _chunk_writer(True)
+                    asm._v, asm._r, asm._c = writer(
+                        vals, asm._r, asm._c, vals, asm._r, asm._c,
+                        jnp.int32(0), jnp.int32(0),
+                    )
+                """
+            )
+        )
+        proc, doc = run_cli(tmp_path)
+        assert proc.returncode == 1, proc.stdout
+        l017 = [f for f in doc["findings"] if f["code"] == "L017"]
+        assert l017, doc["findings"]
+        # the donated slots also carry fields of the caller-owned `asm`
+        # parameter (borrowed too) — the mmap flow is the one we assert
+        mmap = [f for f in l017 if "np.load(mmap_mode=...)" in f["message"]]
+        assert mmap, l017
+        f = mmap[0]
+        assert f["path"] == "photon_ml_tpu/ingest/assemble.py"
+        assert "ingest_assemble_write" in f["message"]
+        assert "`vals`" in f["message"]  # the flow hop
+        assert f["chain"] == ["ingest.assemble._resume_from_spill"]
+
+    def test_unmodified_real_package_copy_is_clean(self, tmp_path):
+        """The control: the same copy WITHOUT the plant passes — the
+        shipped tree is clean under all three new rules."""
+        shutil.copytree(
+            os.path.join(REPO, "photon_ml_tpu"),
+            tmp_path / "photon_ml_tpu",
+            ignore=shutil.ignore_patterns("__pycache__"),
+        )
+        proc, doc = run_cli(tmp_path)
+        assert proc.returncode == 0, json.dumps(
+            doc.get("findings"), indent=2
+        )
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -37,19 +37,31 @@ BAD_SEED = "W002"
 @dataclasses.dataclass
 class Finding:
     """One gate finding. ``chain`` carries the call path for the
-    interprocedural passes (L013/L014), seed first, offending function
-    last."""
+    interprocedural passes (L013/L014/L017/L019), seed first, offending
+    function last. ``alternates`` counts other call chains that reached
+    the same finding — the driver dedupes to the shortest chain so the
+    report stays readable as the graph grows."""
 
     path: str
     line: int
     code: str
     message: str
     chain: Optional[tuple[str, ...]] = None
+    alternates: int = 0
+    # stable identity of the offending SITE (rule-specific, e.g. the sync
+    # description), independent of which chain reached it — the dedupe
+    # key; None opts a finding out of chain-dedupe entirely
+    site: Optional[str] = None
 
     def render(self) -> str:
         text = f"{self.path}:{self.line}: {self.code} {self.message}"
         if self.chain:
             text += f" [via {' -> '.join(self.chain)}]"
+        if self.alternates:
+            text += (
+                f" (+{self.alternates} alternate call "
+                f"chain{'s' if self.alternates > 1 else ''})"
+            )
         return text
 
     def key(self) -> tuple[str, str, str]:
@@ -67,6 +79,7 @@ class Finding:
             "code": self.code,
             "message": self.message,
             "chain": list(self.chain) if self.chain else None,
+            "alternates": self.alternates,
         }
 
 
@@ -113,6 +126,34 @@ def syntax_findings(files: Iterable[SourceFile]) -> list[Finding]:
                     message=sf.error.msg or "invalid syntax",
                 )
             )
+    return out
+
+
+def dedupe_chain_findings(findings: list[Finding]) -> list[Finding]:
+    """Collapse identical findings reached through multiple call chains.
+
+    Interprocedural passes can reach one offending site from several
+    seeds/roots; reporting each chain separately buries the signal as the
+    graph grows. Findings sharing ``(path, line, code, site)`` collapse
+    to ONE report carrying the SHORTEST chain (ties: first wins), with
+    the others counted in ``alternates``. Findings without a ``site`` or
+    ``chain`` pass through untouched.
+    """
+    by_key: dict[tuple, Finding] = {}
+    out: list[Finding] = []
+    for f in findings:
+        if f.chain is None or f.site is None:
+            out.append(f)
+            continue
+        key = (f.path, f.line, f.code, f.site)
+        cur = by_key.get(key)
+        if cur is None:
+            by_key[key] = f
+            out.append(f)
+        else:
+            if len(f.chain) < len(cur.chain):
+                cur.message, cur.chain = f.message, f.chain
+            cur.alternates += 1
     return out
 
 

@@ -1,0 +1,884 @@
+"""Pass 6 — interprocedural def-use/taint dataflow: L017 donation safety
+and L019 unsanctioned host transfer.
+
+The syntactic passes (L001-L016) match names and call chains; none of
+them track *values*. The two nastiest bugs in the tree so far were value
+bugs exactly those passes could not see: the donated
+``dynamic_update_slice`` that aliased a borrowed host-numpy buffer
+(freed-heap garbage, timing-dependent — the PR 10 class), and hidden
+device->host transfers whose sink was never a named sync call on a
+seeded path. This pass is a small, deliberately-bounded dataflow engine
+over the existing per-function ASTs:
+
+- **intraprocedural**: each function is executed abstractly, statement
+  by statement, propagating *taints* through assignments, tuple
+  unpacking, views/slices, loops, and branch joins (branch environments
+  union; loop bodies run twice to reach the loop-carried fixpoint);
+- **interprocedural, one call level deep**: every function gets a
+  *summary* — the taints it returns, the parameters it donates, the
+  parameters it pushes into host-forcing sinks — and call sites stitch
+  caller taints through callee summaries using the SAME import/self/
+  re-export resolution rules the L013/L014 passes use. Summaries are
+  computed in a first phase and consumed in a second, so a flow through
+  one helper (and often deeper, via summaries-of-summaries) is visible.
+
+Taint kinds:
+
+- ``borrowed`` — host memory this code does not own: the result of
+  ``np.load(..., mmap_mode=...)``, ``np.frombuffer``, a staging-ring
+  slot, or a view/slice/field of a function parameter (a view NEVER
+  transfers ownership). Borrowed values must not reach a donated
+  argument slot of ``instrumented_jit``/``jax.jit`` (**L017**): XLA
+  frees a donated buffer after the program runs, and when device_put
+  zero-copied the borrowed host array, "frees" means another owner's
+  heap — the PR 10 freed-heap-garbage bug. Sanctioned laundering
+  copies (``parallel.sharding.place_entity_rows``/``_owned_copy`` — the
+  ``place_entity_rows_copy`` executable — ``jnp.array(..., copy=True)``,
+  ``.copy()``) strip the taint.
+- ``device`` — the result of calling a jitted executable (a value
+  living in device memory). Flowing one into a host-forcing sink —
+  ``float()``/``int()``, ``np.asarray``, ``.tolist()``, ``json.dump``,
+  a comparison inside a branch condition — outside
+  ``telemetry.device.sync_fetch`` is an unaccounted device->host
+  transfer (**L019**): exactly the syncs L013 misses because the sink
+  is not a named sync call on a seeded path.
+- ``jitref`` — a callable produced by ``instrumented_jit``/``jax.jit``
+  (tracked through factory helpers that *return* one, the repo's
+  dominant idiom), carrying its ``donate_argnums`` so call sites know
+  which argument slots donate.
+
+Findings carry the full flow chain (source, each binding hop, sink) so
+a report reads as the story of the bug, not a point coordinate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from tools.analysis.callgraph import FunctionInfo, PackageGraph
+from tools.analysis.core import BAD_SEED, Finding
+from tools.analysis.hotpath import SANCTIONED_SYNC, _short
+
+BORROWED = "borrowed"
+DEVICE = "device"
+PARAM = "param"
+JITREF = "jitref"
+
+#: jit wrappers whose result is a device-executable callable; positional
+#: arg 0 is the traced function, ``donate_argnums`` names donated slots.
+JIT_WRAPPERS = {
+    "jax.jit",
+    "photon_ml_tpu.telemetry.xla.instrumented_jit",
+}
+
+#: Resolved names whose RESULT is owned device memory no matter what went
+#: in: the sanctioned laundering copies (strips ``borrowed``).
+COPY_SANITIZERS = {
+    "photon_ml_tpu.parallel.sharding._owned_copy",
+    "photon_ml_tpu.parallel.sharding.place_entity_rows",
+}
+
+#: Resolved names whose result is borrowed host memory.
+RING_SOURCES = {
+    "photon_ml_tpu.ingest.buffers.BufferRing.acquire",
+}
+
+#: Attribute calls that return views/aliases of their argument — taint
+#: flows THROUGH them (np.asarray may alias; device_put may zero-copy an
+#: aligned host array — the exact PR 10 hazard).
+_VIEW_FUNCS = {
+    "asarray", "device_put", "reshape", "ravel", "transpose", "squeeze",
+    "atleast_1d", "atleast_2d",
+}
+
+#: Maximum recorded flow hops per taint (keeps messages readable).
+_MAX_STEPS = 6
+
+#: Array METADATA attributes: reading them is host-side bookkeeping, not
+#: a transfer (``scores.shape[1] > n`` compares static ints) and never a
+#: borrowed view.
+_METADATA_ATTRS = {
+    "shape", "dtype", "ndim", "size", "nbytes", "itemsize", "sharding",
+    "is_deleted", "device", "devices",
+}
+
+#: Module whose device-sink findings are suppressed wholesale: the
+#: instrumented-jit wrapper itself legitimately measures executables.
+_SANCTIONED_MODULES = {
+    "photon_ml_tpu.telemetry.xla",
+    "photon_ml_tpu.telemetry.device",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    """One taint label. ``param`` links the taint to the function's own
+    parameter index (summaries key on it); ``steps`` is the flow chain
+    accumulated binding by binding."""
+
+    kind: str
+    desc: str = ""
+    line: int = 0
+    param: Optional[int] = None
+    donated: tuple = ()  # JITREF: donated positional argnums
+    jit_name: str = ""  # JITREF: executable name (for messages)
+    steps: tuple = ()
+
+    def with_step(self, step: str) -> "Taint":
+        if len(self.steps) >= _MAX_STEPS:
+            return self
+        return dataclasses.replace(self, steps=self.steps + (step,))
+
+    def flow(self) -> str:
+        """`source (line N) -> hop (line M) -> ...` for the message."""
+        parts = [f"{self.desc} (line {self.line})"] if self.desc else []
+        parts.extend(self.steps)
+        return " -> ".join(parts)
+
+
+@dataclasses.dataclass
+class Summary:
+    """What a function does with taint, seen from a call site."""
+
+    qname: str
+    # taints of the returned value; PARAM entries mean "returns arg i"
+    returns: set = dataclasses.field(default_factory=set)
+    # param index -> (donation line, executable name, how) — a plain or
+    # viewed parameter reaches a donated slot inside this function
+    param_donations: dict = dataclasses.field(default_factory=dict)
+    # param index -> (sink line, sink description) — a parameter reaches
+    # a host-forcing sink inside this function
+    param_sinks: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Stats:
+    functions: int = 0
+    taint_edges: int = 0
+    jit_callables: int = 0
+    donating_callables: int = 0
+
+
+def _attr_parts(expr: ast.AST):
+    """`a.b.c` -> (Name a, ["b", "c"]); (None, []) otherwise."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    parts.reverse()
+    return (expr if isinstance(expr, ast.Name) else None, parts)
+
+
+def _donated_argnums(call: ast.Call) -> tuple:
+    """Donated positional indices from a jit registration call; an
+    ``(idxs) if cond else ()`` conditional takes the donating branch —
+    the conservative reading."""
+
+    def idxs_of(expr) -> tuple:
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return tuple(
+                int(e.value)
+                for e in expr.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            )
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+            return (int(expr.value),)
+        if isinstance(expr, ast.IfExp):
+            return tuple(sorted(set(idxs_of(expr.body))
+                                | set(idxs_of(expr.orelse))))
+        return ()
+
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return idxs_of(kw.value)
+    return ()
+
+
+def _jit_name(call: ast.Call) -> str:
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+            return str(kw.value.value)
+    if call.args:
+        root, parts = _attr_parts(call.args[0])
+        if parts:
+            return parts[-1]
+        if isinstance(call.args[0], ast.Name):
+            return call.args[0].id
+    return "jit"
+
+
+class _FunctionFlow:
+    """Abstract execution of ONE function body."""
+
+    def __init__(
+        self,
+        graph: PackageGraph,
+        fn: FunctionInfo,
+        summaries: dict,
+        stats: Stats,
+        findings: Optional[list] = None,
+    ):
+        self.graph = graph
+        self.fn = fn
+        self.summaries = summaries
+        self.stats = stats
+        self.findings = findings
+        self.summary = Summary(qname=fn.qname)
+        self.env: dict[str, frozenset] = {}
+        self.param_names: dict[str, int] = {}
+        self._emitted: set = set()
+        args = fn.node.args
+        all_args = (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        )
+        for i, a in enumerate(all_args):
+            if a.arg in ("self", "cls"):
+                continue
+            self.param_names[a.arg] = i
+            self.env[a.arg] = frozenset(
+                {Taint(kind=PARAM, desc=f"parameter `{a.arg}`",
+                       line=fn.lineno, param=i)}
+            )
+
+    # -- driving -------------------------------------------------------------
+
+    def run(self) -> Summary:
+        self._exec_block(self.fn.node.body)
+        return self.summary
+
+    def _exec_block(self, stmts) -> None:
+        for stmt in stmts:
+            self._exec_stmt(stmt)
+
+    def _exec_stmt(self, stmt) -> None:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return  # nested defs are their own graph nodes
+        if isinstance(stmt, ast.Assign):
+            taints = self._eval(stmt.value)
+            for target in stmt.targets:
+                self._bind(target, taints, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._bind(stmt.target, self._eval(stmt.value), stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            taints = self._eval(stmt.value) | self._lookup(stmt.target)
+            self._bind(stmt.target, taints, stmt.value)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.summary.returns |= self._eval(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self._eval(stmt.value)
+        elif isinstance(stmt, ast.If):
+            self._branch_test(stmt.test)
+            self._eval(stmt.test)
+            before = dict(self.env)
+            self._exec_block(stmt.body)
+            after_body = self.env
+            self.env = dict(before)
+            self._exec_block(stmt.orelse)
+            self._merge(after_body)
+        elif isinstance(stmt, (ast.While,)):
+            self._branch_test(stmt.test)
+            self._eval(stmt.test)
+            for _ in range(2):  # loop-carried taint fixpoint
+                snapshot = dict(self.env)
+                self._exec_block(stmt.body)
+                self._merge(snapshot)
+            # the test re-executes per iteration with the LOOP-CARRIED
+            # env — `while err > tol:` over a jitted `err` is the
+            # canonical convergence-loop transfer
+            self._branch_test(stmt.test)
+            self._exec_block(stmt.orelse)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            iter_taints = self._eval(stmt.iter)
+            self._bind(stmt.target, iter_taints, stmt.iter)
+            for _ in range(2):
+                snapshot = dict(self.env)
+                self._exec_block(stmt.body)
+                self._merge(snapshot)
+            self._exec_block(stmt.orelse)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                taints = self._eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, taints, item.context_expr)
+            self._exec_block(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            before = dict(self.env)
+            self._exec_block(stmt.body)
+            for handler in stmt.handlers:
+                self._merge(before)
+                self._exec_block(handler.body)
+            self._exec_block(stmt.orelse)
+            self._exec_block(stmt.finalbody)
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._eval(child)
+        # pass/break/continue/import/global/del: no taint flow
+
+    def _merge(self, other: dict) -> None:
+        for name, taints in other.items():
+            if name in self.env:
+                self.env[name] = self.env[name] | taints
+            else:
+                self.env[name] = taints
+
+    # -- binding -------------------------------------------------------------
+
+    def _bind(self, target, taints: frozenset, value_expr) -> None:
+        taints = frozenset(
+            t for t in taints if t.kind in (BORROWED, DEVICE, JITREF, PARAM)
+        )
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elts = (
+                value_expr.elts
+                if isinstance(value_expr, (ast.Tuple, ast.List))
+                and len(value_expr.elts) == len(target.elts)
+                else None
+            )
+            for i, el in enumerate(target.elts):
+                if elts is not None:
+                    self._bind(el, self._eval(elts[i]), elts[i])
+                else:
+                    self._bind(el, taints, value_expr)
+            return
+        if isinstance(target, ast.Starred):
+            self._bind(target.value, taints, value_expr)
+            return
+        key = self._env_key(target)
+        if key is None:
+            return
+        if isinstance(target, ast.Subscript):
+            # an ELEMENT write (`buf[0] = x`) mutates the array without
+            # disowning it: merge, never kill, the base binding's taint
+            taints = taints | self.env.get(key, frozenset())
+        if taints:
+            step = f"`{key}` (line {getattr(target, 'lineno', 0)})"
+            self.env[key] = frozenset(t.with_step(step) for t in taints)
+            self.stats.taint_edges += 1
+        else:
+            self.env[key] = frozenset()
+
+    def _env_key(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return "self." + expr.attr
+        if isinstance(expr, ast.Subscript):
+            return self._env_key(expr.value)
+        return None
+
+    def _lookup(self, expr) -> frozenset:
+        key = self._env_key(expr)
+        return self.env.get(key, frozenset()) if key else frozenset()
+
+    # -- expression evaluation ----------------------------------------------
+
+    def _eval(self, expr) -> frozenset:
+        if expr is None:
+            return frozenset()
+        if isinstance(expr, ast.Name):
+            return self.env.get(expr.id, frozenset())
+        if isinstance(expr, ast.Attribute):
+            return self._eval_attribute(expr)
+        if isinstance(expr, ast.Subscript):
+            self._eval(expr.slice)
+            base = self._eval(expr.value)
+            out = set(base)
+            for t in base:
+                if t.kind == PARAM:
+                    # a slice/view of a parameter is BORROWED memory: the
+                    # view aliases the caller's buffer, ownership never
+                    # transferred
+                    out.add(
+                        Taint(
+                            kind=BORROWED,
+                            desc=f"view/slice of {t.desc}",
+                            line=expr.lineno,
+                            param=t.param,
+                        )
+                    )
+            return frozenset(out)
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr)
+        if isinstance(expr, ast.BinOp):
+            return self._eval(expr.left) | self._eval(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self._eval(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            out = frozenset()
+            for v in expr.values:
+                out |= self._eval(v)
+            return out
+        if isinstance(expr, ast.Compare):
+            out = self._eval(expr.left)
+            for c in expr.comparators:
+                out |= self._eval(c)
+            return out
+        if isinstance(expr, ast.IfExp):
+            self._eval(expr.test)
+            return self._eval(expr.body) | self._eval(expr.orelse)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = frozenset()
+            for el in expr.elts:
+                out |= self._eval(el)
+            return out
+        if isinstance(expr, ast.Dict):
+            out = frozenset()
+            for k, v in zip(expr.keys, expr.values):
+                if k is not None:
+                    self._eval(k)
+                out |= self._eval(v)
+            return out
+        if isinstance(expr, ast.Starred):
+            return self._eval(expr.value)
+        if isinstance(expr, (ast.JoinedStr, ast.FormattedValue)):
+            for child in ast.iter_child_nodes(expr):
+                if isinstance(child, ast.expr):
+                    self._eval(child)
+            return frozenset()
+        if isinstance(expr, ast.NamedExpr):
+            taints = self._eval(expr.value)
+            self._bind(expr.target, taints, expr.value)
+            return taints
+        if isinstance(
+            expr, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+        ):
+            # comprehensions: propagate the iterable's taint to the result
+            out = frozenset()
+            for gen in expr.generators:
+                out |= self._eval(gen.iter)
+            return out
+        if isinstance(expr, ast.Await):
+            return self._eval(expr.value)
+        return frozenset()
+
+    def _eval_attribute(self, expr: ast.Attribute) -> frozenset:
+        root, parts = _attr_parts(expr)
+        if root is not None and root.id == "self" and len(parts) == 1:
+            return self.env.get("self." + parts[0], frozenset())
+        if expr.attr in _METADATA_ATTRS:
+            self._eval(expr.value)
+            return frozenset()
+        base = self._eval(expr.value)
+        out = set(base)
+        for t in base:
+            if t.kind == PARAM:
+                # a field of a caller-owned object (a staging-ring slot's
+                # `.values`, a chunk's arrays): borrowed, like a view
+                out.add(
+                    Taint(
+                        kind=BORROWED,
+                        desc=f"field `.{expr.attr}` of {t.desc}",
+                        line=expr.lineno,
+                        param=t.param,
+                    )
+                )
+        return frozenset(out)
+
+    # -- calls ---------------------------------------------------------------
+
+    def _eval_call(self, call: ast.Call) -> frozenset:
+        arg_taints = [self._eval(a) for a in call.args]
+        for kw in call.keywords:
+            self._eval(kw.value)
+        func = call.func
+        root, parts = _attr_parts(func)
+        attr = parts[-1] if parts else None
+        resolved = self.graph._resolve_func_expr(self.fn, func)
+
+        # ---- sanitizers ----------------------------------------------------
+        if resolved in COPY_SANITIZERS:
+            return frozenset()
+        if resolved in SANCTIONED_SYNC or attr == "sync_fetch":
+            return frozenset()  # the accounted fetch: result is host-owned
+        if attr == "copy" and not call.args and isinstance(
+            func, ast.Attribute
+        ):
+            return frozenset()  # x.copy(): an owned copy
+        if attr == "copy" and root is not None and root.id in (
+            "np", "numpy", "jnp",
+        ):
+            return frozenset()  # np.copy(x) / jnp.copy(x)
+        if attr == "array" and root is not None and root.id in (
+            "np", "numpy", "jnp",
+        ):
+            # np.array / jnp.array copy by default; copy=False/None
+            # ALIASES — not a sanitizer, taint flows through like a view
+            for kw in call.keywords:
+                if kw.arg == "copy" and (
+                    not isinstance(kw.value, ast.Constant)
+                    or kw.value.value in (False, None)
+                ):
+                    out = set()
+                    for at in arg_taints:
+                        out |= {
+                            t for t in at if t.kind in (BORROWED, DEVICE)
+                        }
+                    return frozenset(out)
+            return frozenset()
+
+        # ---- borrowed sources ----------------------------------------------
+        if attr == "load" and root is not None and root.id in (
+            "np", "numpy",
+        ):
+            for kw in call.keywords:
+                if kw.arg == "mmap_mode" and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None
+                ):
+                    return frozenset(
+                        {Taint(BORROWED,
+                               "np.load(mmap_mode=...) memory-mapped file",
+                               call.lineno)}
+                    )
+            return frozenset()
+        if attr == "frombuffer" and root is not None and root.id in (
+            "np", "numpy",
+        ):
+            return frozenset(
+                {Taint(BORROWED, "np.frombuffer view", call.lineno)}
+            )
+        if resolved in RING_SOURCES or (
+            resolved is None and attr == "acquire" and root is not None
+            and "ring" in root.id.lower()
+        ):
+            return frozenset(
+                {Taint(BORROWED, "staging-ring buffer", call.lineno)}
+            )
+
+        # ---- jit registration ----------------------------------------------
+        if resolved in JIT_WRAPPERS or attr == "instrumented_jit":
+            donated = _donated_argnums(call)
+            self.stats.jit_callables += 1
+            if donated:
+                self.stats.donating_callables += 1
+            return frozenset(
+                {Taint(JITREF, "jitted callable", call.lineno,
+                       donated=donated, jit_name=_jit_name(call))}
+            )
+
+        # ---- calling a jitted callable -------------------------------------
+        func_taints = self._eval(func) if not isinstance(
+            func, (ast.Name, ast.Attribute)
+        ) else self._lookup_callable(func)
+        result: set = set()
+        for t in func_taints:
+            if t.kind != JITREF:
+                continue
+            result.add(
+                Taint(DEVICE, f"result of jitted `{t.jit_name}`",
+                      call.lineno)
+            )
+            for i in t.donated:
+                if i < len(arg_taints):
+                    self._check_donation(call, i, arg_taints[i], t.jit_name)
+
+        # ---- callee summaries (one call level deep) ------------------------
+        target = self.graph.resolve_call_target(resolved)
+        summary = self.summaries.get(target) if target else None
+        if summary is not None:
+            callee = self.graph.functions[target]
+            for i, (dline, jname, how) in sorted(
+                summary.param_donations.items()
+            ):
+                if i < len(arg_taints):
+                    self._check_donation_via(
+                        call, i, arg_taints[i], callee, dline, jname, how
+                    )
+            for i, (sline, sdesc) in sorted(summary.param_sinks.items()):
+                if i < len(arg_taints):
+                    self._check_sink_via(
+                        call, i, arg_taints[i], callee, sline, sdesc
+                    )
+            for t in summary.returns:
+                if t.kind == PARAM and t.param is not None:
+                    if t.param < len(arg_taints):
+                        result |= set(arg_taints[t.param])
+                elif t.kind == BORROWED and t.param is not None:
+                    # callee returns a view of its parameter: the result
+                    # aliases whatever the caller passed
+                    if t.param < len(arg_taints):
+                        src = arg_taints[t.param]
+                        link = None
+                        for s in src:
+                            if s.kind == PARAM:
+                                link = s.param
+                        result.add(
+                            Taint(BORROWED,
+                                  f"{t.desc} via `{callee.name}`",
+                                  call.lineno, param=link)
+                        )
+                elif t.kind in (BORROWED, DEVICE, JITREF):
+                    result.add(
+                        dataclasses.replace(
+                            t, param=None, line=call.lineno,
+                            desc=(t.desc if t.kind == JITREF
+                                  else f"{t.desc} via `{callee.name}`"),
+                            steps=(),
+                        )
+                    )
+
+        # ---- host-forcing sinks (L019) -------------------------------------
+        self._check_host_sinks(call, arg_taints, root, attr, func)
+        if isinstance(func, ast.Name) and resolved is None and not parts:
+            # unresolved bare-name call (builtins): no propagation
+            return frozenset(result)
+        if attr in _VIEW_FUNCS:
+            for at in arg_taints:
+                result |= {
+                    t for t in at if t.kind in (BORROWED, DEVICE)
+                }
+        return frozenset(result)
+
+    def _lookup_callable(self, func) -> frozenset:
+        """Taints of a call's FUNC expression: env for names/self-attrs,
+        full eval for anything else (e.g. ``factory(x)(args)``)."""
+        key = self._env_key(func)
+        if key is not None and key in self.env:
+            return self.env[key]
+        return self._eval(func)
+
+    # -- L017 emission -------------------------------------------------------
+
+    def _check_donation(
+        self, call, idx: int, taints: frozenset, jit_name: str
+    ) -> None:
+        for t in taints:
+            if t.kind == BORROWED:
+                if t.param is not None:
+                    # a view of OUR OWN parameter donated here: flag it
+                    # (the view aliases the caller's buffer no matter
+                    # what the caller passed) AND summarize it so a
+                    # caller handing us borrowed memory is flagged too
+                    self.summary.param_donations.setdefault(
+                        t.param, (call.lineno, jit_name, t.desc)
+                    )
+                self._emit_l017(call.lineno, idx, jit_name, t, chain=None)
+            elif t.kind == PARAM:
+                # donating the plain parameter is the CALLER's contract
+                # (the streaming-table idiom): summary only
+                self.summary.param_donations.setdefault(
+                    t.param, (call.lineno, jit_name, t.desc)
+                )
+
+    def _check_donation_via(
+        self, call, idx, taints, callee, dline, jname, how
+    ) -> None:
+        for t in taints:
+            if t.kind == BORROWED:
+                if t.param is not None:
+                    self.summary.param_donations.setdefault(
+                        t.param, (call.lineno, jname, t.desc)
+                    )
+                else:
+                    self._emit_l017(
+                        call.lineno, idx, jname, t,
+                        chain=(self.fn.qname, callee.qname),
+                        via=(callee, dline, how),
+                    )
+            elif t.kind == PARAM:
+                self.summary.param_donations.setdefault(
+                    t.param, (call.lineno, jname, t.desc)
+                )
+
+    def _emit_l017(
+        self, lineno, idx, jit_name, taint, chain=None, via=None
+    ) -> None:
+        if self.findings is None:
+            return
+        key = ("L017", lineno, idx, jit_name, taint.desc)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        flow = taint.flow()
+        if via is not None:
+            callee, dline, how = via
+            detail = (
+                f"flows into `{callee.name}` which donates it "
+                f"(argument {idx} -> `{jit_name}`, "
+                f"{callee.rel}:{dline})"
+            )
+        else:
+            detail = f"flows into donated argument {idx} of `{jit_name}`"
+        self.findings.append(
+            Finding(
+                path=self.fn.rel,
+                line=lineno,
+                code="L017",
+                message=(
+                    f"borrowed host memory [{flow}] {detail} — XLA frees "
+                    f"donated buffers after the program runs, so a "
+                    f"zero-copied borrowed view becomes freed-heap "
+                    f"garbage (the PR 10 bug class); launder through "
+                    f"parallel.sharding.place_entity_rows_copy or "
+                    f"jnp.array(..., copy=True) before donating"
+                ),
+                chain=tuple(_short(q) for q in chain) if chain else (
+                    _short(self.fn.qname),
+                ),
+                site=f"donation:{idx}:{jit_name}:{taint.desc}",
+            )
+        )
+
+    # -- L019 emission -------------------------------------------------------
+
+    def _device_taints(self, taints: frozenset):
+        return [t for t in taints if t.kind == DEVICE]
+
+    def _param_taints(self, taints: frozenset):
+        return [t for t in taints if t.kind == PARAM]
+
+    def _check_host_sinks(self, call, arg_taints, root, attr, func) -> None:
+        sink = None
+        checked: list = []
+        if isinstance(func, ast.Name) and func.id in ("float", "int"):
+            if call.args and not all(
+                isinstance(a, ast.Constant) for a in call.args
+            ):
+                sink = f"{func.id}()"
+                checked = arg_taints[:1]
+        elif attr == "asarray" and root is not None and root.id in (
+            "np", "numpy",
+        ):
+            sink = "np.asarray"
+            checked = arg_taints[:1]
+        elif attr == "tolist":
+            sink = ".tolist()"
+            checked = [self._eval(func.value)]
+        elif attr == "dump" and root is not None and root.id == "json":
+            sink = "json.dump"
+            checked = arg_taints[:1]
+        if sink is None:
+            return
+        for taints in checked:
+            for t in self._device_taints(taints):
+                self._emit_l019(call.lineno, sink, t)
+            for t in self._param_taints(taints):
+                self.summary.param_sinks.setdefault(
+                    t.param, (call.lineno, sink)
+                )
+
+    def _branch_test(self, test) -> None:
+        """Comparison-in-branch: `if jitted_result > x:` forces the
+        transfer implicitly — no named sync call for L013 to see.
+        Identity checks (`is None` / `is not None`) read a pointer, not
+        the value, and are exempt."""
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare):
+                if all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+                ):
+                    continue
+                for side in [node.left] + list(node.comparators):
+                    for t in self._device_taints(self._eval(side)):
+                        self._emit_l019(
+                            node.lineno, "comparison in a branch condition",
+                            t,
+                        )
+
+    def _check_sink_via(self, call, idx, taints, callee, sline, sdesc):
+        for t in self._device_taints(taints):
+            self._emit_l019(
+                call.lineno, sdesc, t,
+                chain=(self.fn.qname, callee.qname),
+                via=(callee, sline),
+            )
+
+    def _emit_l019(self, lineno, sink, taint, chain=None, via=None) -> None:
+        if self.findings is None:
+            return
+        if self.fn.qname in SANCTIONED_SYNC or any(
+            self.fn.qname.startswith(s + ".") for s in SANCTIONED_SYNC
+        ):
+            return
+        if self.fn.module in _SANCTIONED_MODULES:
+            return
+        key = ("L019", lineno, sink, taint.desc)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        where = ""
+        if via is not None:
+            callee, sline = via
+            where = f" (inside `{callee.name}`, {callee.rel}:{sline})"
+        self.findings.append(
+            Finding(
+                path=self.fn.rel,
+                line=lineno,
+                code="L019",
+                message=(
+                    f"{sink}{where} forces a device->host transfer of "
+                    f"{taint.flow()} outside telemetry.device.sync_fetch "
+                    f"— an unaccounted sync the hot-path walk cannot "
+                    f"see; fetch through sync_fetch (the accounted "
+                    f"crossing) or keep the value on device"
+                ),
+                chain=tuple(_short(q) for q in chain) if chain else (
+                    _short(self.fn.qname),
+                ),
+                site=f"transfer:{sink}:{taint.desc}",
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# The pass
+# ---------------------------------------------------------------------------
+
+
+def run(
+    graph: PackageGraph,
+    stats: Optional[Stats] = None,
+    require_seeds: bool = False,
+) -> list[Finding]:
+    """Two-phase taint analysis over the whole package graph.
+
+    ``require_seeds=True`` (the real tree) additionally verifies the
+    configured sanitizer/ring-source qnames still resolve: a rename of
+    ``parallel.sharding._owned_copy`` or ``ingest.buffers.BufferRing
+    .acquire`` must surface as W002, not as L017 silently laundering
+    nothing / missing the ring source."""
+    if stats is None:
+        stats = Stats()
+    findings: list[Finding] = []
+    if require_seeds:
+        for qname, what in sorted(
+            [(q, "COPY_SANITIZERS") for q in COPY_SANITIZERS]
+            + [(q, "RING_SOURCES") for q in RING_SOURCES]
+        ):
+            if qname not in graph.functions:
+                findings.append(
+                    Finding(
+                        path="tools/analysis/dataflow.py",
+                        line=0,
+                        code=BAD_SEED,
+                        message=(
+                            f"dataflow seed `{qname}` ({what}) no longer "
+                            f"resolves — renamed? update the table or "
+                            f"L017 silently stops "
+                            f"{'laundering' if what == 'COPY_SANITIZERS' else 'tracking'}"
+                            f" through it"
+                        ),
+                    )
+                )
+    summaries: dict[str, Summary] = {}
+    # phase A: local summaries (no callee knowledge)
+    for qname, fn in sorted(graph.functions.items()):
+        flow = _FunctionFlow(graph, fn, {}, Stats())
+        summaries[qname] = flow.run()
+    # phase B: re-analyze with summaries; collect findings + real stats
+    for qname, fn in sorted(graph.functions.items()):
+        stats.functions += 1
+        flow = _FunctionFlow(graph, fn, summaries, stats, findings)
+        summaries[qname] = flow.run()
+    return findings

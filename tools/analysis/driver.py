@@ -10,13 +10,15 @@ import glob as _glob
 import os
 from typing import Optional
 
-from tools.analysis import faultcov, hotpath, jitpurity, local, locks
+from tools.analysis import dataflow, faultcov, hotpath, jitpurity, local, locks
 from tools.analysis.callgraph import build_graph
 from tools.analysis.core import (
+    BAD_SEED,
     Finding,
     SourceFile,
     apply_suppressions,
     collect_suppressions,
+    dedupe_chain_findings,
     load_source,
     split_baseline,
     syntax_findings,
@@ -52,9 +54,14 @@ class Result:
     findings: list[Finding]  # NEW findings: these fail the gate
     grandfathered: list[Finding]  # matched --baseline entries
     stale_baseline: list[tuple[str, str, str]]  # baseline keys gone stale
-    # call-graph coverage (tests assert the interprocedural passes really
-    # ran over the whole package, not a silently empty graph)
+    # call-graph + dataflow coverage (tests assert the interprocedural
+    # passes really ran over the whole package, not a silently empty
+    # graph: modules/functions/classes, dataflow functions/taint edges,
+    # lock-order graph size)
     graph_stats: dict = dataclasses.field(default_factory=dict)
+    # --changed mode: the analyzed scope (changed files + call-graph
+    # dependents), or None for a full-tree run
+    changed_scope: Optional[list[str]] = None
 
     @property
     def ok(self) -> bool:
@@ -67,7 +74,7 @@ class Result:
         return dict(sorted(out.items()))
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "version": 1,
             "root": self.root,
             "files": len(self.files),
@@ -77,27 +84,74 @@ class Result:
             "counts": self.counts(),
             "graph": self.graph_stats,
         }
+        if self.changed_scope is not None:
+            out["changed_scope"] = self.changed_scope
+        return out
+
+
+def changed_scope(
+    graph, files: list[SourceFile], changed: set[str]
+) -> set[str]:
+    """``changed`` rel paths + their transitive call-graph DEPENDENTS:
+    every file holding a function that (transitively) calls into a
+    changed file. A changed callee's behavior is visible in its callers,
+    so a pre-commit run must re-judge them too; files neither changed
+    nor depending on a change are out of scope."""
+    # file-level reverse edges: callee rel -> {caller rels}
+    rdeps: dict[str, set[str]] = {}
+    for fn in graph.functions.values():
+        for resolved, _call in fn.calls:
+            target = graph.resolve_call_target(resolved)
+            if target is not None:
+                callee_rel = graph.functions[target].rel
+                if callee_rel != fn.rel:
+                    rdeps.setdefault(callee_rel, set()).add(fn.rel)
+    scope = {sf.rel for sf in files if sf.rel in changed}
+    frontier = list(scope)
+    while frontier:
+        rel = frontier.pop()
+        for caller in rdeps.get(rel, ()):
+            if caller not in scope:
+                scope.add(caller)
+                frontier.append(caller)
+    return scope
 
 
 def analyze(
     root: str,
     baseline: Optional[dict] = None,  # key -> count, or a set (count 1)
     require_seeds: bool = True,
+    changed: Optional[set[str]] = None,
 ) -> Result:
     """Run the whole gate over ``root``. ``require_seeds=False`` relaxes
     the W002 seed check for reduced test trees that intentionally carry
-    only a few modules."""
+    only a few modules.
+
+    ``changed`` (rel paths) switches on the fast pre-commit scope: the
+    whole tree is still PARSED and the interprocedural passes still run
+    over the full graph (a partial graph would silently weaken them),
+    but per-file lint runs only on the changed files + their call-graph
+    dependents, and findings are filtered to that scope. Full-tree
+    behavior (``changed=None``) is unchanged and remains what tier-1
+    runs."""
     files = [
         load_source(os.path.relpath(p, root), p) for p in source_files(root)
     ]
-    findings = syntax_findings(files)
-
     pkg_prefix = PACKAGE_DIR + os.sep
+    package_files = [sf for sf in files if sf.rel.startswith(pkg_prefix)]
+    graph = build_graph(package_files)
+    scope: Optional[set[str]] = None
+    if changed is not None:
+        scope = changed_scope(graph, files, changed)
+
+    findings = syntax_findings(files)
     for sf in files:
         if sf.tree is None:
             continue
         if os.path.basename(sf.rel) == "__init__.py":
             continue  # re-export surfaces import without using
+        if scope is not None and sf.rel not in scope:
+            continue  # --changed: out-of-scope files keep their lint
         findings.extend(
             local.lint_file(
                 sf.rel, sf.tree, library=sf.rel.startswith(pkg_prefix)
@@ -105,12 +159,17 @@ def analyze(
         )
 
     # interprocedural passes over the library package (incl. __init__
-    # trees: re-export bindings are what resolution follows)
-    package_files = [sf for sf in files if sf.rel.startswith(pkg_prefix)]
-    graph = build_graph(package_files)
+    # trees: re-export bindings are what resolution follows) — ALWAYS
+    # the full graph, even under --changed
     findings.extend(hotpath.run(graph, require_seeds=require_seeds))
     findings.extend(jitpurity.run(graph))
     findings.extend(locks.run(graph))
+    lock_stats: dict = {}
+    findings.extend(locks.run_lock_order(graph, lock_stats))
+    df_stats = dataflow.Stats()
+    findings.extend(
+        dataflow.run(graph, df_stats, require_seeds=require_seeds)
+    )
     if require_seeds:
         # L016 fault-point coverage needs the real tests/ tree; reduced
         # fixture trees (require_seeds=False) legitimately carry neither
@@ -119,10 +178,30 @@ def analyze(
         "modules": len(graph.modules),
         "functions": len(graph.functions),
         "classes": len(graph.classes),
+        "dataflow": {
+            "functions": df_stats.functions,
+            "taint_edges": df_stats.taint_edges,
+            "jit_callables": df_stats.jit_callables,
+            "donating_callables": df_stats.donating_callables,
+        },
+        "locks": lock_stats,
     }
+
+    findings = dedupe_chain_findings(findings)
+    if scope is not None:
+        # W002 (a configured seed/sanitizer that no longer resolves) is
+        # pass-config health, reported against tools/analysis/ paths that
+        # are never in a package scope — scoping it out would let the
+        # exact pre-commit workflow it guards land the disarming rename
+        findings = [
+            f for f in findings
+            if f.path in scope or f.code == BAD_SEED
+        ]
 
     suppressions = {}
     for sf in files:
+        if scope is not None and sf.rel not in scope:
+            continue  # out-of-scope W001s would be pre-commit noise
         per_file = collect_suppressions(sf)
         if per_file:
             suppressions[sf.rel] = per_file
@@ -141,4 +220,5 @@ def analyze(
         grandfathered=grandfathered,
         stale_baseline=stale,
         graph_stats=graph_stats,
+        changed_scope=sorted(scope) if scope is not None else None,
     )

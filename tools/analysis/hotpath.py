@@ -176,6 +176,7 @@ def run(
                         f"or lift the sync out of the request path"
                     ),
                     chain=chain,
+                    site=desc,
                 )
             )
 
@@ -241,6 +242,7 @@ def run(
                         f".instrumented_jit(fn, name=...)"
                     ),
                     chain=chain,
+                    site=desc,
                 )
             )
     return findings

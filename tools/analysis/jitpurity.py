@@ -189,17 +189,15 @@ def trace_roots(graph: PackageGraph) -> list[tuple[str, str, int, str]]:
 
 
 def run(graph: PackageGraph) -> list[Finding]:
+    # one finding may be reachable from SEVERAL traced roots: every
+    # occurrence is emitted with its ``site`` set and the driver's
+    # chain-dedupe keeps the shortest chain, counting the alternates
     findings: list[Finding] = []
-    seen: set[tuple[str, int, str]] = set()
     for root, reg_rel, reg_line, wrapper in trace_roots(graph):
         reach = graph.reachable([root])
         for qname in sorted(reach):
             fn = graph.functions[qname]
             for lineno, desc in impure_sites(fn):
-                key = (fn.rel, lineno, desc)
-                if key in seen:
-                    continue  # one report per site, first traced root wins
-                seen.add(key)
                 chain = short_chain(graph.chain_to(reach, qname))
                 findings.append(
                     Finding(
@@ -214,6 +212,7 @@ def run(graph: PackageGraph) -> list[Finding]:
                             f"the host side of the jit boundary"
                         ),
                         chain=chain,
+                        site=desc,
                     )
                 )
     return findings

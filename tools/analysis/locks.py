@@ -1,4 +1,4 @@
-"""Pass 4 — L015 lock discipline.
+"""Pass 4 — L015 lock discipline, and L018 lock-order deadlock cycles.
 
 ``serving/`` and ``telemetry/progress.py`` run real daemon threads now.
 For every class that spawns one (``threading.Thread(target=self._x)``),
@@ -22,6 +22,22 @@ Scope decisions, deliberately:
   interprocedural lock state): a write must be lexically inside the
   ``with`` block. That is the repo's existing style and keeps the pass
   exact; a justified exception takes a ``# photon: noqa[L015]``.
+
+**L018 — lock-order cycles** (:func:`run_lock_order`). The threaded
+classes now hold locks WHILE calling into each other (engine version
+lock, registry lock, nearline buffer condition, fleet status lock), and
+two threads acquiring two locks in opposite orders is the classic
+deadlock no per-class pass can see. This pass extracts every lock
+ACQUISITION ORDER: a ``with self._lock:`` block that (lexically) nests
+another lock ``with``, or that calls — through the call graph, plus
+instance-type resolution the plain graph lacks (``v = ClassName(...)``
+locals, ``self._attr = ClassName(...)`` attributes, annotated returns
+like ``_engine_of(...) -> ScoringEngine``) — into a method that
+acquires another lock, yields a directed edge ``A -> B`` in the
+cross-class lock-order graph. A cycle in that graph (including the
+self-edge: re-acquiring a non-reentrant ``threading.Lock`` through a
+helper call) is a deadlock waiting for the right interleaving; the
+finding names every edge with its acquisition site and call chain.
 """
 
 from __future__ import annotations
@@ -32,6 +48,7 @@ from typing import Optional
 
 from tools.analysis.callgraph import ClassInfo, PackageGraph
 from tools.analysis.core import Finding
+from tools.analysis.hotpath import _short
 
 _LOCKISH = ("lock", "cv", "cond", "mutex")
 
@@ -167,6 +184,403 @@ def _class_closure(
                     stack.append(child)
     reach = graph.reachable(entries)
     return {q for q in reach if q in own}
+
+
+# ---------------------------------------------------------------------------
+# L018 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Acq:
+    """One lock acquisition (`with self.<attr>:`) in a function body."""
+
+    attr: str
+    lineno: int
+
+
+@dataclasses.dataclass
+class _HeldCall:
+    """A call made while holding one or more locks."""
+
+    held: tuple  # lock attrs held (innermost last)
+    call: ast.Call
+    lineno: int
+
+
+def lock_sites(fn_node: ast.AST):
+    """-> (acquisitions, lexical nesting edges, calls-under-lock) for one
+    function body. Nested defs are separate graph nodes and excluded."""
+    acqs: list[_Acq] = []
+    lex_edges: list[tuple[str, str, int]] = []  # (held, acquired, line)
+    held_calls: list[_HeldCall] = []
+
+    def rec(node: ast.AST, held: tuple) -> None:
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                if _is_lock_cm(item.context_expr):
+                    attr = item.context_expr.attr
+                    acqs.append(_Acq(attr, item.context_expr.lineno))
+                    for h in inner:
+                        lex_edges.append(
+                            (h, attr, item.context_expr.lineno)
+                        )
+                    inner = inner + (attr,)
+                else:
+                    # a non-lock context expression (`with self._lock,
+                    # other.use():`) EXECUTES while the earlier items'
+                    # locks are held — its calls are held-calls too
+                    rec(item.context_expr, inner)
+            for child in node.body:
+                rec(child, inner)
+            return
+        if isinstance(node, ast.Call) and held:
+            held_calls.append(_HeldCall(held, node, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            rec(child, held)
+
+    for stmt in fn_node.body:
+        rec(stmt, ())
+    return acqs, lex_edges, held_calls
+
+
+class _TypeResolver:
+    """Instance-type inference the plain call graph lacks: maps
+    ``obj.method()`` calls to class methods via (a) locals assigned from
+    a class constructor, (b) ``self._attr`` fields assigned a
+    constructor anywhere in the class, (c) locals assigned from a call
+    whose return annotation names a package class, (d) annotated
+    parameters. Conservative: a miss resolves to nothing."""
+
+    def __init__(self, graph: PackageGraph):
+        self.graph = graph
+        self._local_cache: dict[str, dict[str, str]] = {}
+        # class qname -> {attr -> class qname}
+        self.attr_types: dict[str, dict[str, str]] = {}
+        for cls in graph.classes.values():
+            table: dict[str, str] = {}
+            for mq in cls.methods.values():
+                fn = graph.functions[mq]
+                for node in ast.walk(fn.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    target_cls = self._call_class(fn, node.value)
+                    if target_cls is None:
+                        continue
+                    for t in node.targets:
+                        attr = _self_attr_of_target(t)
+                        if attr is not None:
+                            table.setdefault(attr, target_cls)
+            if table:
+                self.attr_types[cls.qname] = table
+
+    def _resolve_class(self, module: str, dotted: str) -> Optional[str]:
+        mod = self.graph.modules.get(module)
+        if mod is None:
+            return None
+        head, _, _tail = dotted.partition(".")
+        base = mod.bindings.get(head)
+        cand = (
+            self.graph.resolve_export(
+                base + dotted[len(head):] if base else dotted
+            )
+            if base
+            else mod.name + "." + dotted
+        )
+        if cand in self.graph.classes:
+            return cand
+        # module-local class referenced bare
+        cand = mod.name + "." + dotted
+        return cand if cand in self.graph.classes else None
+
+    def _annotation_class(
+        self, module: str, ann: Optional[ast.AST]
+    ) -> Optional[str]:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return self._resolve_class(module, ann.value.strip("'\""))
+        if isinstance(ann, ast.Name):
+            return self._resolve_class(module, ann.id)
+        if isinstance(ann, ast.Attribute):
+            parts = []
+            node: ast.AST = ann
+            while isinstance(node, ast.Attribute):
+                parts.append(node.attr)
+                node = node.value
+            if isinstance(node, ast.Name):
+                parts.append(node.id)
+                return self._resolve_class(module, ".".join(reversed(parts)))
+            return None
+        if isinstance(ann, ast.Subscript):  # Optional[X] / "Optional[X]"
+            return self._annotation_class(module, ann.slice)
+        return None
+
+    def _call_class(self, fn, expr: ast.AST) -> Optional[str]:
+        """Class qname an assignment RHS constructs or returns."""
+        if not isinstance(expr, ast.Call):
+            return None
+        resolved = self.graph._resolve_func_expr(fn, expr.func)
+        if resolved in self.graph.classes:
+            return resolved
+        target = self.graph.resolve_call_target(resolved)
+        if target is not None:
+            callee = self.graph.functions[target]
+            ret = self._annotation_class(
+                callee.module, getattr(callee.node, "returns", None)
+            )
+            if ret is not None:
+                return ret
+        return None
+
+    def local_types(self, fn) -> dict[str, str]:
+        """var name -> class qname for one function body (cached)."""
+        cached = self._local_cache.get(fn.qname)
+        if cached is not None:
+            return cached
+        out: dict[str, str] = {}
+        self._local_cache[fn.qname] = out
+        args = fn.node.args
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        ):
+            cls = self._annotation_class(fn.module, a.annotation)
+            if cls is not None:
+                out[a.arg] = cls
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign):
+                cls = self._call_class(fn, node.value)
+                if cls is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.setdefault(t.id, cls)
+        return out
+
+    def resolve_call(self, fn, call: ast.Call) -> Optional[str]:
+        """Graph resolution first; typed-instance resolution second."""
+        resolved = self.graph._resolve_func_expr(fn, call.func)
+        target = self.graph.resolve_call_target(resolved)
+        if target is not None:
+            return target
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        owner: Optional[str] = None
+        base = f.value
+        if isinstance(base, ast.Name):
+            owner = self.local_types(fn).get(base.id)
+        elif (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+        ):
+            cls_q = _owner_class(self.graph, fn)
+            if cls_q is not None:
+                owner = self.attr_types.get(cls_q, {}).get(base.attr)
+        if owner is None:
+            return None
+        mq = self.graph.classes[owner].methods.get(f.attr)
+        return mq
+
+    def callees(self, fn) -> list[tuple[str, int]]:
+        """Graph callees + typed-instance edges + containment edges."""
+        out = list(self.graph.callees(fn.qname))
+        have = {t for t, _l in out}
+        from tools.analysis.callgraph import own_body_nodes
+
+        for node in own_body_nodes(fn.node):
+            if isinstance(node, ast.Call):
+                t = self.resolve_call(fn, node)
+                if t is not None and t not in have:
+                    have.add(t)
+                    out.append((t, node.lineno))
+        return out
+
+
+def _owner_class(graph: PackageGraph, fn) -> Optional[str]:
+    """The class a function's ``self`` refers to: its own class, or the
+    enclosing method's class for defs nested inside methods."""
+    cur = fn
+    while cur is not None:
+        if cur.class_qname is not None:
+            return cur.class_qname
+        cur = graph.functions.get(cur.parent) if cur.parent else None
+    return None
+
+
+def _short_cls(qname: str) -> str:
+    return qname.rsplit(".", 1)[-1]
+
+
+def lock_order_graph(graph: PackageGraph, resolver=None):
+    """-> (nodes, edges): the cross-class lock-order graph. Nodes are
+    ``(class qname, lock attr)``; ``edges[(A, B)]`` carries the first
+    (and shortest-chained) evidence ``(rel, lineno, chain)`` that B was
+    acquired while A was held."""
+    if resolver is None:
+        resolver = _TypeResolver(graph)
+    nodes: set = set()
+    edges: dict = {}
+    site_cache: dict[str, tuple] = {}
+
+    def sites(qname: str):
+        got = site_cache.get(qname)
+        if got is None:
+            got = lock_sites(graph.functions[qname].node)
+            site_cache[qname] = got
+        return got
+
+    def add_edge(a, b, rel, lineno, chain):
+        cur = edges.get((a, b))
+        if cur is None or len(chain) < len(cur[2]):
+            edges[(a, b)] = (rel, lineno, chain)
+
+    for qname, fn in sorted(graph.functions.items()):
+        cls_q = _owner_class(graph, fn)
+        if cls_q is None:
+            continue
+        acqs, lex_edges, held_calls = sites(qname)
+        for a in acqs:
+            nodes.add((cls_q, a.attr))
+        for held_attr, acq_attr, lineno in lex_edges:
+            add_edge(
+                (cls_q, held_attr), (cls_q, acq_attr), fn.rel, lineno,
+                (qname,),
+            )
+        for hc in held_calls:
+            target = resolver.resolve_call(fn, hc.call)
+            if target is None:
+                continue
+            # BFS over the callee closure, collecting acquisitions with
+            # the chain from the lock-holding method
+            pred: dict[str, Optional[str]] = {target: None}
+            frontier = [target]
+            while frontier:
+                nxt = []
+                for q in frontier:
+                    g = graph.functions[q]
+                    g_cls = _owner_class(graph, g)
+                    if g_cls is not None:
+                        g_acqs, _lex, _calls = sites(q)
+                        for a in g_acqs:
+                            nodes.add((g_cls, a.attr))
+                            chain = [q]
+                            cur = q
+                            while pred[cur] is not None:
+                                cur = pred[cur]
+                                chain.append(cur)
+                            chain.append(qname)
+                            for held_attr in hc.held:
+                                add_edge(
+                                    (cls_q, held_attr),
+                                    (g_cls, a.attr),
+                                    fn.rel,
+                                    hc.lineno,
+                                    tuple(reversed(chain)),
+                                )
+                    for callee, _l in resolver.callees(g):
+                        if callee not in pred:
+                            pred[callee] = q
+                            nxt.append(callee)
+                frontier = nxt
+    return nodes, edges
+
+
+def _find_cycles(nodes, edges) -> list[list]:
+    """Minimal cycle per strongly-connected component (plus self-edges),
+    deduped by node set — one finding per distinct deadlock shape."""
+    adj: dict = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    cycles: list[list] = []
+    seen_sets: set = set()
+    for (a, b) in sorted(edges):
+        if a == b:
+            key = frozenset((a,))
+            if key not in seen_sets:
+                seen_sets.add(key)
+                cycles.append([a, a])
+            continue
+        # shortest path b -> a (BFS) closes the cycle a -> b -> ... -> a
+        pred = {b: None}
+        frontier = [b]
+        found = False
+        while frontier and not found:
+            nxt = []
+            for n in frontier:
+                for m in adj.get(n, ()):
+                    if m == a:
+                        path = [a, b]
+                        cur = n
+                        back = []
+                        while cur is not None:
+                            back.append(cur)
+                            cur = pred[cur]
+                        path.extend(reversed(back[:-1]))
+                        path.append(a)
+                        key = frozenset(path)
+                        if key not in seen_sets:
+                            seen_sets.add(key)
+                            cycles.append(path)
+                        found = True
+                        break
+                    if m not in pred:
+                        pred[m] = n
+                        nxt.append(m)
+                if found:
+                    break
+            frontier = nxt
+    return cycles
+
+
+def run_lock_order(
+    graph: PackageGraph, stats: Optional[dict] = None
+) -> list[Finding]:
+    """L018: flag every distinct cycle in the lock-order graph."""
+    resolver = _TypeResolver(graph)
+    nodes, edges = lock_order_graph(graph, resolver)
+    if stats is not None:
+        stats["nodes"] = len(nodes)
+        stats["edges"] = len(edges)
+    findings: list[Finding] = []
+    for cycle in _find_cycles(nodes, edges):
+        names = [f"{_short_cls(c)}.{attr}" for c, attr in cycle]
+        legs = []
+        first_rel, first_line = None, 0
+        for a, b in zip(cycle, cycle[1:]):
+            rel, lineno, chain = edges[(a, b)]
+            if first_rel is None:
+                first_rel, first_line = rel, lineno
+            via = " -> ".join(_short(q) for q in chain)
+            legs.append(
+                f"{_short_cls(a[0])}.{a[1]} held while acquiring "
+                f"{_short_cls(b[0])}.{b[1]} at {rel}:{lineno} (via {via})"
+            )
+        if len(cycle) == 2 and cycle[0] == cycle[1]:
+            what = (
+                f"non-reentrant lock re-acquired while held: "
+                f"{names[0]} — threading.Lock/Condition self-deadlocks"
+            )
+        else:
+            what = (
+                f"lock-order cycle {' -> '.join(names)} — two threads "
+                f"taking these locks in opposite orders deadlock"
+            )
+        findings.append(
+            Finding(
+                path=first_rel or "",
+                line=first_line,
+                code="L018",
+                message=f"{what}; acquisition order: " + "; ".join(legs),
+            )
+        )
+    return findings
 
 
 def run(graph: PackageGraph) -> list[Finding]:

@@ -25,13 +25,28 @@ docstrings for the pass-by-pass story):
              ModelRegistry, Heartbeat) must guard attributes written from
              both the thread target and public methods with
              `with self._lock/_cv:`
-  4. ruff + mypy, IF installed (configs live in pyproject.toml)
+  4. interprocedural DATAFLOW over the same graph (tools/analysis/
+     dataflow.py + locks.py): these track VALUES, not names —
+       L017  donation safety — borrowed host memory (mmap'd np.load,
+             np.frombuffer, staging-ring slots, views of parameters)
+             must not reach a donate_argnums slot of instrumented_jit/
+             jax.jit without a sanctioned laundering copy
+       L018  lock-order cycles — `with self._lock:` acquisition orders
+             (incl. calls into other lock-holding methods) must form an
+             acyclic cross-class graph
+       L019  unsanctioned host transfer — jitted-function results must
+             not flow into float()/int()/np.asarray/.tolist()/json.dump/
+             branch comparisons outside telemetry.device.sync_fetch
+  5. ruff + mypy, IF installed (configs live in pyproject.toml)
 
 Inline suppression: `# photon: noqa[L013]` on the reported line (stale
 suppressions are themselves findings, W001). `--baseline accepted.json`
 grandfathers existing findings so only NEW ones fail CI;
 `--write-baseline` emits that file. `--json` prints the machine-readable
 findings document (the schema tests/test_static_gate.py pins).
+`--changed GIT_REF` is the fast pre-commit scope: only files touched vs
+the ref (plus their call-graph dependents) are linted/reported, while
+the interprocedural passes still see the whole graph.
 
 Exit code 0 = clean (no new findings). Otherwise every finding prints as
 `path:line: code message [via call -> chain]` and the run exits 1.
@@ -51,6 +66,30 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from tools.analysis import core, driver  # noqa: E402 (path bootstrap above)
+
+
+def changed_files(root: str, ref: str) -> set:
+    """Repo-relative .py paths touched vs ``ref``: committed/staged/
+    worktree diffs plus untracked files — everything a pre-commit run
+    must re-judge."""
+    out: set = set()
+    for cmd in (
+        ["git", "diff", "--name-only", ref, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, cwd=root, capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"--changed: `{' '.join(cmd)}` failed in {root}:\n"
+                f"{proc.stderr.strip()}"
+            )
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.add(line.replace("/", os.sep))
+    return out
 
 
 def run_external(quiet: bool) -> list[core.Finding]:
@@ -114,6 +153,15 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="skip ruff/mypy even when installed",
     )
+    ap.add_argument(
+        "--changed",
+        metavar="GIT_REF",
+        help="fast pre-commit scope: lint/report only files touched vs "
+        "GIT_REF (plus their call-graph dependents); the whole tree is "
+        "still parsed and the interprocedural passes still see the full "
+        "graph. External tools are skipped (they have no changed-scope "
+        "mode). Full-tree behavior without this flag is unchanged.",
+    )
     args = ap.parse_args(argv)
 
     baseline = None
@@ -121,10 +169,24 @@ def main(argv: list[str] | None = None) -> int:
         baseline = core.load_baseline(args.baseline)
 
     root = os.path.abspath(args.root)
+    changed = None
+    if args.changed and args.write_baseline:
+        # a scope-filtered result would write a PARTIAL baseline,
+        # silently dropping every out-of-scope accepted entry — the next
+        # full-tree run would then fail on all of them
+        ap.error("--write-baseline needs the full tree; drop --changed")
+    if args.changed:
+        changed = changed_files(root, args.changed)
+        if not args.json:
+            print(
+                f"--changed {args.changed}: {len(changed)} touched "
+                f"python file(s)"
+            )
     # fixture trees are not this repo: their seed classes are whatever the
     # test planted, so the missing-seed config check (W002) stays repo-only
     result = driver.analyze(
-        root, baseline=baseline, require_seeds=(root == REPO)
+        root, baseline=baseline, require_seeds=(root == REPO),
+        changed=changed,
     )
 
     if args.write_baseline:
@@ -147,7 +209,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"checking {len(result.files)} files")
 
     external: list[core.Finding] = []
-    if not args.no_external and root == REPO:
+    if not args.no_external and root == REPO and changed is None:
         if not args.json:
             print("external tools:")
         external = run_external(quiet=args.json)
