@@ -48,6 +48,11 @@ def main():
     # spans/metrics opt in via PHOTON_TRACE_OUT / PHOTON_TELEMETRY_OUT; the
     # snapshot below rides the bench JSON either way (one shared schema)
     telemetry.configure_from_env()
+    # profile EVERY dispatch: the bench is a handful of dispatches (the
+    # 1/N sampling default exists for hour-long fits), and the per-kernel
+    # MFU / hot-dispatch-fraction lines below need the timed dispatch
+    # itself honestly measured, not extrapolated from warmup
+    telemetry.profile.set_sample_every(1)
     # an armed PHOTON_FAULT_PLAN would corrupt the bench numbers silently
     # (injected stalls/errors read as regressions) — same loud warning the
     # train/serve drivers give
@@ -167,11 +172,50 @@ def main():
     # take the first metric line as the training-throughput headline
     print(layout_line, flush=True)
 
+    # executable-level utilization (telemetry.profile): the headline
+    # solve's sampled honest timings → per-kernel MFU and the fraction of
+    # the timed window actually spent inside the profiled executable.
+    # Null values stay null ("unknown": no cost analysis / no known
+    # device peak) — the gate skips them rather than gating a fake 0.
+    prof = telemetry.profile.merged_profiles(names=("bench_lbfgs",)).get(
+        "bench_lbfgs"
+    )
+    mfu = None if prof is None else prof.get("mfu")
+    hot_fraction = None
+    if (
+        prof is not None
+        and prof.get("mean_dispatch_seconds")
+        and elapsed > 0
+    ):
+        hot_fraction = round(
+            min(prof["mean_dispatch_seconds"] / elapsed, 1.0), 6
+        )
+    for metric, value in (
+        ("glm_value_grad_mfu", mfu),
+        ("hot_dispatch_fraction", hot_fraction),
+    ):
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": value,
+                    "unit": "fraction",
+                    "vs_baseline": None,
+                    "detail": {"executable": "bench_lbfgs",
+                               "profile": prof},
+                }
+            ),
+            flush=True,
+        )
 
-#: The metric lines main() itself prints (config #1 + the layout build).
+
+#: The metric lines main() itself prints (config #1 + the layout build +
+#: the profiled per-kernel utilization pair).
 HEADLINE_METRICS = (
     "glm_logistic_1Mx10K_rows_per_sec_per_chip",
     "tiled_layout_build_rows_per_sec",
+    "glm_value_grad_mfu",
+    "hot_dispatch_fraction",
 )
 
 

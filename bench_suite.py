@@ -42,7 +42,19 @@ SUITE_METRICS = (
     "linreg_tron_1Mx10K_rows_per_sec_per_chip",
     "linreg_owlqn_elasticnet_1Mx10K_rows_per_sec_per_chip",
     "poisson_offsets_box_1Mx10K_rows_per_sec_per_chip",
+    # per-kernel utilization (telemetry.profile, every dispatch sampled):
+    # achieved MFU of the profiled GLM value+grad solve and the fraction
+    # of the timed window spent inside it — both HIGHER is better, so
+    # they ride the default gate direction, not LOWER_IS_BETTER_METRICS
+    "glm_value_grad_mfu",
+    "hot_dispatch_fraction",
 )
+
+#: The solver configs (#2, #3 + the elastic-net half) — the leading
+#: SUITE_METRICS entries, one timed step each; the utilization pair is
+#: derived from its own profiled step after them.
+_SOLVER_METRICS = SUITE_METRICS[:3]
+_UTILIZATION_METRICS = SUITE_METRICS[3:]
 
 #: Gate metrics where a RISE is the regression (wall-time ratios and
 #: latency/flatness SLOs); all other gated metrics are rates where a
@@ -257,7 +269,7 @@ def run_suite(deadline=None) -> dict[str, float | None]:
 
         return _run(jax.jit(poisson_run), batch, w0, n_rows)
 
-    steps = zip(SUITE_METRICS, (run_tron, run_owlqn, run_poisson))
+    steps = zip(_SOLVER_METRICS, (run_tron, run_owlqn, run_poisson))
     truncated = False
     for metric, step in steps:
         if truncated or (
@@ -281,6 +293,84 @@ def run_suite(deadline=None) -> dict[str, float | None]:
             ),
             flush=True,
         )
+
+    # --- per-kernel utilization (telemetry.profile) ----------------------
+    # One profiled GLM value+grad solve over the cached linear batch:
+    # instrumented_jit + the dispatch sampler at every=1 give an honest
+    # (fetch-synchronized) per-dispatch time, from which achieved MFU and
+    # the hot-dispatch fraction of the timed window follow. Unknowable
+    # values (no cost analysis / unknown device peak) are SKIPPED with a
+    # note, never gated as zero.
+    if truncated or (
+        deadline is not None and time.monotonic() > deadline
+    ):
+        for metric in _UTILIZATION_METRICS:
+            print(truncated_line(metric), flush=True)
+            results[metric] = None
+        return results
+    from photon_ml_tpu import telemetry
+
+    telemetry.profile.set_sample_every(1)
+    obj_glm = make_objective("squared", l2_weight=1.0)
+    glm_cfg = LBFGSConfig(max_iterations=20, tolerance=0.0)
+
+    def glm_value_grad(w, b):
+        return lbfgs_solve(glm_adapter(obj_glm, b), w, glm_cfg)
+
+    solver = telemetry.instrumented_jit(
+        glm_value_grad, name="suite_glm_value_grad"
+    )
+    batch = linear_batch()
+    # warm up with different args (tunnel result-caching, PERF_NOTES.md)
+    float(telemetry.sync_fetch(solver(w0, batch).value, label="warmup"))
+    # hot fraction = exclusive profiled seconds accrued DURING the timed
+    # window / wall elapsed; the warmup dispatch (compile wait) lands
+    # before the snapshot so it can't inflate the fraction
+    excl0 = telemetry.profile.exclusive_seconds_by_name().get(
+        "suite_glm_value_grad", 0.0
+    )
+    t0 = time.perf_counter()
+    res = solver(w0 + 1e-6, batch)
+    float(telemetry.sync_fetch(res.value, label="loss"))
+    util_elapsed = time.perf_counter() - t0
+    excl1 = telemetry.profile.exclusive_seconds_by_name().get(
+        "suite_glm_value_grad", 0.0
+    )
+    prof = telemetry.profile.merged_profiles(
+        names=("suite_glm_value_grad",)
+    ).get("suite_glm_value_grad")
+    mfu = None if prof is None else prof.get("mfu")
+    hot_fraction = None
+    if excl1 > excl0 and util_elapsed > 0:
+        hot_fraction = round(
+            min((excl1 - excl0) / util_elapsed, 1.0), 6
+        )
+    for metric, value in zip(
+        _UTILIZATION_METRICS, (mfu, hot_fraction)
+    ):
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": value,
+                    "unit": "fraction",
+                    "vs_baseline": None,
+                    "detail": {
+                        "executable": "suite_glm_value_grad",
+                        "profile": prof,
+                    },
+                }
+            ),
+            flush=True,
+        )
+        if value is not None:
+            results[metric] = value
+        else:
+            print(
+                f"gate: {metric}: unavailable on this backend (no "
+                "cost analysis or unknown device peak) — skipped",
+                file=sys.stderr,
+            )
     return results
 
 

@@ -4,7 +4,7 @@
         --trace run.trace.jsonl --telemetry run.metrics.jsonl \
         --checkpoint-dir ckpt/ --out report.md [--json report.json] \
         [--compare baseline.report.json] [--fail-on-regress] \
-        [--threshold 0.2]
+        [--threshold 0.2] [--hot [N]]
 
 Merges a span JSONL (``--trace-out``), a telemetry JSONL (metrics
 snapshot + heartbeat lines), and a checkpoint directory's manifests into
@@ -75,6 +75,16 @@ def main(argv: Optional[list] = None) -> int:
         dest="json_out",
         help="also write the full report as JSON (the compare-baseline "
         "format for future runs)",
+    )
+    parser.add_argument(
+        "--hot",
+        nargs="?",
+        const=10,
+        type=int,
+        metavar="N",
+        help="render ONLY the hot-executables table (top N by profiled "
+        "exclusive device seconds, default 10) instead of the full "
+        "report — the quick 'where did the time go' view",
     )
     parser.add_argument(
         "--compare",
@@ -150,8 +160,40 @@ def main(argv: Optional[list] = None) -> int:
             )
             return EXIT_ERROR
         deltas = report.compare(baseline, threshold=args.threshold)
+        # per-executable rows are compared only when BOTH sides carry
+        # them: a renamed or newly-appearing executable has no meaningful
+        # delta, so it is noted and skipped rather than treated as a
+        # regression (the shared-keys rule of compare_metrics)
+        current_km = report.key_metrics()
+        base_km = baseline.get("key_metrics", baseline)
+        if isinstance(base_km, dict):
+            cur_exec = {k for k in current_km if k.startswith("exec.")}
+            base_exec = {k for k in base_km if k.startswith("exec.")}
+            for name in sorted(cur_exec - base_exec):
+                print(
+                    f"note: `{name}` is new (absent from baseline — "
+                    "renamed or newly-profiled executable); skipped in "
+                    "the comparison",
+                    file=sys.stderr,
+                )
+            for name in sorted(base_exec - cur_exec):
+                print(
+                    f"note: `{name}` exists only in the baseline "
+                    "(renamed or no-longer-profiled executable); "
+                    "skipped in the comparison",
+                    file=sys.stderr,
+                )
 
-    md = report.to_markdown(deltas=deltas)
+    if args.hot is not None:
+        hot_lines = report._hot_executables_markdown(args.hot)
+        md = (
+            "\n".join(hot_lines).rstrip() + "\n"
+            if hot_lines
+            else "No profiled executables (run carried no "
+            "profile.exec.* gauges).\n"
+        )
+    else:
+        md = report.to_markdown(deltas=deltas)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as fh:
             fh.write(md)

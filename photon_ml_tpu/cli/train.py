@@ -601,6 +601,22 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     telemetry_out = config.get("telemetry_out")
     if telemetry_out:
         telemetry_out = telemetry.member_artifact_path(telemetry_out)
+    xprof_cfg = config.get("xprof")
+    if xprof_cfg:
+        # arm a jax.profiler capture window around the Kth dispatch (the
+        # steady state AFTER compiles) — telemetry.profile refuses on the
+        # CPU backend unless forced, so a CPU smoke run just logs a note
+        if isinstance(xprof_cfg, str):
+            xprof_cfg = {"dir": xprof_cfg}
+        xprof_kwargs = {}
+        if xprof_cfg.get("arm_at") is not None:
+            xprof_kwargs["arm_at"] = int(xprof_cfg["arm_at"])
+        if xprof_cfg.get("capture") is not None:
+            xprof_kwargs["capture"] = int(xprof_cfg["capture"])
+        telemetry.profile.configure_xprof(
+            telemetry.member_artifact_path(str(xprof_cfg["dir"])),
+            **xprof_kwargs,
+        )
 
     input_spec = dict(config["input"])
     if warm and warm.get("delta_paths"):
@@ -742,6 +758,10 @@ def run(config: Mapping, output_dir: Optional[str] = None) -> dict:
     finally:
         if heartbeat is not None:
             heartbeat.stop()
+        # close any still-open xprof capture window (idempotent): a fit
+        # shorter than the arm threshold, or one interrupted mid-window,
+        # must not leave the jax profiler tracing into a dead directory
+        telemetry.profile.stop_xprof()
 
     if output_dir is not None and index_maps is not None:
         _persist_feature_artifacts(output_dir, index_maps, train_data)
@@ -787,6 +807,21 @@ def main(argv=None) -> int:
         help="write the run report (markdown; + a sibling .json compare "
         "baseline) here when training ends — the `cli report` rendering "
         "of this run's trace/telemetry/checkpoints (config report_out)",
+    )
+    parser.add_argument(
+        "--xprof-dir",
+        metavar="DIR",
+        help="capture a jax.profiler (xprof) trace into this directory, "
+        "armed around the Kth instrumented-jit dispatch (see "
+        "--xprof-arm) so compiles are excluded; refused on the CPU "
+        "backend (config key xprof.dir)",
+    )
+    parser.add_argument(
+        "--xprof-arm",
+        type=int,
+        metavar="K",
+        help="dispatch count at which the --xprof-dir capture window "
+        "opens (default 20 — past warmup/compile; config xprof.arm_at)",
     )
     parser.add_argument(
         "--heartbeat-every",
@@ -954,6 +989,20 @@ def main(argv=None) -> int:
         config["telemetry_out"] = args.telemetry_out
     if args.report_out:
         config["report_out"] = args.report_out
+    if args.xprof_dir or args.xprof_arm is not None:
+        xp = config.get("xprof")
+        xp = dict(xp) if isinstance(xp, dict) else (
+            {"dir": xp} if xp else {}
+        )
+        if args.xprof_dir:
+            xp["dir"] = args.xprof_dir
+        if args.xprof_arm is not None:
+            xp["arm_at"] = args.xprof_arm
+        if "dir" not in xp:
+            parser.error(
+                "--xprof-arm needs --xprof-dir (or a config xprof.dir)"
+            )
+        config["xprof"] = xp
     if args.heartbeat_every is not None:
         if args.heartbeat_every <= 0:
             config["heartbeat"] = False

@@ -25,6 +25,13 @@ Five layers (ISSUE 1 gave emission; ISSUE 3 the interpretation):
   recompile attribution per executable; roofline peaks for MFU and
   bandwidth utilization; ``comms.*`` collective-bytes estimates (the
   run report's "Device utilization" section).
+- :mod:`photon_ml_tpu.telemetry.profile` — the executable layer
+  (ISSUE 16): every ``instrumented_jit`` dispatch is counted and every
+  Nth honestly timed (fetch-synchronized through ``sync_fetch``),
+  yielding per-executable exclusive seconds, MFU, arithmetic intensity,
+  and a roofline bound class — the run report's "Hot executables" table
+  and the heartbeat's ``hot_exec`` field. Armed at import; sampled, so
+  steady-state overhead stays under 2%.
 - :mod:`photon_ml_tpu.telemetry.identity` / ``.fleet_report`` — fleet
   observability (ISSUE 13): per-member artifact suffixing
   (``trace.proc-0.jsonl``), process identity + epoch anchors in every
@@ -54,7 +61,14 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from photon_ml_tpu.telemetry import identity, memory, metrics, trace, xla  # noqa: F401
+from photon_ml_tpu.telemetry import (  # noqa: F401
+    identity,
+    memory,
+    metrics,
+    profile,
+    trace,
+    xla,
+)
 from photon_ml_tpu.telemetry.identity import member_artifact_path  # noqa: F401
 from photon_ml_tpu.telemetry.device import (  # noqa: F401
     install_compile_hooks,
@@ -71,7 +85,6 @@ from photon_ml_tpu.telemetry.metrics import (  # noqa: F401
     histogram,
     snapshot,
 )
-from photon_ml_tpu.telemetry.metrics import flush_jsonl as flush_metrics  # noqa: F401
 from photon_ml_tpu.telemetry.progress import Heartbeat  # noqa: F401
 from photon_ml_tpu.telemetry.trace import (  # noqa: F401
     active_span_path,
@@ -105,6 +118,7 @@ __all__ = [
     "identity",
     "member_artifact_path",
     "xla",
+    "profile",
     "instrumented_jit",
     "record_collective",
     "XLA_REGISTRY",
@@ -125,6 +139,15 @@ def configure(
 ) -> None:
     """Point the span JSONL sink at ``trace_out`` (None = leave as-is)."""
     trace.configure(jsonl_path=trace_out, buffer_limit=buffer_limit)
+
+
+def flush_metrics(path: str) -> dict:
+    """Append the metrics snapshot to ``path`` (``metrics.flush_jsonl``),
+    after flushing the executable profiler's lazily-published derived
+    gauges (MFU, bound class, ...) so offline report loads rebuild the
+    Hot-executables table from the JSONL alone."""
+    profile.publish_metrics()
+    return metrics.flush_jsonl(path)
 
 
 def configure_from_env() -> None:
@@ -165,6 +188,7 @@ def reset() -> None:
     metrics.reset()
     memory.reset()
     xla.reset()
+    profile.reset()
     flush = _env_state["atexit_flush"]
     if flush is not None:
         import atexit
@@ -174,3 +198,6 @@ def reset() -> None:
 
 
 install_compile_hooks()
+# arm the executable-level dispatch sampler (idempotent; profile.reset()
+# re-arms, so test isolation never leaves profiling dark)
+profile.install()

@@ -251,6 +251,7 @@ class FleetMember:
             self.report.heartbeats[-1] if self.report.heartbeats else None
         )
         chunks = counters.get("streaming_chunks")
+        hot = self.report.hot_executables(k=1)
         return {
             "process_index": self.process_index,
             "hostname": self.hostname,
@@ -267,6 +268,7 @@ class FleetMember:
                 wait / run_s if wait is not None and run_s else None
             ),
             "chunks_done": None if chunks is None else int(chunks),
+            "hot_exec": hot[0]["name"] if hot else None,
             "run_seconds": round(run_s, 6) if run_s else None,
             "heartbeats": len(self.report.heartbeats),
             "heartbeat_gap_max_s": self.heartbeat_gap_max_s(),
@@ -456,6 +458,82 @@ class FleetReport:
             },
         }
 
+    def merged_hot_executables(self, k: int = 10) -> list[dict[str, Any]]:
+        """The fleet-wide hot-executable list: per-NAME sums of the
+        members' profiled exclusive seconds and dispatch counts (the
+        same executable runs on every member of an SPMD fleet, so the
+        fleet's cost of a kernel is the sum of its members' costs).
+        MFU is reported as the max across members (the best-observed
+        utilization of that kernel anywhere in the fleet); bound classes
+        are the set observed. Empty when no member profiled anything."""
+        merged: dict[str, dict[str, Any]] = {}
+        for m in self.members:
+            for e in m.report.hot_executables(k=1_000_000):
+                agg = merged.setdefault(
+                    e["name"],
+                    {
+                        "name": e["name"],
+                        "est_exclusive_seconds": 0.0,
+                        "dispatches": 0,
+                        "members": 0,
+                        "mfu_max": None,
+                        "bound_classes": [],
+                        "timing_suspect": False,
+                    },
+                )
+                agg["est_exclusive_seconds"] += float(
+                    e.get("est_exclusive_seconds") or 0.0
+                )
+                agg["dispatches"] += int(e.get("dispatches") or 0)
+                agg["members"] += 1
+                mfu = e.get("mfu")
+                if mfu is not None and (
+                    agg["mfu_max"] is None or mfu > agg["mfu_max"]
+                ):
+                    agg["mfu_max"] = mfu
+                bc = e.get("bound_class", "unknown")
+                if bc not in agg["bound_classes"]:
+                    agg["bound_classes"].append(bc)
+                agg["timing_suspect"] = agg["timing_suspect"] or bool(
+                    e.get("timing_suspect")
+                )
+        out = list(merged.values())
+        for agg in out:
+            agg["est_exclusive_seconds"] = round(
+                agg["est_exclusive_seconds"], 6
+            )
+            agg["bound_classes"] = sorted(agg["bound_classes"])
+        out.sort(key=lambda e: e["est_exclusive_seconds"], reverse=True)
+        return out[:k]
+
+    def _hot_executables_markdown(self, k: int = 10) -> list[str]:
+        hot = self.merged_hot_executables(k)
+        if not hot:
+            return []
+        lines = [
+            "## Fleet hot executables",
+            "",
+            "_Per-executable profiled exclusive seconds summed across "
+            "members (SPMD: the fleet pays every member's copy); MFU is "
+            "the best observed on any member._",
+            "",
+            "| executable | excl s (fleet) | dispatches | members | "
+            "MFU max | bound |",
+            "|---|---|---|---|---|---|",
+        ]
+        for e in hot:
+            name = f"`{e['name']}`"
+            if e["timing_suspect"]:
+                name += " ⚠"
+            lines.append(
+                f"| {name} | {_fmt(e['est_exclusive_seconds'])} | "
+                f"{e['dispatches']} | {e['members']} | "
+                f"{_fmt_pct(e['mfu_max'])} | "
+                f"{', '.join(e['bound_classes'])} |"
+            )
+        lines.append("")
+        return lines
+
     def key_metrics(self) -> dict[str, float]:
         """The aggregated scalar summary ``compare()`` gates on."""
         out: dict[str, float] = {
@@ -536,6 +614,7 @@ class FleetReport:
             "key_metrics": self.key_metrics(),
             "members": self.rows(),
             "straggler": self.straggler(),
+            "hot_executables": self.merged_hot_executables(),
         }
 
     def save_json(self, path: str) -> dict[str, Any]:
@@ -580,8 +659,8 @@ class FleetReport:
             "## Members",
             "",
             "| proc | status | rows/s | MFU | comms | wait s | wait "
-            "share | chunks | beats | max gap s | skew s |",
-            "|---|---|---|---|---|---|---|---|---|---|---|",
+            "share | chunks | hot exec | beats | max gap s | skew s |",
+            "|---|---|---|---|---|---|---|---|---|---|---|---|",
         ]
         for row in self.rows():
             lines.append(
@@ -594,11 +673,18 @@ class FleetReport:
                 f"{_fmt_or_unknown(row['collective_wait_s'])} | "
                 f"{_fmt_pct(row['collective_wait_share'])} | "
                 f"{_fmt_or_unknown(row['chunks_done'])} | "
-                f"{row['heartbeats']} | "
+                + (
+                    f"`{row['hot_exec']}`"
+                    if row.get("hot_exec")
+                    else "unknown"
+                )
+                + f" | {row['heartbeats']} | "
                 f"{_fmt_or_unknown(row['heartbeat_gap_max_s'])} | "
                 f"{_fmt(row['clock_skew_s'])} |"
             )
         lines.append("")
+
+        lines += self._hot_executables_markdown()
 
         straggler = self.straggler()
         if straggler is not None:

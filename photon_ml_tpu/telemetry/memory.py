@@ -33,6 +33,7 @@ __all__ = [
     "hbm_stats",
     "set_stats_provider",
     "record_device_memory",
+    "record_device_watermarks",
     "device_spread_bytes",
     "record_phase_memory",
     "estimate_table_bytes",
@@ -111,6 +112,32 @@ def record_device_memory(devices: Optional[Sequence] = None) -> dict[str, int]:
             )
         out[str(did)] = in_use
     return out
+
+
+def record_device_watermarks(
+    devices: Optional[Sequence] = None, phase: Optional[str] = None
+) -> dict[str, int]:
+    """Sample per-device HBM in-use and max-track high-watermark gauges.
+
+    The executable profiler calls this on its sampling cadence, so the
+    peaks are LIVE — they catch the transient allocation spike mid-solve
+    that the end-of-phase ``record_phase_memory`` probe sleeps through.
+    Gauges: ``memory.device.<id>.peak_bytes`` (per-run high-watermark)
+    and, when ``phase`` is given, ``memory.phase.<phase>.device.<id>
+    .peak_bytes``. Returns the sampled in-use bytes per device id (empty
+    on statless backends — absence stays unknown, never zero)."""
+    per_device = record_device_memory(devices)
+    for did, in_use in per_device.items():
+        peak = metrics.gauge(f"memory.device.{did}.peak_bytes")
+        if peak.value is None or in_use > peak.value:
+            peak.set(in_use)
+        if phase:
+            phase_peak = metrics.gauge(
+                f"memory.phase.{phase}.device.{did}.peak_bytes"
+            )
+            if phase_peak.value is None or in_use > phase_peak.value:
+                phase_peak.set(in_use)
+    return per_device
 
 
 def device_spread_bytes() -> Optional[int]:

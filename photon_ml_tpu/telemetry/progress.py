@@ -42,7 +42,14 @@ import threading
 import time
 from typing import Any, Optional
 
-from photon_ml_tpu.telemetry import identity, memory, metrics, trace, xla
+from photon_ml_tpu.telemetry import (
+    identity,
+    memory,
+    metrics,
+    profile,
+    trace,
+    xla,
+)
 
 __all__ = ["Heartbeat", "DEFAULT_INTERVAL_S", "tail_heartbeat_fields"]
 
@@ -86,6 +93,7 @@ class Heartbeat:
         self._last_xla_bytes = 0.0
         self._last_comms = 0.0
         self._last_ingest_rows = 0.0
+        self._last_profile_excl: dict[str, float] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -108,6 +116,7 @@ class Heartbeat:
             self._last_ingest_rows = (
                 metrics.peek_counter("ingest.rows") or 0.0
             )
+            self._last_profile_excl = profile.exclusive_seconds_by_name()
         self._thread = threading.Thread(
             target=self._run, name="photon-heartbeat", daemon=True
         )
@@ -199,6 +208,21 @@ class Heartbeat:
             )
             if ingest_rows is not None:
                 self._last_ingest_rows = ingest_rows
+            # hottest executable THIS window: top positive delta of the
+            # profiler's estimated exclusive seconds (pure registry read —
+            # no device probes). No sampled dispatches this window, or no
+            # profiler data at all, omits the field ("unknown", never a
+            # stale winner carried forward)
+            excl = profile.exclusive_seconds_by_name()
+            hot_exec = None
+            hot_delta = 0.0
+            for name, secs in excl.items():
+                d = secs - self._last_profile_excl.get(name, 0.0)
+                if d > hot_delta:
+                    hot_delta, hot_exec = d, name
+            self._last_profile_excl = excl
+            if hot_exec is not None:
+                line["hot_exec"] = hot_exec
             sink = self.jsonl_path
 
         # everything below reads device/metrics state, not heartbeat

@@ -37,6 +37,7 @@ __all__ = [
     "MetricDelta",
     "PhaseNode",
     "compare_metrics",
+    "directions_with_exec",
     "KEY_METRIC_DIRECTIONS",
     "REPORT_FORMAT_VERSION",
     "report_path",
@@ -61,6 +62,19 @@ KEY_METRIC_DIRECTIONS: dict[str, int] = {
 
 _STEP_MANIFEST_RE = re.compile(r"^step-(\d{8})$")
 
+
+def directions_with_exec(*metric_dicts: Mapping[str, Any]) -> dict[str, int]:
+    """``KEY_METRIC_DIRECTIONS`` extended with the dynamic per-executable
+    utilization metrics (``exec.<name>.mfu``, higher is better) present
+    in any of the given metric dicts — executable names are data, so they
+    cannot be enumerated statically like the other keys."""
+    directions = dict(KEY_METRIC_DIRECTIONS)
+    for metrics_dict in metric_dicts:
+        for name in metrics_dict:
+            if name.startswith("exec.") and name.endswith(".mfu"):
+                directions[name] = +1
+    return directions
+
 # Fields of the xla.exec.<name>.<field> metric names the executable table
 # is reconstructed from (suffix-matched: executable names may contain
 # dots, field names never do).
@@ -73,6 +87,31 @@ _XLA_EXEC_COUNTER_FIELDS = (
     "bytes_total",
 )
 _XLA_EXEC_GAUGE_FIELDS = ("flops_per_call", "bytes_per_call", "temp_bytes")
+
+# Fields of the profile.exec.<name>.<field> gauges the Hot-executables
+# table is reconstructed from (same suffix-match convention).
+_PROFILE_EXEC_GAUGE_FIELDS = (
+    "dispatches",
+    "sampled",
+    "sampled_seconds",
+    "est_exclusive_seconds",
+    "mean_dispatch_seconds",
+    "mfu",
+    "intensity",
+    "bound_code",
+    "timing_suspect",
+)
+
+# Human names for the profiler's numeric bound-class codes, kept in sync
+# with telemetry.profile.BOUND_CLASS_NAMES (duplicated so reports load
+# without importing the profiler stack).
+_BOUND_CLASS_NAMES = {
+    0: "unknown",
+    1: "MXU-bound",
+    2: "VPU-bound",
+    3: "HBM-bound",
+    4: "dispatch-bound",
+}
 
 # device_utilization() cache sentinel (the computed value may be None)
 _DU_UNSET = object()
@@ -321,8 +360,11 @@ class RunReport:
     ) -> "RunReport":
         """Build from THIS process's live registries (the train driver's
         ``--report-out`` path needs no re-parse of its own sinks)."""
-        from photon_ml_tpu.telemetry import metrics, trace
+        from photon_ml_tpu.telemetry import metrics, profile, trace
 
+        # the profiler publishes its derived gauges (MFU, bound class)
+        # lazily — flush them so the snapshot carries the hot list
+        profile.publish_metrics()
         return cls(
             spans=[s.to_dict() for s in trace.finished_spans()],
             snapshot=metrics.snapshot(),
@@ -418,6 +460,19 @@ class RunReport:
         du = self.device_utilization()
         if du is not None and du.get("mfu") is not None:
             out["mfu"] = float(du["mfu"])
+        # per-executable MFU from the profiler (exec.<name>.mfu): lets a
+        # compare flag "THIS kernel's utilization regressed" — names only
+        # present on one side are skipped by compare_metrics (renamed/new
+        # executables must never crash a baseline comparison)
+        prefix, suffix = "profile.exec.", ".mfu"
+        for key, value in gauges.items():
+            if (
+                key.startswith(prefix)
+                and key.endswith(suffix)
+                and value is not None
+            ):
+                name = key[len(prefix): -len(suffix)]
+                out[f"exec.{name}.mfu"] = float(value)
         return out
 
     def coordinate_summary(self) -> list[dict]:
@@ -590,6 +645,45 @@ class RunReport:
         )
         return ranked[:k]
 
+    def hot_executables(self, k: int = 10) -> list[dict]:
+        """Top-k executables by estimated exclusive device time, from the
+        ``profile.exec.<name>.<field>`` gauges (the executable-level
+        profiler's sampled HONEST timings — see telemetry.profile), so a
+        report loaded from a metrics JSONL alone still ranks them.
+        Each row carries MFU / intensity / bound class plus the matching
+        ``xla.exec.<name>.*`` compile split and recompile count. Empty
+        when the run carried no profiled dispatches."""
+        gauges = self.snapshot.get("gauges", {})
+        counters = self.snapshot.get("counters", {})
+        execs: dict[str, dict[str, Any]] = {}
+        for key, value in gauges.items():
+            if not key.startswith("profile.exec.") or value is None:
+                continue
+            rest = key[len("profile.exec."):]
+            for field in _PROFILE_EXEC_GAUGE_FIELDS:
+                if rest.endswith("." + field):
+                    name = rest[: -len(field) - 1]
+                    execs.setdefault(name, {"name": name})[field] = value
+                    break
+        for e in execs.values():
+            e["bound_class"] = _BOUND_CLASS_NAMES.get(
+                int(e.get("bound_code") or 0), "unknown"
+            )
+            e["timing_suspect"] = bool(e.get("timing_suspect"))
+            for field, source in (
+                ("compile_seconds", counters),
+                ("recompiles", counters),
+            ):
+                v = source.get(f"xla.exec.{e['name']}.{field}")
+                if v is not None:
+                    e[field] = v
+        ranked = sorted(
+            execs.values(),
+            key=lambda e: e.get("est_exclusive_seconds") or 0.0,
+            reverse=True,
+        )
+        return ranked[:k]
+
     def device_utilization(self) -> Optional[dict[str, Any]]:
         """Roofline accounting for the run: overall + per-phase FLOPs,
         MFU, HBM-bandwidth utilization, comms bytes/fraction, and
@@ -694,10 +788,17 @@ class RunReport:
     ) -> list[MetricDelta]:
         """Compare against a baseline: either a full report JSON document
         (``to_json()`` output — its ``key_metrics`` field is used) or a
-        bare ``{metric: value}`` dict."""
+        bare ``{metric: value}`` dict. Per-executable rows
+        (``exec.<name>.mfu``) compare when the executable exists on both
+        sides; renamed/new executables are skipped by compare_metrics'
+        shared-keys rule instead of crashing."""
         base = baseline.get("key_metrics", baseline)
+        current = self.key_metrics()
         return compare_metrics(
-            self.key_metrics(), base, threshold=threshold
+            current,
+            base,
+            threshold=threshold,
+            directions=directions_with_exec(current, base),
         )
 
     # -- rendering -----------------------------------------------------------
@@ -717,6 +818,7 @@ class RunReport:
             "coordinates": self.coordinate_summary(),
             "sweep": self.sweep_summary(),
             "device_utilization": self.device_utilization(),
+            "hot_executables": self.hot_executables(),
             "ingestion": self.ingestion_summary(),
             "serving": self.serving_summary(),
             "recovery": self.recovery_summary(),
@@ -783,6 +885,7 @@ class RunReport:
             lines.append("")
 
         lines += self._device_utilization_markdown()
+        lines += self._hot_executables_markdown()
         lines += self._accounting_markdown()
         lines += self._ingestion_markdown()
         lines += self._serving_markdown()
@@ -898,6 +1001,49 @@ class RunReport:
                     f"{_fmt_or_unknown(e.get('bytes_total'))} | "
                     f"{_fmt(e.get('recompiles') or 0)} |"
                 )
+        out.append("")
+        return out
+
+    def _hot_executables_markdown(self, k: int = 10) -> list[str]:
+        hot = self.hot_executables(k)
+        if not hot:
+            return []
+        out = [
+            "## Hot executables",
+            "",
+            "_Sampled honest timings per executable (telemetry.profile): "
+            "exclusive device seconds are extrapolated from every-Nth "
+            "fetch-synchronized measurements; see README \"Profiling\"._",
+            "",
+            "| executable | excl s | dispatches | mean ms | MFU | "
+            "intensity | bound | compile s | recompiles |",
+            "|---|---|---|---|---|---|---|---|---|",
+        ]
+        for e in hot:
+            mean = e.get("mean_dispatch_seconds")
+            name = e["name"] + (" ⚠" if e["timing_suspect"] else "")
+            out.append(
+                f"| `{name}` | "
+                f"{_fmt(e.get('est_exclusive_seconds'))} | "
+                f"{_fmt(e.get('dispatches'))} | "
+                f"{_fmt(None if mean is None else mean * 1e3)} | "
+                f"{_fmt_pct(e.get('mfu'))} | "
+                f"{_fmt_or_unknown(e.get('intensity'))} | "
+                f"{e['bound_class']} | "
+                f"{_fmt(e.get('compile_seconds'))} | "
+                f"{_fmt(e.get('recompiles') or 0)} |"
+            )
+        suspects = [e["name"] for e in hot if e["timing_suspect"]]
+        if suspects:
+            out += [
+                "",
+                "> **Warning — timing suspect**: "
+                + ", ".join(f"`{n}`" for n in suspects)
+                + " measured ABOVE the resolved device peak, which is "
+                "physically impossible — the clock is not seeing the "
+                "device (PERF_NOTES: only a device->host fetch truly "
+                "syncs). Treat these rates as fake.",
+            ]
         out.append("")
         return out
 
@@ -1364,6 +1510,9 @@ class RunReport:
             for name, value in g.items()
             if name.startswith("memory.phase.")
             and name.endswith(".peak_bytes")
+            # memory.phase.<phase>.device.<id>.peak_bytes rows are the
+            # per-device watermarks, rendered separately below
+            and ".device." not in name[len("memory.phase."):]
             and value is not None
         }
         headroom = self.snapshot.get("counters", {}).get(
@@ -1413,6 +1562,28 @@ class RunReport:
                 "- per-device in-use spread (max-min): "
                 f"{_fmt_bytes(g['memory.device_spread_bytes'])}"
             )
+        watermarks = {
+            name[len("memory.device."):-len(".peak_bytes")]: value
+            for name, value in g.items()
+            if name.startswith("memory.device.")
+            and name.endswith(".peak_bytes")
+            and value is not None
+        }
+        if watermarks:
+            # live high-watermarks from the profiler's sampling cadence:
+            # they catch the transient mid-solve spike the end-of-phase
+            # probes sleep through
+            lo, hi = min(watermarks.values()), max(watermarks.values())
+            line = (
+                f"- HBM high-watermark across {len(watermarks)} "
+                f"device(s): peak {_fmt_bytes(hi)}"
+            )
+            if len(watermarks) >= 2:
+                line += (
+                    f" (min {_fmt_bytes(lo)}, watermark spread "
+                    f"{_fmt_bytes(hi - lo)})"
+                )
+            out.append(line)
         if headroom:
             out.append(
                 f"- **{int(headroom)} headroom warning(s)** — predicted "
@@ -1457,16 +1628,16 @@ class RunReport:
         if not self.heartbeats:
             return []
         last = self.heartbeats[-1]
-        return [
-            "## Heartbeats",
-            "",
+        line = (
             f"- {len(self.heartbeats)} beat(s); last at uptime "
             f"{last.get('uptime_s', '?')}s in span "
             f"`{last.get('span') or '(idle)'}` — "
             f"{_fmt(last.get('rows_per_s'))} rows/s, "
-            f"{_fmt(last.get('coeffs_per_s'))} coeffs/s",
-            "",
-        ]
+            f"{_fmt(last.get('coeffs_per_s'))} coeffs/s"
+        )
+        if last.get("hot_exec"):
+            line += f"; hot executable `{last['hot_exec']}`"
+        return ["## Heartbeats", "", line, ""]
 
 
 def _render_tree(

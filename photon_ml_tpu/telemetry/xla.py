@@ -58,6 +58,7 @@ __all__ = [
     "instrumented_jit",
     "shape_signature",
     "set_analysis_provider",
+    "set_dispatch_profiler",
     "set_peaks",
     "device_peaks",
     "collective_bytes",
@@ -93,6 +94,23 @@ _PEAK_TABLE: tuple[tuple[str, float, float], ...] = (
 # either the old or the new hook, both valid)
 _peaks_override: Optional[tuple[Optional[float], Optional[float]]] = None
 _analysis_provider: Optional[Callable] = None
+
+# the executable-level profiler hook (telemetry.profile installs its
+# sampler here at import). NOT cleared by reset() — disarming profiling
+# is an explicit set_dispatch_profiler(None), never a side effect of
+# test isolation.
+_dispatch_profiler: Optional[Callable] = None
+
+
+def set_dispatch_profiler(hook: Optional[Callable]) -> None:
+    """Install the per-dispatch profiler hook. When set, every
+    ``InstrumentedFunction`` invocation routes through
+    ``hook(record, target, args, kwargs)`` — the hook must call
+    ``target(*args, **kwargs)`` exactly once, return its result, and let
+    target exceptions propagate unmodified (the AOT TypeError/ValueError
+    fallback depends on seeing them). ``None`` disarms."""
+    global _dispatch_profiler
+    _dispatch_profiler = hook
 
 
 # ---------------------------------------------------------------------------
@@ -604,9 +622,14 @@ class InstrumentedFunction:
                     self._compiled[key] = entry
         compiled, rec = entry
         XLA_REGISTRY.record_call(rec)
+        prof = _dispatch_profiler
         if compiled is None:
+            if prof is not None:
+                return prof(rec, self._jit, args, kwargs)
             return self._jit(*args, **kwargs)
         try:
+            if prof is not None:
+                return prof(rec, compiled, args, kwargs)
             return compiled(*args, **kwargs)
         except (TypeError, ValueError):
             # AOT argument-processing mismatch inside one key bucket
@@ -622,6 +645,8 @@ class InstrumentedFunction:
             )
             metrics.counter("xla.fallback_calls").inc()
             self._compiled[key] = (None, rec)
+            if prof is not None:
+                return prof(rec, self._jit, args, kwargs)
             return self._jit(*args, **kwargs)
 
     def _compile(self, structure, leaf_sig, args, kwargs):

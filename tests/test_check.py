@@ -1259,3 +1259,74 @@ class TestFleetObservabilityGate:
         assert locks.run(g) == []
         findings = hotpath.run(g, require_seeds=False)
         assert [f for f in findings if f.code == "L013"] == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: the executable profiler's sampler joins the analysis scope
+# ---------------------------------------------------------------------------
+
+
+def _profiler_tree(fetch_stmt: str) -> dict:
+    """A profile.py-shaped fixture: the dispatch sampler with its
+    synchronizing fetch spelled ``fetch_stmt`` — bare np.asarray re-opens
+    the fake-timing trap; routing through sync_fetch is sanctioned."""
+    return {
+        "photon_ml_tpu/__init__.py": "",
+        "photon_ml_tpu/telemetry/__init__.py": "",
+        "photon_ml_tpu/telemetry/device.py": (
+            "import numpy as np\n\n\n"
+            "def sync_fetch(x, label=None):\n"
+            "    return np.asarray(x)\n"
+        ),
+        "photon_ml_tpu/telemetry/profile.py": (
+            ("import numpy as np\n\n" if "np." in fetch_stmt else "")
+            + ("from photon_ml_tpu.telemetry.device import "
+               "sync_fetch\n\n\n" if "sync_fetch" in fetch_stmt else "")
+            + "def profile_dispatch(rec, target, args, kwargs):\n"
+            "    out = target(*args, **kwargs)\n"
+            f"    {fetch_stmt}\n"
+            "    return out\n"
+        ),
+    }
+
+
+class TestProfilerGateRegistration:
+    def test_sampler_seed_and_hot_file_are_registered(self):
+        from tools.analysis import hotpath
+
+        assert (
+            "photon_ml_tpu.telemetry.profile.profile_dispatch"
+            in hotpath.SYNC_SEEDS
+        )
+        rel = os.path.join("photon_ml_tpu", "telemetry", "profile.py")
+        assert local.is_l011_hot(rel)
+
+    def test_bare_asarray_in_sampler_fails_the_real_cli(self, tmp_path):
+        """ISSUE 16 satellite acceptance: a bare np.asarray in the
+        dispatch sampler — an unaccounted device sync on the hottest
+        path in the process — flips the REAL CLI to exit 1."""
+        write_tree(tmp_path, _profiler_tree("np.asarray(out)"))
+        proc = subprocess.run(
+            [sys.executable, CHECK, "--root", str(tmp_path), "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        l013 = [f for f in doc["findings"] if f["code"] == "L013"]
+        assert l013, doc["findings"]
+        (finding,) = l013
+        assert finding["path"] == "photon_ml_tpu/telemetry/profile.py"
+        assert "np.asarray" in finding["message"]
+        assert finding["chain"] == ["telemetry.profile.profile_dispatch"]
+
+    def test_sanctioned_sync_fetch_route_passes(self, tmp_path):
+        write_tree(
+            tmp_path,
+            _profiler_tree("sync_fetch(out, label=rec.name)"),
+        )
+        proc = subprocess.run(
+            [sys.executable, CHECK, "--root", str(tmp_path), "--json"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout)["findings"] == []

@@ -216,6 +216,8 @@ def _write_member(
     truncate_trace: bool = False,
     write_metrics: bool = True,
     rendezvous_end: float = None,
+    extra_gauges: dict = None,
+    extra_counters: dict = None,
 ):
     """One member's artifact pair in the identity naming contract. The
     truncate/no-metrics combination is EXACTLY the leftover shape a
@@ -275,6 +277,10 @@ def _write_member(
                 # minimal counters/gauges RunReport needs
                 counters["xla.flops_total"] = mfu * 1e12 * 10.0
                 gauges["device.peak_flops"] = 1e12
+            if extra_gauges:
+                gauges.update(extra_gauges)
+            if extra_counters:
+                counters.update(extra_counters)
             fh.write(
                 json.dumps(
                     {"type": "metrics",
@@ -569,3 +575,73 @@ def test_train_explicit_artifact_flags_suffix_per_member(
         (tmp_path / "run.trace.proc-1.jsonl").read_text().splitlines()[0]
     )
     assert header["process_index"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: fleet-merged hot-executable list
+# ---------------------------------------------------------------------------
+
+
+def _profile_gauges(name, excl, dispatches, mfu, bound_code):
+    return {
+        f"profile.exec.{name}.est_exclusive_seconds": excl,
+        f"profile.exec.{name}.dispatches": dispatches,
+        f"profile.exec.{name}.mfu": mfu,
+        f"profile.exec.{name}.bound_code": bound_code,
+    }
+
+
+def test_fleet_report_merged_hot_executables(tmp_path):
+    """The fleet hot list sums exclusive seconds per executable NAME
+    across members (SPMD: the fleet pays every member's copy), reports
+    the best-observed MFU, collects the bound classes seen, and rides
+    the member rows / JSON / markdown."""
+    g0 = dict(_profile_gauges("solve", 4.0, 100, 0.30, 1))
+    g0.update(_profile_gauges("aux", 1.0, 50, 0.05, 4))
+    _write_member(
+        tmp_path, 0, anchor_unix=1000.0, wait_s=1.0, rows_per_sec=100.0,
+        extra_gauges=g0,
+    )
+    _write_member(
+        tmp_path, 1, anchor_unix=1000.0, wait_s=1.0, rows_per_sec=90.0,
+        extra_gauges=_profile_gauges("solve", 2.0, 100, 0.40, 3),
+    )
+    report = FleetReport.load(str(tmp_path))
+
+    hot = report.merged_hot_executables()
+    assert [e["name"] for e in hot] == ["solve", "aux"]
+    solve = hot[0]
+    assert solve["est_exclusive_seconds"] == pytest.approx(6.0)
+    assert solve["dispatches"] == 200
+    assert solve["members"] == 2
+    assert solve["mfu_max"] == pytest.approx(0.40)
+    assert solve["bound_classes"] == ["HBM-bound", "MXU-bound"]
+    assert solve["timing_suspect"] is False
+    assert hot[1]["members"] == 1
+    assert hot[1]["bound_classes"] == ["dispatch-bound"]
+
+    # each member row names ITS hottest executable
+    rows = {r["process_index"]: r for r in report.rows()}
+    assert rows[0]["hot_exec"] == "solve"
+    assert rows[1]["hot_exec"] == "solve"
+
+    doc = json.loads(json.dumps(report.to_json(), default=str))
+    assert doc["hot_executables"][0]["name"] == "solve"
+    assert doc["hot_executables"][0]["members"] == 2
+
+    md = report.to_markdown()
+    assert "## Fleet hot executables" in md
+    assert "| `solve` |" in md
+    assert "HBM-bound, MXU-bound" in md
+    assert "| hot exec |" in md.replace("\n", " ")  # Members column
+
+
+def test_fleet_report_members_without_profiles_render_unknown(tmp_path):
+    _write_member(tmp_path, 0, anchor_unix=1000.0, wait_s=1.0)
+    _write_member(tmp_path, 1, anchor_unix=1000.0, wait_s=1.0)
+    report = FleetReport.load(str(tmp_path))
+    assert report.merged_hot_executables() == []
+    assert all(r["hot_exec"] is None for r in report.rows())
+    md = report.to_markdown()
+    assert "## Fleet hot executables" not in md
+    assert "unknown" in md  # the hot-exec member column stays unknown

@@ -357,3 +357,80 @@ def test_gate_fleet_observability_metrics_lower_is_better(capsys):
     err = capsys.readouterr().err
     assert rc == 0
     assert "fleet_collective_wait_fraction: new metric" in err
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: per-device HBM high-watermarks across a multichip fleet, and
+# the gated per-kernel utilization metrics
+# ---------------------------------------------------------------------------
+
+
+class _StatsDevice:
+    def __init__(self, did, in_use, limit=16 * 2**30):
+        self.id = did
+        self.stats = {"bytes_in_use": in_use, "bytes_limit": limit}
+
+    def memory_stats(self):
+        return self.stats
+
+
+def test_watermark_spread_across_eight_devices():
+    """Per-device HBM peaks are max-tracked independently per device and
+    per phase; the spread (max-min of current usage) exposes the skewed
+    member — exactly the imbalance a fleet report needs to attribute."""
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.telemetry import memory as tmem
+
+    devices = [_StatsDevice(i, (i + 1) * 2**20) for i in range(8)]
+    tmem.record_device_watermarks(devices, phase="fit")
+    # device 3 spikes during scoring, everyone else dips
+    for d in devices:
+        d.stats["bytes_in_use"] = 2**20
+    devices[3].stats["bytes_in_use"] = 12 * 2**20
+    tmem.record_device_watermarks(devices, phase="score")
+
+    g = telemetry.snapshot()["gauges"]
+    # global per-device peaks hold the max across BOTH phases
+    assert g["memory.device.3.peak_bytes"] == 12 * 2**20
+    assert g["memory.device.7.peak_bytes"] == 8 * 2**20
+    # per-phase peaks stay attributed to their phase
+    assert g["memory.phase.fit.device.3.peak_bytes"] == 4 * 2**20
+    assert g["memory.phase.score.device.3.peak_bytes"] == 12 * 2**20
+    assert g["memory.phase.score.device.0.peak_bytes"] == 2**20
+    # the live spread names the imbalance: 12 MiB vs 1 MiB
+    assert tmem.device_spread_bytes() == 11 * 2**20
+
+
+def test_gate_kernel_utilization_metrics(capsys):
+    """The per-kernel utilization metrics ride bench_suite's gate:
+    an MFU drop regresses (higher is better), and baselines predating
+    the profiler skip-with-note."""
+    import bench_suite
+
+    assert "glm_value_grad_mfu" in bench_suite.SUITE_METRICS
+    assert "hot_dispatch_fraction" in bench_suite.SUITE_METRICS
+    baseline = {"glm_value_grad_mfu": 0.5, "hot_dispatch_fraction": 0.8}
+    rc = bench_suite.run_gate(
+        {"glm_value_grad_mfu": 0.1, "hot_dispatch_fraction": 0.8},
+        baseline, threshold=0.2,
+    )
+    assert rc == bench_suite.GATE_EXIT_CODE  # MFU collapsed: regression
+    capsys.readouterr()
+    rc = bench_suite.run_gate(
+        {"glm_value_grad_mfu": 0.55, "hot_dispatch_fraction": 0.9},
+        baseline, threshold=0.2,
+    )
+    assert rc == 0  # better utilization passes
+    capsys.readouterr()
+    # an old baseline without the profiler metrics: skipped with a note
+    rc = bench_suite.run_gate(
+        {
+            "glm_value_grad_mfu": 0.1,
+            "linreg_tron_1Mx10K_rows_per_sec_per_chip": 100.0,
+        },
+        {"linreg_tron_1Mx10K_rows_per_sec_per_chip": 100.0},
+        threshold=0.2,
+    )
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "glm_value_grad_mfu: new metric" in err and "skipped" in err

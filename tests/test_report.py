@@ -865,3 +865,200 @@ def test_heartbeat_ingest_fields():
     telemetry.metrics.counter("ingest.stalls").inc()
     line = hb.beat()
     assert line["ingest_stalls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: executable-level roofline profiler in heartbeats + reports
+# ---------------------------------------------------------------------------
+
+
+def _record_profile(name, seconds, exclusive, flops, nbytes, n=1):
+    """Drive the profile registry directly: n sampled dispatches of
+    ``name`` at the given per-dispatch honest timing / cost."""
+    from photon_ml_tpu.telemetry import profile
+
+    for _ in range(n):
+        profile.PROFILE_REGISTRY.count_dispatch(name, ("f32[8]",), 1)
+        profile.PROFILE_REGISTRY.record_sample(
+            name, ("f32[8]",), seconds, exclusive, 0.0, flops, nbytes
+        )
+
+
+def test_heartbeat_hot_exec_round_trip(tmp_path):
+    """The heartbeat's hot_exec field names the executable with the top
+    exclusive-time DELTA over the last interval, rides the JSONL sink
+    through tail_heartbeat_fields, and stays absent (unknown) when no
+    dispatch was profiled — never a stale winner."""
+    from photon_ml_tpu.telemetry.progress import tail_heartbeat_fields
+
+    out = tmp_path / "hb.jsonl"
+    hb = Heartbeat(interval=60, jsonl_path=str(out))
+    line = hb.beat()
+    assert "hot_exec" not in line  # nothing profiled yet: unknown
+
+    _record_profile("alpha", 3.0, 3.0, None, None)
+    _record_profile("beta", 1.0, 1.0, None, None)
+    line = hb.beat()
+    assert line["hot_exec"] == "alpha"
+    rec = tail_heartbeat_fields(str(out))
+    assert rec is not None and rec["hot_exec"] == "alpha"
+
+    # next interval: only beta advances -> the DELTA winner flips
+    _record_profile("beta", 2.0, 2.0, None, None)
+    assert hb.beat()["hot_exec"] == "beta"
+    # idle interval: no new samples, no winner, field omitted
+    assert "hot_exec" not in hb.beat()
+
+
+def test_report_hot_executables_round_trip(tmp_path):
+    """Hot-executables table: built from the profile.exec.* gauges at
+    report time, ranked by exclusive seconds, carrying MFU / intensity /
+    bound class and the xla.exec.* compile split; survives the JSON
+    baseline and a metrics-JSONL reload."""
+    from photon_ml_tpu.telemetry import xla
+
+    xla.set_peaks(1e12, 1e11)
+    # 4 dispatches, 0.5 s each, intensity 1.25 (< balance 10): HBM-bound
+    _record_profile("glm_value_grad", 0.5, 0.4, 1e10, 8e9, n=4)
+    _record_profile("tiny", 0.01, 0.01, None, None)
+    telemetry.metrics.counter(
+        "xla.exec.glm_value_grad.recompiles"
+    ).inc(2)
+    telemetry.metrics.counter(
+        "xla.exec.glm_value_grad.compile_seconds"
+    ).inc(1.5)
+
+    report = RunReport.from_live()
+    hot = report.hot_executables()
+    assert [e["name"] for e in hot] == ["glm_value_grad", "tiny"]
+    top = hot[0]
+    assert top["est_exclusive_seconds"] == pytest.approx(1.6)
+    assert top["dispatches"] == 4
+    assert top["mfu"] == pytest.approx(0.02)
+    assert top["bound_class"] == "HBM-bound"
+    assert top["recompiles"] == 2
+    assert top["compile_seconds"] == pytest.approx(1.5)
+    assert top["timing_suspect"] is False
+
+    km = report.key_metrics()
+    assert km["exec.glm_value_grad.mfu"] == pytest.approx(0.02)
+
+    md = report.to_markdown()
+    assert "## Hot executables" in md
+    assert "`glm_value_grad`" in md
+    assert "HBM-bound" in md
+    assert "| MFU |" in md
+
+    # JSON baseline round trip
+    doc = report.save_json(str(tmp_path / "r.json"))
+    loaded = json.loads((tmp_path / "r.json").read_text())
+    assert loaded["hot_executables"][0]["name"] == "glm_value_grad"
+    assert doc["key_metrics"]["exec.glm_value_grad.mfu"] == km[
+        "exec.glm_value_grad.mfu"
+    ]
+
+    # metrics-JSONL reload reconstructs the same table
+    tele = tmp_path / "run.metrics.jsonl"
+    telemetry.flush_metrics(str(tele))
+    reloaded = RunReport.load(telemetry=str(tele))
+    rehot = reloaded.hot_executables()
+    assert rehot[0]["name"] == "glm_value_grad"
+    assert rehot[0]["bound_class"] == "HBM-bound"
+
+
+def test_report_without_profiles_has_no_hot_section():
+    live = RunReport.from_live()
+    assert live.hot_executables() == []
+    assert "## Hot executables" not in live.to_markdown()
+
+
+def test_report_renders_timing_suspect_warning():
+    from photon_ml_tpu.telemetry import xla
+
+    xla.set_peaks(1e12, 1e11)
+    # forged-clock rate: 1e9 FLOPs in a nanosecond >> device peak
+    _record_profile("liar", 1e-9, 1e-9, 1e9, 1e6)
+    md = RunReport.from_live().to_markdown()
+    assert "`liar ⚠`" in md
+    assert "timing suspect" in md
+    assert "physically impossible" in md
+
+
+def test_cli_report_hot_flag(tmp_path):
+    """`cli report --hot` renders ONLY the hot-executables table."""
+    from photon_ml_tpu.cli.report import main as report_main
+
+    _record_profile("solve", 2.0, 2.0, None, None)
+    tele = tmp_path / "run.metrics.jsonl"
+    telemetry.flush_metrics(str(tele))
+    telemetry.reset()
+
+    out = tmp_path / "hot.md"
+    rc = report_main(
+        ["--telemetry", str(tele), "--hot", "--out", str(out)]
+    )
+    assert rc == 0
+    md = out.read_text()
+    assert "## Hot executables" in md
+    assert "`solve`" in md
+    assert "# Run report" not in md  # the full report is suppressed
+
+    # no profiled dispatches: an explanatory line, not an empty file
+    empty_tele = tmp_path / "empty.metrics.jsonl"
+    empty_tele.write_text(
+        json.dumps({"type": "metrics", "snapshot": {
+            "counters": {}, "gauges": {}, "histograms": {},
+        }}) + "\n"
+    )
+    rc = report_main(
+        ["--telemetry", str(empty_tele), "--hot", "3",
+         "--out", str(tmp_path / "none.md")]
+    )
+    assert rc == 0
+    assert "No profiled executables" in (tmp_path / "none.md").read_text()
+
+
+def test_cli_report_compare_notes_and_skips_exec_metrics(
+    tmp_path, capsys
+):
+    """Per-executable rows in --compare: renamed/new executables are
+    note-and-skipped on stderr; a regression on a SHARED executable's
+    MFU still flags."""
+    from photon_ml_tpu.cli.report import main as report_main
+    from photon_ml_tpu.telemetry import xla
+
+    xla.set_peaks(1e12, 1e11)
+    # shared: mfu 0.02; new_kernel: only in the current run
+    _record_profile("shared", 0.5, 0.5, 1e10, 8e9, n=2)
+    _record_profile("new_kernel", 0.2, 0.2, 2e10, 1e9)
+    tele = tmp_path / "run.metrics.jsonl"
+    telemetry.flush_metrics(str(tele))
+    telemetry.reset()
+
+    baseline = {
+        "key_metrics": {
+            # shared at 5x the current MFU: an MFU regression
+            "exec.shared.mfu": 0.1,
+            # old_kernel: renamed away since the baseline
+            "exec.old_kernel.mfu": 0.3,
+        }
+    }
+    base_path = tmp_path / "baseline.json"
+    base_path.write_text(json.dumps(baseline))
+
+    rc = report_main([
+        "--telemetry", str(tele),
+        "--out", str(tmp_path / "cmp.md"),
+        "--compare", str(base_path), "--fail-on-regress",
+    ])
+    err = capsys.readouterr().err
+    assert rc == 3  # the shared executable's MFU regressed
+    assert "exec.new_kernel.mfu" in err and "is new" in err
+    assert "exec.old_kernel.mfu" in err
+    assert "only in the baseline" in err
+    md = (tmp_path / "cmp.md").read_text()
+    cmp_md = md[md.index("## Comparison vs baseline"):]
+    assert "`exec.shared.mfu`" in cmp_md and "**REGRESSED**" in cmp_md
+    # the one-sided rows were skipped, not compared
+    assert "exec.new_kernel.mfu" not in cmp_md
+    assert "exec.old_kernel.mfu" not in cmp_md

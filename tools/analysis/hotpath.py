@@ -55,6 +55,13 @@ SYNC_SEEDS = (
     "photon_ml_tpu.telemetry.progress.tail_heartbeat_fields",
     "photon_ml_tpu.parallel.fleet_status.FleetStatusWriter.snapshot",
     "photon_ml_tpu.parallel.fleet_status.FleetStatusWriter.write_once",
+    # executable-level profiler (ISSUE 16): the dispatch sampler wraps
+    # EVERY instrumented_jit call — its one honest device sync must stay
+    # routed through the sanctioned telemetry.device.sync_fetch crossing
+    # (a bare np.asarray/device_get here would re-open the fake-timing
+    # trap on the hottest path in the process). A rename surfaces as
+    # W002, not silence.
+    "photon_ml_tpu.telemetry.profile.profile_dispatch",
 )
 
 #: The sanctioned device->host crossing: its body is the accounted fetch.

@@ -85,6 +85,12 @@ L011_HOT_FILES = {
     # whose recompiles the SLO bench gates p99 flatness over
     os.path.join("photon_ml_tpu", "serving", "nearline.py"),
     os.path.join("photon_ml_tpu", "training.py"),
+    # the executable profiler wraps EVERY instrumented dispatch: a bare
+    # jax.jit inside it would both escape its own accounting and put an
+    # uninstrumented program on the hottest path in the process; its
+    # functions are also L013 jit-walk seeds, so a device sync it
+    # introduces is caught on the real dispatch path
+    os.path.join("photon_ml_tpu", "telemetry", "profile.py"),
 }
 L011_COLD_ALLOWLIST = {
     # gather_to_host: a once-per-summary replicating identity, not a
