@@ -299,7 +299,9 @@ _SCRIPT_METRICS = {
     "bench_ingest.py": _INGEST_METRICS,
     "bench_freshness.py": _FRESHNESS_METRICS,
     "bench_serving.py": ("serving_p50_ms", "serving_p99_ms",
-                         "serving_rows_per_sec"),
+                         "serving_rows_per_sec",
+                         "serving_fleet_p99_resize_ratio",
+                         "serving_fleet_kill_recovery_s"),
     "bench_northstar.py": ("north_star_e2e",),
 }
 

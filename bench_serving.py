@@ -64,6 +64,11 @@ SLO_METRICS = (
     "serving_nearline_apply_ms",
 )
 
+FLEET_METRICS = (
+    "serving_fleet_p99_resize_ratio",
+    "serving_fleet_kill_recovery_s",
+)
+
 #: Offered load at/below engine capacity may shed at most this fraction
 #: of requests — the SLO error budget.
 SHED_BUDGET = 0.01
@@ -478,12 +483,127 @@ def run_serving_slo(
     return results
 
 
+def run_serving_fleet_bench(
+    deadline=None,
+    *,
+    fleet_size=4,
+    resize_to=8,
+    traffic_seconds=32.0,
+    detail_out=None,
+) -> dict:
+    """The shard-owning FLEET headline: a real ``fleet_size``-process
+    ``cli serve --member`` fleet under sustained router traffic survives
+    a mid-stream hard kill (``serving_fleet_kill_recovery_s`` =
+    heartbeat detection + same-slot relaunch back to a complete epoch)
+    and executes a live ``fleet_size -> resize_to -> fleet_size`` elastic
+    resize through the stage/commit barrier —
+    ``serving_fleet_p99_resize_ratio`` is p99 latency inside the resize
+    windows over the undisturbed steady windows (1.0 = perfectly flat;
+    the acceptance line is <= 1.1). Zero non-shed request failures is a
+    hard requirement, not a metric."""
+    import shutil as _shutil
+
+    from photon_ml_tpu import faults
+    from tools import fleet
+
+    faults.warn_if_armed()
+    results: dict = {m: None for m in FLEET_METRICS}
+    detail = detail_out if detail_out is not None else {}
+    # the full run needs every member warm twice (launch + resize)
+    if deadline is not None and deadline - time.monotonic() < 60:
+        return results
+    workdir = tempfile.mkdtemp(prefix="bench-serving-fleet-")
+    try:
+        version_dir = fleet.make_serving_model(
+            tempfile.mkdtemp(prefix="bench-fleet-reg-", dir=workdir),
+            n_entities=48,
+        )
+        kill_after_s = 2.0
+        grow_at = traffic_seconds * 0.35
+        shrink_at = traffic_seconds * 0.65
+        spec = fleet.ServingFleetSpec(
+            workdir=workdir,
+            model_dir=version_dir,
+            fleet_size=fleet_size,
+            traffic_seconds=traffic_seconds,
+            traffic_hz=20.0,
+            traffic_rows=8,
+            traffic_features=(("global", 2), ("user", 2)),
+            kill_member=1,
+            kill_after_s=kill_after_s,
+            relaunch=True,
+            heartbeat_deadline_s=2.0,
+            resizes=((grow_at, resize_to), (shrink_at, fleet_size)),
+        )
+        run = fleet.run_serving_fleet(spec)
+        samples = run.get("samples") or []
+
+        def p99(t_lo, t_hi):
+            sel = np.sort(np.asarray(
+                [ms for t, ms, _rows in samples if t_lo <= t < t_hi]
+            ))
+            return _percentile(sel, 0.99)
+
+        kill = run.get("kill") or {}
+        resize_windows = [
+            (ev["resize"]["t_start"], ev["resize"]["t_swap"] + 0.5)
+            for ev in run.get("events", [])
+            if "resize" in ev and "t_swap" in ev["resize"]
+        ]
+        # steady = everything outside the kill outage and resize windows
+        disturbed = list(resize_windows)
+        if kill.get("t_kill") is not None:
+            disturbed.append((
+                kill["t_kill"],
+                kill["t_kill"] + (kill.get("recovery_s") or 0.0) + 0.5,
+            ))
+        steady_lat = np.sort(np.asarray([
+            ms for t, ms, _rows in samples
+            if not any(lo <= t < hi for lo, hi in disturbed)
+        ]))
+        steady_p99 = _percentile(steady_lat, 0.99)
+        resize_lat = np.sort(np.asarray([
+            ms for t, ms, _rows in samples
+            if any(lo <= t < hi for lo, hi in resize_windows)
+        ]))
+        resize_p99 = _percentile(resize_lat, 0.99)
+        if steady_p99 and resize_p99:
+            results["serving_fleet_p99_resize_ratio"] = round(
+                resize_p99 / steady_p99, 3
+            )
+        if kill.get("recovery_s") is not None:
+            results["serving_fleet_kill_recovery_s"] = kill["recovery_s"]
+        detail["fleet"] = {
+            "fleet_size": fleet_size,
+            "resize_to": resize_to,
+            "steady_p99_ms": steady_p99,
+            "resize_p99_ms": resize_p99,
+            "resize_windows_s": [
+                [round(lo, 2), round(hi, 2)] for lo, hi in resize_windows
+            ],
+            "kill": kill,
+            "routed_rows": run.get("routed_rows"),
+            "degraded_scores": run.get("degraded_scores"),
+            "degraded_fraction": run.get("degraded_fraction"),
+            "request_failures": len(run.get("failures") or []),
+            "rcs": run.get("rcs"),
+            "ok": run.get("ok"),
+        }
+        if run.get("failures"):
+            # a non-shed failure voids the headline: report no number
+            # rather than a flat-looking p99 over a failing fleet
+            results["serving_fleet_p99_resize_ratio"] = None
+    finally:
+        _shutil.rmtree(workdir, ignore_errors=True)
+    return results
+
+
 def main() -> int:
     from bench_suite import budget_deadline, truncated_line
 
     deadline = budget_deadline()
     if deadline is not None and deadline - time.monotonic() < 30:
-        for metric in SERVING_METRICS + SLO_METRICS:
+        for metric in SERVING_METRICS + SLO_METRICS + FLEET_METRICS:
             print(truncated_line(metric), flush=True)
         return 0
 
@@ -591,6 +711,31 @@ def main() -> int:
                     ),
                     "vs_baseline": None,
                     "detail": slo_detail,
+                }
+            ),
+            flush=True,
+        )
+
+    # -- the shard-owning fleet headline ---------------------------------
+    fleet_detail: dict = {}
+    fleet_metrics = run_serving_fleet_bench(
+        deadline=deadline, detail_out=fleet_detail
+    )
+    for metric in FLEET_METRICS:
+        value = fleet_metrics.get(metric)
+        if value is None:
+            print(truncated_line(metric), flush=True)
+            continue
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": value,
+                    "unit": (
+                        "ratio" if metric.endswith("_ratio") else "s"
+                    ),
+                    "vs_baseline": None,
+                    "detail": fleet_detail,
                 }
             ),
             flush=True,

@@ -65,6 +65,10 @@ LOWER_IS_BETTER_METRICS = frozenset({
     "serving_slo_p99_swap_ratio",
     "serving_slo_p99_nearline_ratio",
     "serving_nearline_apply_ms",
+    # serving fleet (bench_serving run_serving_fleet_bench): resize-window
+    # p99 flatness and hard-kill recovery both regress upward
+    "serving_fleet_p99_resize_ratio",
+    "serving_fleet_kill_recovery_s",
     # fleet observability (bench_multichip): time lost waiting at
     # collectives and per-member MFU imbalance both regress upward
     "fleet_collective_wait_fraction",

@@ -11,6 +11,13 @@ Long-lived, low-latency counterpart of the batch ``cli score`` driver:
 
     python -m photon_ml_tpu.cli serve --model-dir out/model/best --stdio
 
+    python -m photon_ml_tpu.cli serve --registry-dir out/registry \\
+        --member 1 --fleet-size 4 --announce-dir out/fleet \\
+        --hbm-budget-mb 64 --port 0
+
+    python -m photon_ml_tpu.cli serve --registry-dir out/registry \\
+        --router --announce-dir out/fleet --port 8080
+
 ``--registry-dir`` watches a versioned models directory and hot-swaps to
 the newest valid version (see serving/registry.py for the layout);
 ``--model-dir`` pins one saved model (still requiring its
@@ -27,15 +34,32 @@ capacity frees). ``--nearline <id_name>`` accepts ``POST /v1/update``
 feedback events and re-solves just those entities' coefficient rows in
 place. ``--stdio`` swaps the HTTP front end for a JSONL stdin/stdout
 loop so pipelines and CI can drive the service without sockets.
+
+``--member i --fleet-size N`` serves as ONE shard-owning fleet member:
+the process loads only its deterministic entity slice of every
+random-effect table (serving/shard.py), enforces ``--hbm-budget-mb``
+against the SLICE, announces readiness into ``--announce-dir`` once
+warm, and accepts ``/v1/admin/stage`` + ``/v1/admin/commit`` for live
+resizes and hot swaps. ``--router`` serves the fleet's routing front
+end instead: entity lookups fan out to owning members discovered from
+the announce directory and partial margins fold exactly
+(serving/router.py) — unreachable members degrade to fixed-effect-only
+scores, never failures.
+
+SIGTERM/SIGINT drains gracefully: admission closes (503 with
+``Retry-After``), in-flight batches finish, and the process exits 75
+("incomplete, restart me" — schedulers relaunch it). A second signal
+hard-exits immediately.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import signal
+import os
 import sys
 import threading
+import time
 
 from photon_ml_tpu.utils import logger, setup_logging
 
@@ -66,6 +90,67 @@ def _parse_re_checkpoints(pairs):
             )
         out[coord] = directory
     return out or None
+
+
+class _ServingBeat:
+    """Member-attributed serving heartbeat: append one JSONL line per
+    interval carrying the cumulative request/row counters, so the fleet
+    supervisor's ``tail_heartbeat_fields`` poll can difference
+    successive beats into a live requests/s without any RPC into the
+    member."""
+
+    def __init__(self, path: str, member: int, interval_s: float = 1.0):
+        self.path = path
+        self.member = int(member)
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._t0 = time.monotonic()
+
+    def beat(self) -> None:
+        from photon_ml_tpu import telemetry
+
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        line = {
+            "type": "heartbeat",
+            "seq": seq,
+            "proc": self.member,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "serving_requests_total": int(
+                telemetry.counter("serving.requests").value
+            ),
+            "serving_margin_rows_total": int(
+                telemetry.counter("serving.margin_rows").value
+            ),
+        }
+        with open(self.path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(line) + "\n")
+
+    def start(self) -> "_ServingBeat":
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self.beat()
+        self._thread = threading.Thread(
+            target=self._run, name="serving-beat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.beat()
+            except OSError as e:  # a torn-down workdir must not kill serving
+                logger.warning("serving heartbeat write failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s * 4)
+            self._thread = None
 
 
 def main(argv=None) -> int:
@@ -164,6 +249,60 @@ def main(argv=None) -> int:
         help="serve a JSONL request/response loop on stdin/stdout instead "
         "of HTTP",
     )
+    fleet = parser.add_argument_group(
+        "serving fleet (shard-owning members + routing front end)"
+    )
+    fleet.add_argument(
+        "--member", type=int,
+        help="serve as shard-owning fleet member i: load ONLY this "
+        "member's contiguous entity slice of every random-effect table",
+    )
+    fleet.add_argument(
+        "--fleet-size", type=int,
+        help="fleet size N the ownership map is derived from (required "
+        "with --member)",
+    )
+    fleet.add_argument(
+        "--router", action="store_true",
+        help="serve as the fleet routing front end: fan entity lookups "
+        "out to owning members and fold partial margins exactly",
+    )
+    fleet.add_argument(
+        "--announce-dir",
+        help="fleet rendezvous directory: members atomically announce "
+        "member-<i>.json once warm; the router adopts the newest "
+        "complete epoch (required with --member / --router)",
+    )
+    fleet.add_argument(
+        "--epoch", type=int, default=0,
+        help="announce epoch this member starts in (a resize launches "
+        "replacements at epoch+1)",
+    )
+    fleet.add_argument(
+        "--hbm-budget-mb", type=float,
+        help="fail startup (ShardBudgetError) if the member's SLICE "
+        "exceeds this many MiB — the whole point of the fleet is that "
+        "the slice fits where the full model cannot",
+    )
+    fleet.add_argument(
+        "--heartbeat-dir",
+        help="touch proc-<member>.alive here on a cadence so the fleet "
+        "supervisor detects a dead member from file mtime alone",
+    )
+    fleet.add_argument(
+        "--telemetry-out",
+        help="append member-attributed serving heartbeat JSONL here "
+        "(requests/s for the fleet status surface)",
+    )
+    fleet.add_argument(
+        "--member-timeout-s", type=float, default=5.0,
+        help="router: per-member fan-out timeout before bounded "
+        "retry/backoff and degraded fallback",
+    )
+    fleet.add_argument(
+        "--router-refresh-s", type=float, default=0.5,
+        help="router: announce-directory rescan cadence",
+    )
     args = parser.parse_args(argv)
 
     setup_logging()
@@ -174,17 +313,105 @@ def main(argv=None) -> int:
     faults.warn_if_armed()
     from photon_ml_tpu.serving import (
         AsyncScoringServer,
+        FleetRouter,
         ModelRegistry,
         NearlineUpdater,
         ScoringEngine,
         ScoringServer,
         ScoringService,
+        ShardMemberSource,
+        fleet_lookups_from_version_dir,
+        load_member_engine,
+        scan_versions,
         serve_stdio,
+        write_announce,
     )
 
+    if args.member is not None and args.router:
+        raise SystemExit(
+            "--member and --router are different fleet processes; run one"
+        )
+    fleet_mode = args.member is not None or args.router
+    if fleet_mode:
+        if not args.announce_dir:
+            raise SystemExit("--member/--router require --announce-dir")
+        incompatible = [
+            flag
+            for flag, on in (
+                ("--stdio", args.stdio),
+                ("--nearline", args.nearline),
+                ("--mesh", args.mesh),
+            )
+            if on
+        ]
+        if incompatible:
+            raise SystemExit(
+                "fleet processes replicate fixed effects and slice "
+                "random-effect tables per member; drop "
+                + ", ".join(incompatible)
+            )
+    if args.member is not None and args.fleet_size is None:
+        raise SystemExit("--member requires --fleet-size")
+
+    def _version_dir(version=None):
+        """Resolve a registry version string (None = newest) to its
+        published directory; ``--model-dir`` pins one directory."""
+        if args.model_dir:
+            return args.model_dir
+        versions = scan_versions(args.registry_dir)
+        if not versions:
+            raise SystemExit(
+                f"no published versions under {args.registry_dir}"
+            )
+        if version is None:
+            return versions[-1][1]
+        for _, path in versions:
+            if os.path.basename(os.path.normpath(path)) == str(version):
+                return path
+        # the front ends map KeyError to HTTP 409 version_unavailable
+        raise KeyError(
+            f"version {version!r} is not published under "
+            f"{args.registry_dir}"
+        )
+
     registry = None
+    heartbeat = None
+    beat = None
     mesh = _build_mesh(args.mesh) if args.mesh else None
-    if args.model_dir:
+    if args.member is not None:
+
+        def _load_slice(fleet_size, version=None):
+            return load_member_engine(
+                _version_dir(version),
+                args.member,
+                fleet_size,
+                max_batch=args.max_batch,
+                max_row_nnz=args.max_row_nnz,
+                hbm_budget_bytes=(
+                    None
+                    if args.hbm_budget_mb is None
+                    else int(args.hbm_budget_mb * 2**20)
+                ),
+                re_checkpoints=_parse_re_checkpoints(args.re_checkpoint),
+            )
+
+        source = ShardMemberSource(
+            _load_slice, member=args.member, fleet_size=args.fleet_size
+        )
+        # load + warm BEFORE serving: announcing is the readiness barrier
+        source.commit(*source.stage(args.fleet_size))
+    elif args.router:
+        task, link, lookups = fleet_lookups_from_version_dir(_version_dir())
+        source = FleetRouter(
+            args.announce_dir,
+            lookups,
+            task=task,
+            link=link,
+            member_timeout_s=args.member_timeout_s,
+            refresh_interval_s=args.router_refresh_s,
+            max_batch=args.max_batch,
+        )
+    elif args.model_dir:
         source = ScoringEngine.load(
             args.model_dir,
             max_batch=args.max_batch,
@@ -255,34 +482,100 @@ def main(argv=None) -> int:
         )
         server = server_cls(service, host=args.host, port=args.port)
         server.start()
-        stop = threading.Event()
 
-        def _on_signal(signum, frame):
-            logger.info("received signal %d: shutting down", signum)
-            stop.set()
+        epoch_ref = {"epoch": int(args.epoch)}
 
-        signal.signal(signal.SIGTERM, _on_signal)
-        signal.signal(signal.SIGINT, _on_signal)
-        print(
-            json.dumps(
+        def _owned_ranges(fleet_size, version):
+            from photon_ml_tpu.parallel.sharding import member_row_range
+
+            try:
+                with open(
+                    os.path.join(
+                        _version_dir(version), "model-metadata.json"
+                    )
+                ) as fh:
+                    meta = json.load(fh)
+                out = {}
+                for spec in (meta.get("coordinates") or {}).values():
+                    if spec.get("type") != "random_effect":
+                        continue
+                    lo, hi = member_row_range(
+                        int(spec["num_entities"]), args.member, fleet_size
+                    )
+                    out[spec["id_name"]] = [lo, hi]
+                return out
+            except (OSError, ValueError, KeyError):
+                return {}
+
+        def _announce(fleet_size, version):
+            write_announce(
+                args.announce_dir,
                 {
-                    "serving": {
-                        "host": args.host,
-                        "port": server.port,
-                        "frontend": args.frontend,
-                        "batcher": batcher,
-                        "model_version": service.health().get("model_version"),
-                    }
-                }
-            ),
-            flush=True,
+                    "member": args.member,
+                    "fleet_size": int(fleet_size),
+                    "epoch": epoch_ref["epoch"],
+                    "url": f"http://{args.host}:{server.port}",
+                    "version": str(version),
+                    "ready": True,
+                    "pid": os.getpid(),
+                    "owned": _owned_ranges(fleet_size, version),
+                },
+            )
+
+        if args.member is not None:
+
+            def _on_commit(key, payload):
+                fleet_size, version = key
+                if payload.get("epoch") is not None:
+                    epoch_ref["epoch"] = int(payload["epoch"])
+                _announce(fleet_size, version)
+
+            service.on_commit = _on_commit
+            _announce(source.fleet_size, source.engine.version)
+            if args.heartbeat_dir:
+                from photon_ml_tpu.parallel.multihost import HeartbeatWriter
+
+                heartbeat = HeartbeatWriter(
+                    args.heartbeat_dir, args.member
+                ).start()
+            if args.telemetry_out:
+                beat = _ServingBeat(args.telemetry_out, args.member).start()
+
+        from photon_ml_tpu.game.checkpoint import GracefulStop
+
+        stop = GracefulStop(hard_exit_code=75).install()
+        banner = {
+            "host": args.host,
+            "port": server.port,
+            "frontend": args.frontend,
+            "batcher": batcher,
+            "model_version": service.health().get("model_version"),
+        }
+        if args.member is not None:
+            banner["member"] = args.member
+            banner["fleet_size"] = source.fleet_size
+            banner["epoch"] = epoch_ref["epoch"]
+        if args.router:
+            banner["router"] = True
+        print(json.dumps({"serving": banner}), flush=True)
+        while not stop():
+            time.sleep(0.2)
+        logger.info(
+            "draining: admission closed (503 + Retry-After), in-flight "
+            "batches finishing; exiting %d", stop.hard_exit_code,
         )
-        stop.wait()
+        service.drain()
         server.stop()
-        return 0
+        return stop.hard_exit_code
     finally:
+        if beat is not None:
+            beat.stop()
+        if heartbeat is not None:
+            heartbeat.stop()
         if registry is not None:
             registry.stop()
+        if args.router:
+            source.close()
 
 
 if __name__ == "__main__":

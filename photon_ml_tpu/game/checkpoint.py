@@ -1095,6 +1095,53 @@ class StreamingCheckpointManager:
                 )
         return None
 
+    def restore_row_range(self, lo: int, hi: int):
+        """Entity-code rows ``[lo, hi)`` of the newest valid checkpoint's
+        coefficient table, as an owned host array — the serving-fleet
+        member's restore: a member owning a contiguous code block
+        (``parallel.sharding.member_row_range``) reads EXACTLY its slice
+        off the mmap'd shard files, so a table no one host can hold still
+        loads member-by-member. Falls back past corrupt directories like
+        :meth:`restore`; returns None when no valid checkpoint exists.
+        Bounds are validated against the manifest's entity count —
+        a mis-sized fleet must fail loudly, never read a wrong slice."""
+        np = self._np
+        lo, hi = int(lo), int(hi)
+        with telemetry.span("checkpoint:restore", member_rows=hi - lo):
+            for _c, path in reversed(self._chunk_dirs()):
+                try:
+                    manifest = self._read_manifest(path)
+                    n = int(manifest["num_entities"])
+                    if not 0 <= lo <= hi <= n:
+                        raise CheckpointError(
+                            f"{path}: member row range [{lo}, {hi}) outside "
+                            f"the {n}-entity table"
+                        )
+                    read_coeffs = self._row_reader(
+                        path, manifest, "coefficients"
+                    )
+                except CheckpointError as e:
+                    if "member row range" in str(e):
+                        # a fleet-sizing error, not corruption: older
+                        # checkpoints of this fit would fail identically
+                        raise
+                    telemetry.counter("checkpoint.corrupt").inc()
+                    logger.warning(
+                        "skipping corrupt checkpoint %s: %s", path, e
+                    )
+                    continue
+                except (ValueError, OSError) as e:
+                    telemetry.counter("checkpoint.corrupt").inc()
+                    logger.warning(
+                        "skipping corrupt checkpoint %s: %s", path, e
+                    )
+                    continue
+                telemetry.counter("checkpoint.restores").inc()
+                # owned copy, never a view of the mmap (the restore()
+                # aliasing contract)
+                return np.array(read_coeffs(lo, hi), copy=True)
+        return None
+
     def _note_topology_delta(
         self, path, saved_sharding, saved_env, mesh, axis
     ) -> bool:

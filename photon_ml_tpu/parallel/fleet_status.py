@@ -104,6 +104,11 @@ class FleetStatusWriter:
             "rcs": {},
             "outcome": None,
             "telemetry_out": telemetry_out,
+            # supervisor-pushed per-member facts beyond liveness: a
+            # SERVING fleet's owned entity ranges, model version, and
+            # router's-eye requests/s land here (keyed by process id) and
+            # merge into each member's snapshot entry
+            "member_extras": {},
         }
 
     # -- supervisor push side ------------------------------------------------
@@ -158,6 +163,15 @@ class FleetStatusWriter:
                 )
                 if fields is not None:
                     entry["last_heartbeat"] = fields
+            extras = state.get("member_extras") or {}
+            extra = extras.get(pid, extras.get(str(pid)))
+            if extra:
+                entry.update(extra)
+                if extra.get("degraded"):
+                    # the router cannot reach this member: whatever the
+                    # heartbeat file says, its shard is NOT serving —
+                    # render it lost so an operator sees the shed
+                    entry["lost"] = True
             members[str(pid)] = entry
         doc: dict[str, Any] = {
             "type": "fleet_status",

@@ -143,6 +143,77 @@ def entity_axis_mismatch(
     )
 
 
+# ---------------------------------------------------------------------------
+# serving-fleet ownership: entity code -> owning member, pure math
+# ---------------------------------------------------------------------------
+
+#: An upper bound on how many valid fleet sizes get LISTED in the
+#: indivisible-fleet error (the sizes themselves are unbounded).
+_FLEET_SIZE_LISTING_CAP = 64
+
+
+def valid_fleet_sizes(num_entities: int) -> list[int]:
+    """Fleet sizes ``num_entities`` divides over — the serving analog of
+    :func:`valid_entity_axis_sizes`, deliberately NOT capped at the
+    device count: fleet members are processes (often hosts), and the
+    whole point of the fleet is holding a table no one device set can."""
+    n = int(num_entities)
+    return [
+        d for d in range(1, min(n, _FLEET_SIZE_LISTING_CAP) + 1)
+        if n % d == 0
+    ]
+
+
+def fleet_size_mismatch(
+    num_entities: int, num_members: int, what: str = "slice the serving fleet"
+) -> ElasticPlacementError:
+    """The indivisible-fleet error, formatted like
+    :func:`entity_axis_mismatch`: the operator picking a fleet size needs
+    the sizes that CAN hold the table, not a modulus."""
+    return ElasticPlacementError(
+        f"num_entities={num_entities} must divide over a "
+        f"{num_members}-member serving fleet to {what}; valid "
+        f"fleet sizes for this table: {valid_fleet_sizes(num_entities)}"
+    )
+
+
+def member_row_range(
+    num_entities: int, member: int, num_members: int
+) -> tuple[int, int]:
+    """The contiguous entity-code block ``[lo, hi)`` serving-fleet member
+    ``member`` of ``num_members`` owns — a pure function of the fleet
+    size alone (the ``plans_for_host`` discipline): every member and the
+    router compute the SAME ownership from ``(num_entities,
+    num_members)`` with no coordination, and a resize is just re-running
+    it at the new size. Contiguous blocks line up with the streamed
+    checkpoint's row ranges, so a member restore is one
+    ``read_rows(lo, hi)`` over the mmap'd shards."""
+    num_entities, num_members = int(num_entities), int(num_members)
+    if num_members < 1:
+        raise ValueError(f"num_members must be >= 1, got {num_members}")
+    if not 0 <= int(member) < num_members:
+        raise ValueError(
+            f"member {member} outside fleet of {num_members}"
+        )
+    if num_entities % num_members:
+        raise fleet_size_mismatch(num_entities, num_members)
+    per = num_entities // num_members
+    return int(member) * per, (int(member) + 1) * per
+
+
+def owner_of_row(num_entities: int, row: int, num_members: int) -> int:
+    """The member owning entity code ``row`` — the router-side inverse of
+    :func:`member_row_range` (same divisibility contract)."""
+    num_entities, num_members = int(num_entities), int(num_members)
+    if num_entities % num_members:
+        raise fleet_size_mismatch(num_entities, num_members)
+    if not 0 <= int(row) < num_entities:
+        raise ValueError(
+            f"entity code {row} outside table of {num_entities}"
+        )
+    return int(row) // (num_entities // num_members)
+
+
 def place_entity_rows(
     read_rows,
     num_entities: int,

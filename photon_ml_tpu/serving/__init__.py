@@ -30,6 +30,18 @@ package is the long-lived answer:
   connection (the sustained-load front end; pairs with
   :class:`ContinuousBatcher`, which admits rows into the next in-flight
   device bucket as capacity frees instead of waiting out a deadline).
+- :mod:`photon_ml_tpu.serving.shard` — shard-owning fleet members: each
+  serving process loads ONLY its deterministic contiguous slice of every
+  random-effect table (``slice_model_for_member`` /
+  ``load_member_engine``), so the fleet serves models whose entity tables
+  exceed any single host's HBM. :class:`ShardMemberSource` stages and
+  commits ``(fleet_size, version)``-keyed engines for live resize and
+  coordinated hot swap with a mixed-version window.
+- :mod:`photon_ml_tpu.serving.router` — :class:`FleetRouter` fans entity
+  lookups out to owning members, folds partial margins EXACTLY (the GAME
+  score is additive), and degrades to fixed-effect-only scores (counted
+  ``serving.degraded_scores``) when a member is unreachable — the fleet
+  sheds accuracy, never availability.
 - :mod:`photon_ml_tpu.serving.nearline` — :class:`NearlineUpdater`
   consumes (entity, features, label) feedback events and re-solves JUST
   those entities' random-effect coefficient rows online (warm-started
@@ -48,6 +60,7 @@ Wired to the CLI as ``python -m photon_ml_tpu.cli serve``.
 from photon_ml_tpu.serving.aio import AsyncScoringServer  # noqa: F401
 from photon_ml_tpu.serving.batcher import (  # noqa: F401
     ContinuousBatcher,
+    Draining,
     MicroBatcher,
     Overloaded,
 )
@@ -58,10 +71,25 @@ from photon_ml_tpu.serving.registry import (  # noqa: F401
     publish_version,
     scan_versions,
 )
+from photon_ml_tpu.serving.router import (  # noqa: F401
+    FleetRouter,
+    FleetUnavailable,
+    FleetView,
+    fleet_lookups_from_version_dir,
+    scan_announce,
+    write_announce,
+)
 from photon_ml_tpu.serving.server import (  # noqa: F401
     ScoringServer,
     ScoringService,
     serve_stdio,
+)
+from photon_ml_tpu.serving.shard import (  # noqa: F401
+    ShardBudgetError,
+    ShardMemberSource,
+    load_member_engine,
+    member_owned_ranges,
+    slice_model_for_member,
 )
 
 __all__ = [
@@ -70,6 +98,7 @@ __all__ = [
     "MicroBatcher",
     "ContinuousBatcher",
     "Overloaded",
+    "Draining",
     "ModelRegistry",
     "NearlineUpdater",
     "publish_version",
@@ -78,4 +107,15 @@ __all__ = [
     "ScoringServer",
     "AsyncScoringServer",
     "serve_stdio",
+    "FleetRouter",
+    "FleetUnavailable",
+    "FleetView",
+    "fleet_lookups_from_version_dir",
+    "scan_announce",
+    "write_announce",
+    "ShardBudgetError",
+    "ShardMemberSource",
+    "load_member_engine",
+    "member_owned_ranges",
+    "slice_model_for_member",
 ]

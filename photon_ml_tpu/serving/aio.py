@@ -41,9 +41,13 @@ import logging
 import threading
 from typing import Optional
 
-from photon_ml_tpu.serving.batcher import Overloaded
+from photon_ml_tpu.serving.batcher import Draining, Overloaded
 from photon_ml_tpu.serving.engine import BadRequest
-from photon_ml_tpu.serving.server import ScoringService, _json_scores
+from photon_ml_tpu.serving.server import (
+    DRAIN_RETRY_AFTER_S,
+    ScoringService,
+    _json_scores,
+)
 
 logger = logging.getLogger("photon_ml_tpu.serving.aio")
 
@@ -137,8 +141,8 @@ class AsyncScoringServer:
                 if request is None:
                     break
                 method, path, headers, body = request
-                code, obj = await self._route(method, path, body)
-                await self._reply(writer, code, obj)
+                code, obj, extra = await self._route(method, path, body)
+                await self._reply(writer, code, obj, extra)
                 if headers.get("connection", "").lower() == "close":
                     break
         except (
@@ -182,17 +186,27 @@ class AsyncScoringServer:
         return method, path, headers, body
 
     async def _reply(
-        self, writer: asyncio.StreamWriter, code: int, obj
+        self,
+        writer: asyncio.StreamWriter,
+        code: int,
+        obj,
+        extra_headers: Optional[dict] = None,
     ) -> None:
         body = json.dumps(obj, default=float).encode("utf-8")
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  503: "Service Unavailable", 504: "Gateway Timeout",
+                  409: "Conflict", 503: "Service Unavailable",
+                  504: "Gateway Timeout",
                   500: "Internal Server Error"}.get(code, "OK")
+        extras = "".join(
+            f"{name}: {value}\r\n"
+            for name, value in (extra_headers or {}).items()
+        )
         writer.write(
             (
                 f"HTTP/1.1 {code} {reason}\r\n"
                 "Content-Type: application/json\r\n"
                 f"Content-Length: {len(body)}\r\n"
+                f"{extras}"
                 "\r\n"
             ).encode("latin-1")
             + body
@@ -201,35 +215,71 @@ class AsyncScoringServer:
 
     # -- routing -------------------------------------------------------------
 
+    _POST_PATHS = (
+        "/v1/score",
+        "/v1/update",
+        "/v1/margins",
+        "/v1/admin/stage",
+        "/v1/admin/commit",
+    )
+
     async def _route(self, method: str, path: str, body: bytes):
+        """Returns ``(code, obj, extra_headers_or_None)``."""
         if method == "GET":
             # answered inline on the loop — NEVER behind the batcher, so
             # health/metrics stay responsive however loaded scoring is
             if path == "/healthz":
-                return 200, self.service.health()
+                return 200, self.service.health(), None
             if path == "/metricsz":
-                return 200, self.service.metrics()
-            return 404, {"error": f"unknown path {path}"}
-        if method != "POST" or path not in ("/v1/score", "/v1/update"):
-            return 404, {"error": f"unknown path {path}"}
+                return 200, self.service.metrics(), None
+            return 404, {"error": f"unknown path {path}"}, None
+        if method != "POST" or path not in self._POST_PATHS:
+            return 404, {"error": f"unknown path {path}"}, None
         try:
             payload = json.loads(body or b"{}")
         except ValueError:
             return 400, {"error": "bad_request",
-                         "detail": "body is not valid JSON"}
+                         "detail": "body is not valid JSON"}, None
+        loop = asyncio.get_running_loop()
         try:
             if path == "/v1/update":
-                return 200, self.service.update_request(payload)
-            return 200, await self._score(payload)
+                return 200, self.service.update_request(payload), None
+            if path == "/v1/margins":
+                # device work runs off-loop: the margin fold is a blocking
+                # engine call, and the loop must keep accepting traffic
+                result = await loop.run_in_executor(
+                    None, self.service.margin_request, payload
+                )
+                return 200, result, None
+            if path.startswith("/v1/admin/"):
+                op = path.rsplit("/", 1)[1]
+                # stage loads+warms a whole shard engine — seconds of
+                # blocking work that must not stall the event loop
+                result = await loop.run_in_executor(
+                    None, self.service.admin_request, op, payload
+                )
+                return 200, result, None
+            return 200, await self._score(payload), None
+        except Draining as e:
+            return (
+                503,
+                {"error": "draining", "detail": str(e)},
+                {"Retry-After": str(DRAIN_RETRY_AFTER_S)},
+            )
         except Overloaded as e:
-            return 503, {"error": "overloaded", "detail": str(e)}
+            return 503, {"error": "overloaded", "detail": str(e)}, None
         except BadRequest as e:
-            return 400, {"error": "bad_request", "detail": str(e)}
+            return 400, {"error": "bad_request", "detail": str(e)}, None
+        except KeyError as e:
+            # a version pin the member cannot honor (mid-swap window):
+            # the router sheds this member for the request, never blends
+            return 409, {"error": "version_unavailable",
+                         "detail": str(e)}, None
         except asyncio.TimeoutError:
-            return 504, {"error": "timeout"}
+            return 504, {"error": "timeout"}, None
         except Exception as e:  # noqa: BLE001 — a request must not kill the loop
             logger.exception("async score request failed")
-            return 500, {"error": "internal", "detail": str(e)}
+            return 500, {"error": "internal", "detail": str(e)}, None
 
     async def _score(self, payload) -> dict:
         """Submit to the shared batcher and await the wrapped future —

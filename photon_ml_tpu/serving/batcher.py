@@ -58,6 +58,13 @@ class Overloaded(RuntimeError):
     HTTP 503."""
 
 
+class Draining(RuntimeError):
+    """The server is draining (SIGTERM graceful stop): admission is
+    closed while in-flight batches finish. Servers map this to HTTP 503
+    WITH a ``Retry-After`` header — callers should re-resolve and retry
+    against a peer, the replacement process, or later."""
+
+
 class _Unit:
     __slots__ = ("rows", "future", "t_enqueue")
 

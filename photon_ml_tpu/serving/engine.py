@@ -81,28 +81,13 @@ def bucket_sizes_for(max_batch: int) -> tuple[int, ...]:
     return tuple(sizes)
 
 
-@functools.lru_cache(maxsize=32)  # bounded: a long-lived server swapping
-# structurally different versions must not accumulate executables forever
-def _compiled_score_fn(link: str, coords: tuple, eshard=None):
-    """One jitted score function per model STRUCTURE.
-
-    ``coords`` is a static spec per coordinate: ``("fixed", shard_idx)``
-    or ``("re", shard_idx, num_buckets)``. Table VALUES arrive as traced
-    arguments, so two model versions with the same structure (the common
-    hot-swap case: retrained coefficients, same entities/features) share
-    one executable and swap with ZERO recompiles. Batch size and table
-    shapes are read off the traced arguments — each padded bucket size is
-    its own trace inside the one jit cache.
-
-    ``eshard`` (a hashable ``NamedSharding``, or None for the replicated
-    single-device engine) pins every random-effect table's entity axis to
-    the serving mesh INSIDE the trace: without the constraint the
-    compiler is free to "helpfully" replicate a table that only fits
-    sharded. With it, the per-row coefficient gathers execute on the
-    shard that owns each entity's rows (GSPMD inserts the cross-shard
-    combine) and the request path stays free of host syncs — the L013
-    gate walks this function like any other.
-    """
+def _coordinate_terms(coords: tuple, eshard=None):
+    """The per-coordinate margin kernel shared by the score and margin
+    executables: a traceable ``terms(batch, shards, re_inputs, tables)``
+    yielding ``(kind, per-row segment sum)`` for every coordinate spec in
+    ``coords`` — ``kind`` is ``"fixed"``/``"re"`` so callers can gate the
+    fixed-effect contribution (the fleet-router protocol computes FE on
+    exactly one member per row)."""
     re_slots = {}
     for ci, spec in enumerate(coords):
         if spec[0] == "re":
@@ -114,9 +99,7 @@ def _compiled_score_fn(link: str, coords: tuple, eshard=None):
             return table
         return jax.lax.with_sharding_constraint(table, eshard)
 
-    def fn(offsets, shards, re_inputs, tables):
-        batch = offsets.shape[0]
-        total = jnp.zeros((batch,), jnp.float32)
+    def terms(batch, shards, re_inputs, tables):
         for ci, spec in enumerate(coords):
             values, rows, cols = shards[spec[1]]
             if spec[0] == "fixed":
@@ -163,9 +146,42 @@ def _compiled_score_fn(link: str, coords: tuple, eshard=None):
                     contrib = contrib + jnp.where(
                         bkt_n == b_idx, values * w_n, 0.0
                     )
-            total = total + jax.ops.segment_sum(
+            yield spec[0], jax.ops.segment_sum(
                 contrib, rows, num_segments=batch, indices_are_sorted=True
             )
+
+    return terms
+
+
+@functools.lru_cache(maxsize=32)  # bounded: a long-lived server swapping
+# structurally different versions must not accumulate executables forever
+def _compiled_score_fn(link: str, coords: tuple, eshard=None):
+    """One jitted score function per model STRUCTURE.
+
+    ``coords`` is a static spec per coordinate: ``("fixed", shard_idx)``
+    or ``("re", shard_idx, num_buckets)``. Table VALUES arrive as traced
+    arguments, so two model versions with the same structure (the common
+    hot-swap case: retrained coefficients, same entities/features) share
+    one executable and swap with ZERO recompiles. Batch size and table
+    shapes are read off the traced arguments — each padded bucket size is
+    its own trace inside the one jit cache.
+
+    ``eshard`` (a hashable ``NamedSharding``, or None for the replicated
+    single-device engine) pins every random-effect table's entity axis to
+    the serving mesh INSIDE the trace: without the constraint the
+    compiler is free to "helpfully" replicate a table that only fits
+    sharded. With it, the per-row coefficient gathers execute on the
+    shard that owns each entity's rows (GSPMD inserts the cross-shard
+    combine) and the request path stays free of host syncs — the L013
+    gate walks this function like any other.
+    """
+    terms = _coordinate_terms(coords, eshard)
+
+    def fn(offsets, shards, re_inputs, tables):
+        batch = offsets.shape[0]
+        total = jnp.zeros((batch,), jnp.float32)
+        for _kind, seg in terms(batch, shards, re_inputs, tables):
+            total = total + seg
         scores = total + offsets
         if link == "logistic":
             return jax.nn.sigmoid(scores)
@@ -180,6 +196,33 @@ def _compiled_score_fn(link: str, coords: tuple, eshard=None):
     # bucket must not read as a recompile storm
     return telemetry.instrumented_jit(
         fn, name="serving_score", multi_shape=True
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _compiled_margin_fn(coords: tuple, eshard=None):
+    """The fleet-member margin executable: RAW additive margins — no
+    link, no offset — with the fixed-effect contribution gated per row by
+    a traced 0/1 ``fe_gate`` vector.
+
+    This is the member half of exact fleet folding: the GAME score is a
+    SUM of per-coordinate margins, so members return partial sums, the
+    router adds them (plus the offset, once) and applies the link.
+    Gating FE per row (instead of per batch) keeps one executable per
+    bucket whichever member the router designates as a row's FE owner.
+    ``offsets`` is accepted for shape/assembly symmetry with the score
+    executable and deliberately NOT added."""
+    terms = _coordinate_terms(coords, eshard)
+
+    def fn(fe_gate, offsets, shards, re_inputs, tables):
+        batch = offsets.shape[0]
+        total = jnp.zeros((batch,), jnp.float32)
+        for kind, seg in terms(batch, shards, re_inputs, tables):
+            total = total + (fe_gate * seg if kind == "fixed" else seg)
+        return total
+
+    return telemetry.instrumented_jit(
+        fn, name="serving_margin", multi_shape=True
     )
 
 
@@ -466,6 +509,9 @@ class ScoringEngine:
                 )
         self._tables = tuple(uploaded)
         self._fn = _compiled_score_fn(self._link, self._coords, self._eshard)
+        # the fleet-member margin executable, built on first margin_rows
+        # (or warmup(margins=True)); single-process serving never pays
+        self._margin_fn = None
         # the VERSION LOCK: apply_re_rows builds + swaps the whole table
         # tuple under it, so concurrent nearline appliers serialize;
         # score_rows deliberately reads self._tables WITHOUT it (one
@@ -688,6 +734,13 @@ class ScoringEngine:
                     # from this coordinate, RandomEffectModel semantics)
                     telemetry.counter("serving.unseen_entities").inc()
                     continue
+                if entity_bucket[code] < 0:
+                    # entity the model KNOWS but whose rows live on
+                    # another fleet member (a shard-mode slice marks
+                    # non-owned codes bucket -1): contributes 0 here —
+                    # the router folds the owning member's margin in
+                    telemetry.counter("serving.not_owned_entities").inc()
+                    continue
                 bkt[i] = entity_bucket[code]
                 pos[i] = entity_pos[code]
             re_inputs.append((bkt, pos))
@@ -715,10 +768,55 @@ class ScoringEngine:
             parts.append(host[: len(chunk)])
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
-    def warmup(self) -> "ScoringEngine":
+    def margin_rows(
+        self,
+        rows: Sequence[Mapping],
+        include_fixed=None,
+    ) -> np.ndarray:
+        """RAW additive margins for ``rows`` — pre-link, offset EXCLUDED
+        — the fleet-member half of routed scoring. ``include_fixed`` is
+        None (fixed effects included for every row) or a per-row boolean
+        sequence: the router designates exactly one member per row as its
+        FE owner, so the fold stays lossless. Chunks like
+        :meth:`score_rows`."""
+        if not rows:
+            return np.zeros((0,), np.float32)
+        mask = None
+        if include_fixed is not None:
+            # include_fixed is the request's host-side python list,
+            # never a device array — no crossing here
+            mask = np.asarray(include_fixed, bool)  # photon: noqa[L010]
+            if mask.shape != (len(rows),):
+                raise BadRequest(
+                    f"include_fixed must have one boolean per row "
+                    f"({len(rows)}), got shape {tuple(mask.shape)}"
+                )
+        if self._margin_fn is None:
+            self._margin_fn = _compiled_margin_fn(self._coords, self._eshard)
+        parts = []
+        for lo in range(0, len(rows), self.max_batch):
+            chunk = rows[lo : lo + self.max_batch]
+            t0 = time.monotonic()
+            batch = self._bucket_for(len(chunk))
+            inputs = self._assemble(chunk, batch)
+            gate = np.ones((batch,), np.float32)
+            if mask is not None:
+                gate[: len(chunk)] = mask[lo : lo + len(chunk)]
+            margins = self._margin_fn(gate, *inputs, self._tables)
+            host = telemetry.sync_fetch(margins, label="serving.margins")
+            dt_ms = (time.monotonic() - t0) * 1000.0
+            telemetry.histogram("serving.device_ms").observe(dt_ms)
+            telemetry.counter("serving.margin_rows").inc(len(chunk))
+            parts.append(host[: len(chunk)])
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    def warmup(self, margins: bool = False) -> "ScoringEngine":
         """Execute every batch-size bucket once so all traces compile at
         load time — after this, steady-state serving never recompiles
-        (asserted via the flat ``jit_compiles`` counter in tests)."""
+        (asserted via the flat ``jit_compiles`` counter in tests).
+        ``margins=True`` (fleet members) additionally compiles the margin
+        executable for every bucket — the ``fe_gate`` vector is traced,
+        so one trace per bucket covers both FE-owner modes."""
         with telemetry.span(
             "serving:warmup", version=self.version,
             buckets=len(self.bucket_sizes),
@@ -731,6 +829,17 @@ class ScoringEngine:
                 rec = self._fn.record_for(*inputs, self._tables)
                 if rec is not None:
                     self._bucket_records[b] = rec
+                if margins:
+                    if self._margin_fn is None:
+                        self._margin_fn = _compiled_margin_fn(
+                            self._coords, self._eshard
+                        )
+                    telemetry.sync_fetch(
+                        self._margin_fn(
+                            np.ones((b,), np.float32), *inputs, self._tables
+                        ),
+                        label="serving.warmup",
+                    )
         self.warm = True
         return self
 

@@ -1,0 +1,519 @@
+"""The serving-fleet routing front end: split each request's entity
+lookups across shard-owning members, fold the partial margins exactly,
+degrade — never fail — on partial fleet loss.
+
+The GAME score is a SUM of per-coordinate margins, so routed scoring is
+lossless: every entity's rows live on exactly ONE member (contiguous
+code blocks, ``parallel.sharding.owner_of_row``), each owning member
+returns its partial margin, one designated member per row adds the
+fixed-effect margin (``include_fixed`` — FE vectors are replicated so
+ANY member can), and the router folds partials in f64, adds the offset
+once, and applies the link host-side. No jax on this path: the router
+is pure numpy + stdlib HTTP, so a routing tier needs no accelerator.
+
+Degraded mode: an unreachable member's entities fall back to
+fixed-effect-only — the established unseen-entity semantics — counted
+per affected row as ``serving.degraded_scores``. A row's FE margin
+retries on any alive member, so partial fleet loss sheds ACCURACY
+(bounded, observable) but never availability while one member lives.
+
+Fleet discovery is file-based: each member atomically writes
+``member-<i>.json`` into the announce directory when its slice is warm.
+The router adopts the highest ``epoch`` whose member set is COMPLETE
+(all of ``0..fleet_size-1`` ready) and swaps its ownership view
+atomically (``serving.resize_swap``) — a live resize is: new members
+announce at the next epoch, the view flips once, old members drain.
+Requests are pinned to the view's registry version, so a mid-swap
+member either serves the pinned version (staged or committed) or is
+treated as unavailable for that request — mixed-version windows can
+shed, never blend coefficients from two versions in one score.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu import faults, telemetry
+from photon_ml_tpu.parallel.sharding import owner_of_row
+from photon_ml_tpu.utils.atomic import atomic_write_json
+
+_FP_ROUTE_FANOUT = faults.register_point(
+    "serving.route_fanout",
+    distributed=True,
+    description=(
+        "one member's margin fan-out call from the router — io action = "
+        "the member unreachable for that batch (degraded, never failed)"
+    ),
+)
+_FP_RESIZE_SWAP = faults.register_point(
+    "serving.resize_swap",
+    distributed=True,
+    description=(
+        "the router's atomic ownership-map swap at a fleet resize / "
+        "epoch flip — a failed swap keeps the old map serving"
+    ),
+)
+
+#: link functions the router applies host-side after the fold — the
+#: numpy mirror of the engine's post-link (``get_loss(task).name``)
+_LINKS = {
+    "logistic": lambda s: 1.0 / (1.0 + np.exp(-s)),
+    "poisson": np.exp,
+}
+
+
+class FleetUnavailable(RuntimeError):
+    """No fleet member could serve any part of a request — total fleet
+    loss (or no complete epoch announced yet). Partial loss never raises
+    this; it degrades."""
+
+
+class _MemberUnavailable(RuntimeError):
+    """One member failed a fan-out call past its retry budget."""
+
+
+# ---------------------------------------------------------------------------
+# announce files: how members and router find each other
+# ---------------------------------------------------------------------------
+
+
+def announce_path(announce_dir: str, member: int) -> str:
+    return os.path.join(announce_dir, f"member-{int(member)}.json")
+
+
+def write_announce(announce_dir: str, payload: Mapping) -> str:
+    """Atomically publish one member's announce record (the member calls
+    this AFTER its slice is warm — announcing is the readiness barrier).
+    Required keys: member, fleet_size, epoch, url, version."""
+    os.makedirs(announce_dir, exist_ok=True)
+    path = announce_path(announce_dir, int(payload["member"]))
+    atomic_write_json(path, dict(payload), indent=2, sort_keys=True)
+    return path
+
+
+def scan_announce(announce_dir: str) -> list[dict]:
+    """Every parseable announce record in ``announce_dir`` — a member
+    killed mid-write leaves a torn file, which reads as absent."""
+    out = []
+    try:
+        names = os.listdir(announce_dir)
+    except FileNotFoundError:
+        return out
+    for name in sorted(names):
+        if not (name.startswith("member-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(announce_dir, name)) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and "member" in rec:
+            out.append(rec)
+    return out
+
+
+def fleet_lookups_from_version_dir(version_dir: str):
+    """``(task, link, {id_name: {value: code}})`` read numpy+json-only
+    from a published registry version — the router's share of the model:
+    entity vocabularies (for ownership) and the task link, no
+    coefficients."""
+    with open(os.path.join(version_dir, "model-metadata.json")) as fh:
+        meta = json.load(fh)
+    from photon_ml_tpu.ops.losses import get_loss
+
+    task = meta["task"]
+    link = get_loss(task).name
+    lookups: dict[str, dict] = {}
+    for name, spec in (meta.get("coordinates") or {}).items():
+        if spec.get("type") != "random_effect":
+            continue
+        with np.load(
+            os.path.join(version_dir, "random-effect", name, "model.npz")
+        ) as z:
+            vocab = z["vocab"]
+        id_name = spec["id_name"]
+        table = {str(v): i for i, v in enumerate(vocab.tolist())}
+        if id_name in lookups and lookups[id_name] != table:
+            raise ValueError(
+                f"coordinates disagree on the '{id_name}' vocabulary — "
+                "the router cannot derive one ownership map"
+            )
+        lookups[id_name] = table
+    return task, link, lookups
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetView:
+    """One immutable ownership snapshot: requests read whichever view
+    was current when they started — a resize swaps the reference, never
+    mutates a view in place."""
+
+    epoch: int
+    fleet_size: int
+    version: str
+    endpoints: tuple  # member index -> base url
+
+
+class FleetRouter:
+    """Engine-shaped fleet scorer: ``score_rows(rows)`` like
+    :class:`~photon_ml_tpu.serving.engine.ScoringEngine`, so the whole
+    existing front-end stack (service, batchers, HTTP/asyncio servers)
+    serves a fleet by swapping in a router where an engine went.
+
+    ``lookups`` maps ``id_name -> {entity value: training code}`` (the
+    ownership inputs; see :func:`fleet_lookups_from_version_dir`).
+    ``link`` is the post-fold link function name (engine parity)."""
+
+    def __init__(
+        self,
+        announce_dir: str,
+        lookups: Mapping[str, Mapping[str, int]],
+        task: str = "logistic",
+        link: Optional[str] = None,
+        member_timeout_s: float = 5.0,
+        retries: int = 1,
+        backoff_s: float = 0.05,
+        refresh_interval_s: float = 0.5,
+        cooldown_s: float = 1.0,
+        max_batch: int = 1024,
+    ):
+        self.announce_dir = announce_dir
+        self._lookups = {
+            name: dict(table) for name, table in dict(lookups).items()
+        }
+        self._num_entities = {
+            name: len(table) for name, table in self._lookups.items()
+        }
+        self.task = task
+        # the post-fold link defaults to the task name (the engine's
+        # get_loss(task).name for the canonical task spellings); unknown
+        # names fold to identity, matching the engine's else-branch
+        self._link = task if link is None else link
+        self.member_timeout_s = float(member_timeout_s)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.refresh_interval_s = float(refresh_interval_s)
+        self.cooldown_s = float(cooldown_s)
+        # engine-shaped surface (health/metrics/front ends)
+        self.max_batch = int(max_batch)
+        self.max_row_nnz = None
+        self.bucket_sizes = (int(max_batch),)
+        self.warm = True
+        self.entity_axis = None
+        self.nearline_seq = 0
+        self.lineage = None
+        self._view: Optional[FleetView] = None
+        self._view_lock = threading.Lock()
+        self._down_until: dict[int, float] = {}
+        self._next_refresh = 0.0
+        self._pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="fleet-router"
+        )
+
+    # -- fleet view ----------------------------------------------------------
+
+    @property
+    def version(self) -> str:
+        view = self._view
+        return view.version if view is not None else "fleet-unannounced"
+
+    @property
+    def view(self) -> Optional[FleetView]:
+        return self._view
+
+    def compile_summary(self) -> dict:
+        return {}
+
+    def refresh(self) -> Optional[FleetView]:
+        """Re-scan the announce directory; adopt the newest COMPLETE
+        epoch (atomic ownership swap through ``serving.resize_swap``
+        when the epoch/fleet size changes). Safe to call from any
+        thread; also called lazily from the request path on a cadence."""
+        records = scan_announce(self.announce_dir)
+        by_epoch: dict[tuple[int, int], dict[int, dict]] = {}
+        for rec in records:
+            try:
+                key = (int(rec.get("epoch", 0)), int(rec["fleet_size"]))
+                member = int(rec["member"])
+            except (TypeError, ValueError, KeyError):
+                continue
+            if rec.get("ready", True) and "url" in rec:
+                by_epoch.setdefault(key, {})[member] = rec
+        for (epoch, fleet_size), members in sorted(
+            by_epoch.items(), reverse=True
+        ):
+            if set(members) != set(range(fleet_size)):
+                continue  # incomplete epoch: keep serving the old view
+            version = members[0].get("version", "unversioned")
+            view = FleetView(
+                epoch=epoch,
+                fleet_size=fleet_size,
+                version=str(version),
+                endpoints=tuple(
+                    str(members[i]["url"]) for i in range(fleet_size)
+                ),
+            )
+            return self._adopt(view)
+        return self._view
+
+    def _adopt(self, view: FleetView) -> Optional[FleetView]:
+        with self._view_lock:
+            old = self._view
+            if old == view:
+                return old
+            if old is None or (old.epoch, old.fleet_size) != (
+                view.epoch, view.fleet_size,
+            ):
+                try:
+                    # the swap seam: an injected failure here must leave
+                    # the OLD ownership map serving untouched
+                    faults.fault_point(_FP_RESIZE_SWAP)
+                except (faults.InjectedFault, faults.InjectedIOError):
+                    telemetry.counter("serving.resize_swap_failures").inc()
+                    return old
+                telemetry.counter("serving.resize_swaps").inc()
+            self._view = view  # the atomic ownership swap
+            self._down_until.clear()
+            return view
+
+    def _current_view(self) -> FleetView:
+        now = time.monotonic()
+        if now >= self._next_refresh or self._view is None:
+            self._next_refresh = now + self.refresh_interval_s
+            self.refresh()
+        view = self._view
+        if view is None:
+            raise FleetUnavailable(
+                f"no complete serving-fleet epoch announced under "
+                f"{self.announce_dir}"
+            )
+        return view
+
+    def members_status(self) -> dict[int, dict]:
+        """Per-member router's-eye liveness for the status surface."""
+        view = self._view
+        if view is None:
+            return {}
+        now = time.monotonic()
+        return {
+            m: {
+                "url": view.endpoints[m],
+                "cooling_down": self._down_until.get(m, 0.0) > now,
+            }
+            for m in range(view.fleet_size)
+        }
+
+    # -- request path --------------------------------------------------------
+
+    def score_rows(self, rows: Sequence[Mapping]) -> np.ndarray:
+        """Mean predictions for ``rows`` — the
+        ``ScoringEngine.score_rows`` contract, served by the fleet."""
+        if not rows:
+            return np.zeros((0,), np.float32)
+        view = self._current_view()
+        n, fleet = len(rows), view.fleet_size
+        offsets = np.zeros((n,), np.float64)
+        # plan: row -> owning members (one per entity) + one FE owner
+        member_rows: dict[int, list[int]] = {}
+        member_fe: dict[int, list[bool]] = {}
+        fe_owner = np.empty((n,), np.int64)
+        for i, row in enumerate(rows):
+            try:
+                offsets[i] = float(row.get("offset") or 0.0)
+            except (TypeError, ValueError, AttributeError):
+                offsets[i] = 0.0  # the member rejects the malformed row
+            ids = row.get("ids") if isinstance(row, Mapping) else None
+            owners = set()
+            for id_name, table in self._lookups.items():
+                value = (ids or {}).get(id_name)
+                if value is None:
+                    continue
+                code = table.get(str(value))
+                if code is None:
+                    continue  # unseen entity: FE-only everywhere
+                owners.add(
+                    owner_of_row(self._num_entities[id_name], code, fleet)
+                )
+            fe_owner[i] = min(owners) if owners else i % fleet
+            for m in owners | {int(fe_owner[i])}:
+                member_rows.setdefault(m, []).append(i)
+                # plain bool: this list is json-serialized onto the wire
+                member_fe.setdefault(m, []).append(bool(m == fe_owner[i]))
+        futures = {
+            m: self._pool.submit(
+                self._call_member,
+                view,
+                m,
+                [self._sub_row(rows[i]) for i in idxs],
+                member_fe[m],
+            )
+            for m, idxs in member_rows.items()
+        }
+        totals = np.zeros((n,), np.float64)
+        degraded = np.zeros((n,), bool)
+        fe_orphans: list[int] = []
+        failed: set[int] = set()
+        for m, fut in futures.items():
+            idxs = member_rows[m]
+            try:
+                margins = fut.result()
+                totals[idxs] += np.asarray(margins, np.float64)
+            except _MemberUnavailable:
+                failed.add(m)
+                telemetry.counter("serving.member_failures").inc()
+                for i, had_fe in zip(idxs, member_fe[m]):
+                    if had_fe:
+                        fe_orphans.append(i)
+                    # only LOST ENTITY margins are accuracy shed — a
+                    # losslessly-retried FE designate is not degraded
+                    if self._row_had_entities(rows[i], m, fleet):
+                        degraded[i] = True
+        if fe_orphans:
+            totals[fe_orphans] += self._fe_fallback(
+                view, [rows[i] for i in fe_orphans], failed
+            )
+        shed = int(np.count_nonzero(degraded))
+        if shed:
+            telemetry.counter("serving.degraded_scores").inc(shed)
+        telemetry.counter("serving.routed_rows").inc(n)
+        scores = totals + offsets
+        link_fn = _LINKS.get(self._link)
+        if link_fn is not None:
+            scores = link_fn(scores)
+        return np.asarray(scores, np.float32)
+
+    @staticmethod
+    def _sub_row(row) -> dict:
+        """A member-bound copy of ``row``: the offset stays host-side
+        (added once, after the fold)."""
+        if not isinstance(row, Mapping):
+            return {"features": {}}
+        return {k: v for k, v in row.items() if k != "offset"}
+
+    def _row_had_entities(self, row, member: int, fleet: int) -> bool:
+        """Did ``member`` own any of ``row``'s entities (vs being only
+        its FE designate)? Distinguishes real accuracy shed from a
+        losslessly-retried FE margin."""
+        ids = row.get("ids") if isinstance(row, Mapping) else None
+        if not ids:
+            return False
+        for id_name, table in self._lookups.items():
+            value = ids.get(id_name)
+            if value is None:
+                continue
+            code = table.get(str(value))
+            if code is None:
+                continue
+            if owner_of_row(self._num_entities[id_name], code, fleet) == member:
+                return True
+        return False
+
+    def _fe_fallback(
+        self, view: FleetView, rows: Sequence[Mapping], failed: set
+    ) -> np.ndarray:
+        """Fixed-effect margins for rows whose FE designate died,
+        retried on any alive member (FE vectors are replicated; ids are
+        STRIPPED so no member double-counts entity margins it already
+        returned). Total fleet loss is the one unservable case."""
+        stripped = [
+            {k: v for k, v in self._sub_row(r).items() if k != "ids"}
+            for r in rows
+        ]
+        last_err: Optional[Exception] = None
+        for m in range(view.fleet_size):
+            if m in failed:
+                continue
+            try:
+                margins = self._call_member(
+                    view, m, stripped, [True] * len(stripped)
+                )
+                return np.asarray(margins, np.float64)
+            except _MemberUnavailable as e:
+                failed.add(m)
+                telemetry.counter("serving.member_failures").inc()
+                last_err = e
+        raise FleetUnavailable(
+            f"every member of fleet epoch {view.epoch} is unreachable"
+        ) from last_err
+
+    def _call_member(
+        self,
+        view: FleetView,
+        member: int,
+        sub_rows: list,
+        include_fixed: list,
+    ) -> list:
+        """One member's margin batch, with bounded retry/backoff and a
+        down-cooldown so a dead member costs one timeout per cooldown
+        window, not per request."""
+        now = time.monotonic()
+        if self._down_until.get(member, 0.0) > now:
+            raise _MemberUnavailable(f"member {member} cooling down")
+        try:
+            faults.fault_point(_FP_ROUTE_FANOUT)
+        except (faults.InjectedFault, faults.InjectedIOError) as e:
+            # the seam's contract: an injected fan-out failure IS a
+            # member unreachable for this batch — degraded, never failed
+            self._down_until[member] = time.monotonic() + self.cooldown_s
+            raise _MemberUnavailable(
+                f"member {member} fan-out fault: {e}"
+            ) from e
+        body = json.dumps({
+            "rows": sub_rows,
+            "include_fixed": include_fixed,
+            "fleet_size": view.fleet_size,
+            "version": view.version,
+        }).encode()
+        url = view.endpoints[member] + "/v1/margins"
+        last_err: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self.member_timeout_s
+                ) as resp:
+                    payload = json.loads(resp.read())
+                self._down_until.pop(member, None)
+                margins = payload["margins"]
+                if len(margins) != len(sub_rows):
+                    raise _MemberUnavailable(
+                        f"member {member} returned {len(margins)} margins "
+                        f"for {len(sub_rows)} rows"
+                    )
+                return margins
+            except urllib.error.HTTPError as e:
+                # 409: the member holds no engine for our pinned
+                # (fleet_size, version) — a mixed-swap window; shed this
+                # member for the request rather than blend versions
+                last_err = e
+                if e.code == 409:
+                    break
+            except (OSError, ValueError, KeyError) as e:
+                last_err = e
+            if attempt < self.retries:
+                time.sleep(self.backoff_s * (2 ** attempt))
+        self._down_until[member] = time.monotonic() + self.cooldown_s
+        raise _MemberUnavailable(
+            f"member {member} at {url}: {last_err}"
+        ) from last_err
+
+    def close(self):
+        self._pool.shutdown(wait=False)

@@ -30,10 +30,16 @@ from typing import Mapping, Optional
 from photon_ml_tpu import telemetry
 from photon_ml_tpu.serving.batcher import (
     ContinuousBatcher,
+    Draining,
     MicroBatcher,
     Overloaded,
 )
 from photon_ml_tpu.serving.engine import BadRequest, ScoringEngine
+
+#: the Retry-After hint (seconds) on draining 503s — long enough for a
+#: drain + relaunch, short enough that a router's next probe finds the
+#: replacement
+DRAIN_RETRY_AFTER_S = 2
 
 logger = logging.getLogger("photon_ml_tpu.serving.server")
 
@@ -81,6 +87,13 @@ class ScoringService:
     saturated scoring path must not take the health surface down with it
     (asserted by a responsiveness test)."""
 
+    # class-level defaults so hand-assembled instances (tests build
+    # wedged services via ``__new__`` to inject custom scorers) admit
+    # requests and skip the commit hook without tripping on attributes
+    # __init__ would have set
+    _draining = False
+    on_commit = None
+
     def __init__(
         self,
         source,
@@ -106,6 +119,10 @@ class ScoringService:
             queue_depth=queue_depth,
         )
         self._updater = None
+        self._draining = False
+        # fleet-member hook: called after a successful /v1/admin/commit
+        # with (key, payload) so the owner re-announces at the new size
+        self.on_commit = None
 
     def _score(self, rows):
         engine = _engine_of(self._source)
@@ -118,6 +135,23 @@ class ScoringService:
         return self
 
     def stop(self) -> None:
+        self._batcher.stop()
+        if self._updater is not None:
+            self._updater.stop()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """The graceful-stop half of the training ``GracefulStop``
+        contract, serving-side: close admission FIRST (new requests get
+        :class:`Draining` -> 503 + ``Retry-After``), then drain —
+        ``batcher.stop()`` joins the dispatcher only after every
+        already-admitted unit has been scored and delivered. Idempotent;
+        safe from a signal-handling thread."""
+        self._draining = True
+        telemetry.counter("serving.drains").inc()
         self._batcher.stop()
         if self._updater is not None:
             self._updater.stop()
@@ -135,6 +169,8 @@ class ScoringService:
     def update_request(self, payload: Mapping) -> dict:
         """Handle one ``/v1/update`` body: ``{"events": [...]}`` (see
         serving/nearline.py for the event schema)."""
+        if self._draining:
+            raise Draining("server is draining; retry elsewhere")
         if self._updater is None:
             raise BadRequest(
                 "nearline updates are not enabled on this server"
@@ -153,10 +189,84 @@ class ScoringService:
         """Validate one ``/v1/score`` body and enqueue it; the batcher
         Future (resolves to ``{"scores", "model_version"}``). Shared by
         the blocking (:meth:`score_request`) and asyncio front ends."""
+        if self._draining:
+            raise Draining("server is draining; retry elsewhere")
         rows = payload.get("rows") if isinstance(payload, Mapping) else None
         if not isinstance(rows, list):
             raise BadRequest('request body must be {"rows": [...]}')
         return self._batcher.submit(rows)
+
+    # -- fleet-member endpoints ----------------------------------------------
+
+    def margin_request(self, payload: Mapping) -> dict:
+        """One ``/v1/margins`` body — the router's fan-out unit:
+        ``{"rows": [...], "include_fixed": [bool, ...]?, "fleet_size":
+        N?, "version": "v-..."?}``. Scores DIRECTLY on the resolved
+        engine (router batches upstream; re-coalescing here would add a
+        deadline per member). Full-precision margins: the router's fold
+        is exact, so no wire rounding."""
+        if self._draining:
+            raise Draining("server is draining; retry elsewhere")
+        if not isinstance(payload, Mapping):
+            raise BadRequest('request body must be {"rows": [...]}')
+        rows = payload.get("rows")
+        if not isinstance(rows, list):
+            raise BadRequest('request body must be {"rows": [...]}')
+        engine = self._resolve_engine(payload)
+        include_fixed = payload.get("include_fixed")
+        if include_fixed is not None and not isinstance(include_fixed, list):
+            raise BadRequest("include_fixed must be a list of booleans")
+        telemetry.counter("serving.requests").inc()
+        margins = engine.margin_rows(rows, include_fixed)
+        return {
+            # host numpy from the engine's sync_fetch; float() is JSON
+            # shaping, not a device crossing
+            "margins": [float(m) for m in margins],
+            "model_version": engine.version,
+        }
+
+    def admin_request(self, op: str, payload: Mapping) -> dict:
+        """``/v1/admin/stage`` / ``/v1/admin/commit`` — the fleet
+        resize/hot-swap barrier on a shard member. Stage loads + warms a
+        ``(fleet_size, version)`` slice while the current one serves;
+        commit flips to a staged key (and re-announces via
+        ``on_commit``). Only meaningful when the source is a
+        :class:`~photon_ml_tpu.serving.shard.ShardMemberSource`."""
+        src = self._source
+        if not (hasattr(src, "stage") and hasattr(src, "commit")):
+            raise BadRequest(
+                "this server is not a shard-owning fleet member"
+            )
+        if not isinstance(payload, Mapping):
+            raise BadRequest("admin body must be a JSON object")
+        try:
+            fleet_size = int(payload["fleet_size"])
+        except (KeyError, TypeError, ValueError):
+            raise BadRequest(
+                'admin body must carry an integer "fleet_size"'
+            ) from None
+        if op == "stage":
+            key = src.stage(fleet_size, payload.get("version"))
+            return {"staged": {"fleet_size": key[0], "version": key[1]}}
+        version = payload.get("version")
+        if not version:
+            raise BadRequest('commit requires an explicit "version"')
+        key = src.commit(fleet_size, str(version))
+        if self.on_commit is not None:
+            self.on_commit(key, payload)
+        return {"committed": {"fleet_size": key[0], "version": key[1]}}
+
+    def _resolve_engine(self, payload: Mapping):
+        """The engine a margin request is pinned to: a shard member
+        resolves ``(fleet_size, version)`` through its staged set
+        (KeyError -> HTTP 409, the mixed-swap-window signal); everything
+        else serves its current engine."""
+        src = self._source
+        if hasattr(src, "resolve"):
+            return src.resolve(
+                payload.get("fleet_size"), payload.get("version")
+            )
+        return _engine_of(src)
 
     def score_request(self, payload: Mapping) -> dict:
         future = self.submit_rows(payload)
@@ -181,7 +291,7 @@ class ScoringService:
             return {"status": "loading", "model_version": None,
                     "warm": False, "detail": str(e)}
         state = {
-            "status": "serving",
+            "status": "draining" if self._draining else "serving",
             "model_version": engine.version,
             "warm": engine.warm,
             "buckets": list(engine.bucket_sizes),
@@ -211,11 +321,13 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet: requests go to telemetry
         logger.debug(fmt, *args)
 
-    def _reply(self, code: int, obj) -> None:
+    def _reply(self, code: int, obj, headers: Optional[dict] = None) -> None:
         body = json.dumps(obj, default=float).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -228,9 +340,14 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
+    _POST_PATHS = (
+        "/v1/score", "/v1/update", "/v1/margins",
+        "/v1/admin/stage", "/v1/admin/commit",
+    )
+
     def do_POST(self):  # noqa: N802
         service: ScoringService = self.server.service  # type: ignore[attr-defined]
-        if self.path not in ("/v1/score", "/v1/update"):
+        if self.path not in self._POST_PATHS:
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
         try:
@@ -243,12 +360,28 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/v1/update":
                 self._reply(200, service.update_request(payload))
+            elif self.path == "/v1/margins":
+                self._reply(200, service.margin_request(payload))
+            elif self.path.startswith("/v1/admin/"):
+                op = self.path.rsplit("/", 1)[1]
+                self._reply(200, service.admin_request(op, payload))
             else:
                 self._reply(200, service.score_request(payload))
+        except Draining as e:
+            self._reply(
+                503, {"error": "draining", "detail": str(e)},
+                headers={"Retry-After": str(DRAIN_RETRY_AFTER_S)},
+            )
         except Overloaded as e:
             self._reply(503, {"error": "overloaded", "detail": str(e)})
         except BadRequest as e:
             self._reply(400, {"error": "bad_request", "detail": str(e)})
+        except KeyError as e:
+            # a margin request pinned to a (fleet_size, version) this
+            # member does not hold — the mixed-swap window; the router
+            # sheds this member for the request instead of blending
+            self._reply(409, {"error": "version_unavailable",
+                              "detail": str(e)})
         except FutureTimeout:
             self._reply(504, {"error": "timeout"})
         except Exception as e:  # noqa: BLE001 — a request must not kill the server

@@ -1,0 +1,422 @@
+"""Shard-owning serving members: slice a GAME model to one fleet
+member's deterministic entity block and serve it from a per-member
+engine (ROADMAP item 3 — the serving leg of the fleet story).
+
+Ownership is pure math (``parallel.sharding.member_row_range``): member
+``i`` of ``N`` owns the contiguous entity-code block ``[i*E/N,
+(i+1)*E/N)`` of every random-effect coordinate, a function of the fleet
+size alone — every member and the router derive the SAME map with no
+coordination, and a resize is just re-deriving it at the new size.
+Fixed-effect vectors are replicated (they are small and every member
+must be able to serve the FE-only degraded fallback).
+
+The sliced model keeps the FULL vocab and marks non-owned codes with
+bucket ``-1`` in the host placement arrays, so a non-owned entity
+contributes exactly 0 on this member (``serving.not_owned_entities``)
+— the router's fold over owning members is lossless because the GAME
+score is additive and every entity's rows exist on exactly one member.
+
+:class:`ShardMemberSource` is the member's engine source: engines are
+keyed by ``(fleet_size, version)`` and swapped through an explicit
+stage/commit barrier, so a live resize (or fleet-wide hot swap) keeps
+the old slice serving until the router flips — the member tolerates the
+mixed-version window by resolving requests pinned to either side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Mapping, Optional
+
+import numpy as np
+
+from photon_ml_tpu import faults, telemetry
+from photon_ml_tpu.game.models import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.parallel import sharding as psharding
+from photon_ml_tpu.serving.engine import ScoringEngine
+
+_FP_MEMBER_LOAD = faults.register_point(
+    "serving.member_load",
+    distributed=True,
+    description=(
+        "a fleet member loading (or re-loading after relaunch/resize) "
+        "its entity slice — io action = transient shard read"
+    ),
+)
+
+
+class ShardBudgetError(RuntimeError):
+    """A member's entity slice does not fit its configured HBM budget —
+    a fleet-sizing error (grow the fleet), distinct from model
+    corruption."""
+
+
+def serving_table_bytes(model: GameModel) -> int:
+    """Predicted HBM residency of ``model`` served: FE vectors plus
+    coefficient + int32 projection per RE bucket (the engine's own
+    upload prediction, reusable before any engine exists)."""
+    total = 0
+    for sub in model.models.values():
+        if isinstance(sub, FixedEffectModel):
+            total += telemetry.memory.estimate_table_bytes(
+                1, np.asarray(sub.coefficients).shape[0]
+            )
+        elif isinstance(sub, RandomEffectModel):
+            for bm in sub.buckets:
+                num_e, local_k = np.asarray(bm.coefficients).shape
+                total += 2 * telemetry.memory.estimate_table_bytes(
+                    num_e, local_k
+                )
+    return total
+
+
+def slice_model_for_member(
+    model: GameModel, member: int, num_members: int
+) -> GameModel:
+    """``model`` with every random-effect table cut down to member
+    ``member``'s owned entity-code block.
+
+    Per coordinate: owned codes keep their bucket rows (re-packed dense,
+    positions renumbered); every other code gets bucket ``-1`` so it
+    scores 0 here. Buckets left empty by the cut are dropped (their
+    indices renumber with the placement arrays). The vocab stays FULL —
+    a non-owned id must resolve to a known code (and count
+    ``serving.not_owned_entities``), never masquerade as unseen.
+    Indivisible coordinates raise the valid-fleet-sizes listing."""
+    out = model
+    for name, sub in model.models.items():
+        if not isinstance(sub, RandomEffectModel):
+            continue
+        num_entities = int(len(sub.vocab))
+        try:
+            lo, hi = psharding.member_row_range(
+                num_entities, member, num_members
+            )
+        except psharding.ElasticPlacementError:
+            raise psharding.fleet_size_mismatch(
+                num_entities, num_members,
+                what=f"slice coordinate '{name}' across the serving fleet",
+            ) from None
+        entity_bucket = np.asarray(sub.entity_bucket)
+        entity_pos = np.asarray(sub.entity_pos)
+        new_bucket = np.full(num_entities, -1, np.int32)
+        new_pos = np.full(num_entities, -1, np.int32)
+        owned = np.zeros(num_entities, bool)
+        owned[lo:hi] = True
+        new_buckets = []
+        for b, bm in enumerate(sub.buckets):
+            codes = np.nonzero(owned & (entity_bucket == b))[0]
+            if not len(codes):
+                continue  # bucket entirely elsewhere; indices renumber
+            rows_sel = entity_pos[codes]
+            b_new = len(new_buckets)
+            new_bucket[codes] = b_new
+            new_pos[codes] = np.arange(len(codes), dtype=np.int32)
+            new_buckets.append(
+                dataclasses.replace(
+                    bm,
+                    coefficients=np.asarray(bm.coefficients)[rows_sel],
+                    projection=np.asarray(bm.projection)[rows_sel],
+                    entity_codes=np.asarray(codes, np.int32),
+                    variances=(
+                        None if bm.variances is None
+                        else np.asarray(bm.variances)[rows_sel]
+                    ),
+                )
+            )
+        out = out.with_model(
+            name,
+            dataclasses.replace(
+                sub,
+                buckets=tuple(new_buckets),
+                entity_bucket=new_bucket,
+                entity_pos=new_pos,
+            ),
+        )
+    return out
+
+
+def member_owned_ranges(
+    model: GameModel, member: int, num_members: int
+) -> dict[str, tuple[int, int]]:
+    """``{id_name: [lo, hi)}`` for the fleet-status surface — the code
+    block this member serves per random-effect coordinate."""
+    out = {}
+    for sub in model.models.values():
+        if isinstance(sub, RandomEffectModel):
+            out[sub.id_name] = psharding.member_row_range(
+                int(len(sub.vocab)), member, num_members
+            )
+    return out
+
+
+def _restore_member_rows(
+    sub: RandomEffectModel,
+    sliced: RandomEffectModel,
+    coord: str,
+    ckpt_dir: str,
+    lo: int,
+    hi: int,
+):
+    """Replace the SLICED single-bucket coordinate's coefficients with
+    rows ``[lo, hi)`` restored straight off the streamed checkpoint's
+    mmap'd shard files (``restore_row_range``) — the member-shard
+    complement of ``restore_placed``: no member ever materializes more
+    than its own slice. Requires the coordinate's bucket positions to be
+    contiguous over the owned block (the streamed-training layout);
+    anything else must fail loudly, never read a wrong slice."""
+    from photon_ml_tpu.data.model_store import ModelLoadError
+    from photon_ml_tpu.game.checkpoint import StreamingCheckpointManager
+
+    if len(sub.buckets) != 1:
+        raise ModelLoadError(
+            ckpt_dir,
+            f"coordinate '{coord}' has {len(sub.buckets)} geometry "
+            "buckets; streamed checkpoints hold ONE dense [E, K] table, "
+            "so only single-bucket coordinates restore from one",
+        )
+    pos = np.asarray(sub.entity_pos)[lo:hi]
+    if len(pos) and not np.array_equal(
+        pos, np.arange(pos[0], pos[0] + len(pos))
+    ):
+        raise ModelLoadError(
+            ckpt_dir,
+            f"coordinate '{coord}' bucket positions are not contiguous "
+            f"over entity block [{lo}, {hi}) — a member cannot restore "
+            "it as one checkpoint row range",
+        )
+    manager = StreamingCheckpointManager.open_for_restore(ckpt_dir)
+    rows = manager.restore_row_range(int(pos[0]), int(pos[0]) + len(pos))
+    if rows is None:
+        raise ModelLoadError(
+            ckpt_dir,
+            "no certified streamed checkpoint to restore the member "
+            f"slice of coordinate '{coord}' from",
+        )
+    bm = sliced.buckets[0]
+    want = tuple(int(d) for d in np.asarray(bm.coefficients).shape)
+    got = tuple(int(d) for d in rows.shape)
+    if got != want:
+        raise ModelLoadError(
+            ckpt_dir,
+            f"checkpoint member rows shape {got} does not match "
+            f"coordinate '{coord}' slice shape {want}",
+        )
+    return dataclasses.replace(
+        sliced, buckets=(dataclasses.replace(bm, coefficients=rows),)
+    )
+
+
+def load_member_engine(
+    model_dir: str,
+    member: int,
+    fleet_size: int,
+    max_batch: int = 64,
+    max_row_nnz: int = 128,
+    version: Optional[str] = None,
+    hbm_budget_bytes: Optional[int] = None,
+    re_checkpoints: Optional[Mapping[str, str]] = None,
+    warm: bool = True,
+) -> ScoringEngine:
+    """Build (and by default warm, margins included) the
+    :class:`ScoringEngine` serving member ``member``'s slice of the
+    model in ``model_dir``.
+
+    ``hbm_budget_bytes`` enforces the whole point of the fleet: the
+    member's SLICE must fit the budget (:class:`ShardBudgetError`
+    otherwise, naming the fleet sizes that would) even when the full
+    model could not. ``re_checkpoints`` (coordinate -> streamed
+    checkpoint dir) restores that coordinate's slice straight off the
+    checkpoint's shard files — only the owned row range is ever read."""
+    import os
+
+    from photon_ml_tpu.data.model_store import (
+        ModelLoadError,
+        load_feature_index_maps,
+        load_game_model,
+        load_game_model_metadata,
+    )
+
+    faults.fault_point(_FP_MEMBER_LOAD)
+    with telemetry.span(
+        "serving:member_load", member=member, fleet_size=fleet_size
+    ):
+        index_maps = load_feature_index_maps(model_dir)
+        if index_maps is None:
+            raise ModelLoadError(
+                os.path.join(model_dir, "feature-indexes"),
+                "missing feature-indexes/ — a fleet member cannot pin the "
+                "serving feature space, so scores would be silently wrong",
+            )
+        model = load_game_model(model_dir)
+        sliced = slice_model_for_member(model, member, fleet_size)
+        for coord, ckpt_dir in (re_checkpoints or {}).items():
+            sub = model.models.get(coord)
+            cut = sliced.models.get(coord)
+            if not isinstance(sub, RandomEffectModel):
+                raise ModelLoadError(
+                    ckpt_dir,
+                    f"re_checkpoints names coordinate '{coord}', which is "
+                    "not a random-effect coordinate of the model "
+                    f"(has: {sorted(model.models)})",
+                )
+            lo, hi = psharding.member_row_range(
+                int(len(sub.vocab)), member, fleet_size
+            )
+            sliced = sliced.with_model(
+                coord,
+                _restore_member_rows(sub, cut, coord, ckpt_dir, lo, hi),
+            )
+        slice_bytes = serving_table_bytes(sliced)
+        if hbm_budget_bytes is not None and slice_bytes > hbm_budget_bytes:
+            raise ShardBudgetError(
+                f"member {member}/{fleet_size} slice needs {slice_bytes} "
+                f"bytes, over the {int(hbm_budget_bytes)}-byte HBM budget "
+                f"(full model: {serving_table_bytes(model)} bytes) — grow "
+                "the fleet"
+            )
+        try:
+            lineage = (
+                load_game_model_metadata(model_dir).get("extra") or {}
+            ).get("lineage")
+        except (OSError, ValueError):
+            lineage = None
+        engine = ScoringEngine(
+            sliced,
+            index_maps=index_maps,
+            max_batch=max_batch,
+            max_row_nnz=max_row_nnz,
+            version=version
+            or os.path.basename(os.path.normpath(model_dir)),
+        )
+        telemetry.gauge("serving.member_slice_bytes").set(slice_bytes)
+        if warm:
+            engine.warmup(margins=True)
+        return engine
+
+
+class ShardMemberSource:
+    """One fleet member's engine source: ``(fleet_size, version)``-keyed
+    engines behind a stage/commit barrier.
+
+    ``stage`` loads and warms a new slice WHILE the current one serves
+    (resize: the same registry version re-sliced at the new fleet size;
+    hot swap: a new version at the current size). ``commit`` flips the
+    current pointer and keeps exactly one previous engine — the
+    mixed-version window the router pins requests through — evicting
+    anything older. ``resolve`` serves a request pinned to either side
+    of the barrier; an unknown pin raises ``KeyError`` (the front end
+    maps it to a client error and the router retries or degrades).
+
+    The loader is ``loader(fleet_size, version) -> warmed engine``
+    (``version=None`` means the registry's newest)."""
+
+    def __init__(
+        self,
+        loader: Callable[[int, Optional[str]], ScoringEngine],
+        member: int,
+        fleet_size: int,
+    ):
+        self._loader = loader
+        self.member = int(member)
+        self.initial_fleet_size = int(fleet_size)
+        self._lock = threading.RLock()
+        self._engines: dict[tuple[int, str], ScoringEngine] = {}
+        self._current: Optional[tuple[int, str]] = None
+        self._previous: Optional[tuple[int, str]] = None
+
+    @property
+    def engine(self) -> ScoringEngine:
+        with self._lock:
+            if self._current is None:
+                raise RuntimeError(
+                    f"member {self.member}: no committed shard engine"
+                )
+            return self._engines[self._current]
+
+    @property
+    def fleet_size(self) -> int:
+        with self._lock:
+            if self._current is None:
+                return self.initial_fleet_size
+            return self._current[0]
+
+    def staged_keys(self) -> list[tuple[int, str]]:
+        with self._lock:
+            return sorted(self._engines)
+
+    def stage(
+        self, fleet_size: int, version: Optional[str] = None
+    ) -> tuple[int, str]:
+        """Load + warm the ``(fleet_size, version)`` slice without
+        touching what currently serves; idempotent per key."""
+        fleet_size = int(fleet_size)
+        with self._lock:
+            if version is not None:
+                key = (fleet_size, str(version))
+                if key in self._engines:
+                    return key
+        engine = self._loader(fleet_size, version)
+        key = (fleet_size, engine.version)
+        with self._lock:
+            self._engines.setdefault(key, engine)
+        return key
+
+    def commit(self, fleet_size: int, version: str) -> tuple[int, str]:
+        """Flip the current pointer to a STAGED key; the previous
+        current stays resolvable (one mixed-window slot), everything
+        older is evicted."""
+        key = (int(fleet_size), str(version))
+        with self._lock:
+            if key not in self._engines:
+                raise KeyError(
+                    f"member {self.member}: commit of unstaged "
+                    f"{key}; staged: {sorted(self._engines)}"
+                )
+            if key != self._current:
+                self._previous, self._current = self._current, key
+            keep = {k for k in (self._current, self._previous) if k}
+            for k in list(self._engines):
+                if k not in keep:
+                    del self._engines[k]
+        return key
+
+    def resolve(
+        self,
+        fleet_size: Optional[int] = None,
+        version: Optional[str] = None,
+    ) -> ScoringEngine:
+        """The engine a request pinned to ``(fleet_size, version)``
+        scores on; ``None`` pins default to the current engine's."""
+        with self._lock:
+            if self._current is None:
+                raise RuntimeError(
+                    f"member {self.member}: no committed shard engine"
+                )
+            if fleet_size is None:
+                fleet_size = self._current[0]
+            fleet_size = int(fleet_size)
+            if version is not None:
+                engine = self._engines.get((fleet_size, str(version)))
+                if engine is None:
+                    raise KeyError(
+                        f"member {self.member} holds no engine for "
+                        f"fleet_size={fleet_size} version={version!r}; "
+                        f"staged: {sorted(self._engines)}"
+                    )
+                return engine
+            for key in (self._current, self._previous):
+                if key is not None and key[0] == fleet_size:
+                    return self._engines[key]
+            for key in sorted(self._engines):
+                if key[0] == fleet_size:
+                    return self._engines[key]
+            raise KeyError(
+                f"member {self.member} holds no engine for "
+                f"fleet_size={fleet_size}; staged: {sorted(self._engines)}"
+            )

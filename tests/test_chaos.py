@@ -450,12 +450,20 @@ def test_distributed_points_enumeration_is_stable():
     import photon_ml_tpu.game.checkpoint  # noqa: F401
     import photon_ml_tpu.parallel.distributed  # noqa: F401
     import photon_ml_tpu.parallel.multihost  # noqa: F401
+    import photon_ml_tpu.serving.router  # noqa: F401
+    import photon_ml_tpu.serving.shard  # noqa: F401
 
     assert faults.distributed_points() == [
         "checkpoint.peer_manifest",
         "fleet.heartbeat",
         "multihost.init",
         "parallel.collective.entry",
+        # serving-fleet seams: registered distributed, matrixed by
+        # tools/chaos.py --serving-fleet (they fire in serving
+        # processes, never in a training fleet worker)
+        "serving.member_load",
+        "serving.resize_swap",
+        "serving.route_fanout",
     ]
 
 

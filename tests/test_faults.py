@@ -61,6 +61,11 @@ EXPECTED_POINTS = {
     "fleet.heartbeat",
     "checkpoint.peer_manifest",
     "parallel.collective.entry",
+    # serving-fleet seams (distributed, but they fire in router/member
+    # processes — tools/chaos.py --serving-fleet owns their matrix)
+    "serving.member_load",
+    "serving.route_fanout",
+    "serving.resize_swap",
     # fleet observability (supervisor-side: neither matrix — status is
     # observability, never control; covered by tests/test_fleet_status)
     "fleet.status_write",
@@ -81,13 +86,18 @@ WRITE_PATH_POINTS = [
     "checkpoint.save.before_tmp",
 ]
 
-#: the multi-process seams — tools/chaos.py --fleet enumerates exactly
-#: this set (sorted), one 2-process kill-one-member row per seam
+#: the multi-process seams (sorted). tools/chaos.py --fleet runs the
+#: training-fleet subset (one 2-process kill-one-member row per seam);
+#: the serving.* entries fire in serving router/member processes and are
+#: exercised by tools/chaos.py --serving-fleet instead
 DISTRIBUTED_POINTS = [
     "checkpoint.peer_manifest",
     "fleet.heartbeat",
     "multihost.init",
     "parallel.collective.entry",
+    "serving.member_load",
+    "serving.resize_swap",
+    "serving.route_fanout",
 ]
 
 
@@ -102,6 +112,8 @@ def test_registry_catalog_is_complete_and_stable():
     import photon_ml_tpu.serving.batcher  # noqa: F401
     import photon_ml_tpu.serving.nearline  # noqa: F401
     import photon_ml_tpu.serving.registry  # noqa: F401
+    import photon_ml_tpu.serving.router  # noqa: F401
+    import photon_ml_tpu.serving.shard  # noqa: F401
     import photon_ml_tpu.parallel.distributed  # noqa: F401
     import photon_ml_tpu.parallel.fleet_status  # noqa: F401
     import photon_ml_tpu.parallel.multihost  # noqa: F401

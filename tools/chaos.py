@@ -272,7 +272,13 @@ def run_fleet_matrix(
     import photon_ml_tpu.parallel.multihost  # noqa: F401
     from tools import fleet
 
-    all_points = faults.distributed_points()
+    # serving.* distributed seams belong to the SERVING fleet matrix
+    # (run_serving_matrix): they fire in router/member processes, not in
+    # a training fleet worker — arming one here could never fire
+    all_points = [
+        p for p in faults.distributed_points()
+        if not p.startswith("serving.")
+    ]
     points = list(points) if points is not None else all_points
     unknown = sorted(set(points) - set(all_points))
     if unknown:
@@ -378,6 +384,353 @@ def run_fleet_matrix(
 
 
 # ---------------------------------------------------------------------------
+# the SERVING crash matrix (shard-owning fleet rows, via tools/fleet.py)
+# ---------------------------------------------------------------------------
+
+#: the serving rows, cheapest-first so a tight tier-1 budget still lands
+#: the in-process seam proofs before the subprocess hard-kill row
+SERVING_ROWS = (
+    "member_load_io",
+    "route_fanout_io",
+    "resize_swap",
+    "member_hard_kill",
+)
+
+#: hard-kill recovery budget: heartbeat-staleness detection plus a full
+#: same-slot member relaunch (fresh interpreter + jax import + slice
+#: load + warm) on a loaded CI host
+KILL_RECOVERY_BUDGET_S = 120.0
+
+
+def _mini_member(version_dir: str, announce_dir: str, member: int,
+                 fleet_size: int, epoch: int = 0):
+    """One IN-PROCESS shard member: engine slice behind a
+    :class:`ShardMemberSource`, a :class:`ScoringServer` on an ephemeral
+    port, and its announce record. Returns (server, source)."""
+    from photon_ml_tpu.serving import (
+        ScoringServer,
+        ScoringService,
+        ShardMemberSource,
+        load_member_engine,
+        write_announce,
+    )
+
+    def loader(fs, version=None):
+        return load_member_engine(version_dir, member, fs, max_batch=16)
+
+    source = ShardMemberSource(loader, member=member, fleet_size=fleet_size)
+    source.commit(*source.stage(fleet_size))
+    server = ScoringServer(ScoringService(source, max_batch=16), port=0)
+    server.start()
+    write_announce(announce_dir, {
+        "member": member, "fleet_size": fleet_size, "epoch": epoch,
+        "url": f"http://127.0.0.1:{server.port}",
+        "version": source.engine.version, "ready": True,
+        "pid": os.getpid(), "owned": {},
+    })
+    return server, source
+
+
+def _serving_rows(n_entities: int) -> list[dict]:
+    """Deterministic scoring rows covering every entity (so every member
+    owns part of every batch)."""
+    return [
+        {
+            "features": {
+                "global": [[0, 0.5], [1, -0.25]],
+                "user": [[0, 1.0], [1, 0.5]],
+            },
+            "ids": {"userId": str(i)},
+        }
+        for i in range(n_entities)
+    ]
+
+
+def run_serving_matrix(
+    workdir: str,
+    rows: Optional[Sequence[str]] = None,
+    budget_s: Optional[float] = None,
+    traffic_seconds: float = 8.0,
+) -> dict:
+    """The serving-fleet chaos matrix: every ``serving.*`` distributed
+    seam plus the real hard-kill-under-traffic row.
+
+    - ``member_load_io``: an injected IO failure in the slice load
+      surfaces as ``OSError`` (a supervisor relaunch retries); the
+      unarmed retry loads and serves.
+    - ``route_fanout_io``: an injected fan-out failure degrades exactly
+      that member's entity margins to fixed-effect-only — the request
+      SUCCEEDS, ``serving.degraded_scores`` counts the shed, and the
+      next request (seam exhausted, cooldown expired) is back to exact
+      single-engine parity.
+    - ``resize_swap``: an injected ownership-swap failure leaves the OLD
+      fleet view serving untouched (counted
+      ``serving.resize_swap_failures``); the unarmed refresh adopts the
+      new epoch and parity holds across the swap.
+    - ``member_hard_kill``: a real 3-process ``cli serve`` fleet under
+      sustained router traffic, one member SIGKILLed mid-stream — zero
+      non-shed request failures, degraded scores bounded and accounted,
+      heartbeat detection + same-slot relaunch within the recovery
+      budget, and every surviving member drains to exit 75.
+
+    Budget-aware like :func:`run_matrix`: rows beyond ``budget_s`` are
+    reported ``skipped``, never silently dropped.
+    """
+    import numpy as np
+
+    from photon_ml_tpu import faults, telemetry
+    from tools import fleet
+
+    known = list(SERVING_ROWS)
+    rows = list(rows) if rows is not None else known
+    unknown = sorted(set(rows) - set(known))
+    if unknown:
+        raise ValueError(
+            f"not serving chaos rows: {unknown} (known: {known})"
+        )
+    t0 = time.monotonic()
+    report: dict = {
+        "workdir": workdir,
+        "rows": rows,
+        "results": {},
+        "skipped": [],
+        "ok": True,
+    }
+    os.makedirs(workdir, exist_ok=True)
+    n_entities = 12
+    version_dir = fleet.make_serving_model(
+        os.path.join(workdir, "registry"), n_entities=n_entities
+    )
+
+    def _fail(entry: dict, problems: list) -> None:
+        if problems:
+            entry["error"] = "; ".join(problems)
+            report["ok"] = False
+        entry["passed"] = not problems
+
+    for row in rows:
+        if budget_s is not None and time.monotonic() - t0 > budget_s:
+            report["skipped"] = [
+                r for r in rows if r not in report["results"]
+            ]
+            break
+        entry: dict = {"row": row}
+        problems: list = []
+        faults.clear_plan()
+        try:
+            if row == "member_load_io":
+                from photon_ml_tpu.serving import load_member_engine
+
+                faults.install_plan(faults.FaultPlan([
+                    faults.FaultRule(
+                        "serving.member_load", action="io", nth=1
+                    ),
+                ]))
+                try:
+                    load_member_engine(version_dir, 0, 2, max_batch=16)
+                    problems.append(
+                        "armed slice load did not raise (seam misses the "
+                        "load path?)"
+                    )
+                except OSError as e:
+                    entry["armed_error"] = f"{type(e).__name__}: {e}"
+                finally:
+                    faults.clear_plan()
+                engine = load_member_engine(version_dir, 0, 2, max_batch=16)
+                got = engine.score_rows(_serving_rows(n_entities)[:4])
+                entry["retry_scores"] = len(got)
+                if len(got) != 4:
+                    problems.append("unarmed retry did not serve")
+
+            elif row in ("route_fanout_io", "resize_swap"):
+                from photon_ml_tpu.serving import FleetRouter, ScoringEngine
+
+                sub = os.path.join(workdir, row)
+                announce = os.path.join(sub, "announce")
+                os.makedirs(announce, exist_ok=True)
+                members = [
+                    _mini_member(version_dir, announce, m, 2)
+                    for m in range(2)
+                ]
+                router = FleetRouter(
+                    announce, _version_lookups(version_dir),
+                    task="logistic", member_timeout_s=5.0,
+                    cooldown_s=0.05, backoff_s=0.01,
+                )
+                ref_engine = ScoringEngine.load(version_dir, max_batch=16)
+                ref_engine.warmup()
+                score_rows = _serving_rows(n_entities)
+                ref = np.asarray(ref_engine.score_rows(score_rows))
+                try:
+                    router.refresh()
+                    if row == "route_fanout_io":
+                        degraded0 = telemetry.counter(
+                            "serving.degraded_scores"
+                        ).value
+                        faults.install_plan(faults.FaultPlan([
+                            faults.FaultRule(
+                                "serving.route_fanout", action="io", nth=1
+                            ),
+                        ]))
+                        shed = np.asarray(router.score_rows(score_rows))
+                        faults.clear_plan()
+                        degraded = int(telemetry.counter(
+                            "serving.degraded_scores"
+                        ).value - degraded0)
+                        entry["degraded_scores"] = degraded
+                        if len(shed) != len(score_rows):
+                            problems.append(
+                                "degraded request dropped rows"
+                            )
+                        if not degraded:
+                            problems.append(
+                                "injected fan-out failure shed nothing "
+                                "(seam misses the request path?)"
+                            )
+                        time.sleep(0.1)  # let the member cooldown lapse
+                        clean = np.asarray(router.score_rows(score_rows))
+                        entry["recovered_delta"] = float(
+                            np.max(np.abs(clean - ref))
+                        )
+                        if entry["recovered_delta"] >= 1e-6:
+                            problems.append(
+                                "post-shed request off single-engine "
+                                f"parity by {entry['recovered_delta']:g}"
+                            )
+                    else:  # resize_swap
+                        from photon_ml_tpu.serving import write_announce
+
+                        swaps_failed0 = telemetry.counter(
+                            "serving.resize_swap_failures"
+                        ).value
+                        old_epoch = router.view.epoch
+                        for m, (server, source) in enumerate(members):
+                            write_announce(announce, {
+                                "member": m, "fleet_size": 2, "epoch": 1,
+                                "url": f"http://127.0.0.1:{server.port}",
+                                "version": source.engine.version,
+                                "ready": True, "pid": os.getpid(),
+                                "owned": {},
+                            })
+                        faults.install_plan(faults.FaultPlan([
+                            faults.FaultRule(
+                                "serving.resize_swap", action="raise",
+                                nth=1,
+                            ),
+                        ]))
+                        router.refresh()
+                        faults.clear_plan()
+                        entry["swap_failures"] = int(telemetry.counter(
+                            "serving.resize_swap_failures"
+                        ).value - swaps_failed0)
+                        if router.view.epoch != old_epoch:
+                            problems.append(
+                                "injected swap failure still adopted the "
+                                "new epoch (old view not preserved)"
+                            )
+                        if not entry["swap_failures"]:
+                            problems.append(
+                                "swap failure not counted "
+                                "serving.resize_swap_failures"
+                            )
+                        during = np.asarray(router.score_rows(score_rows))
+                        entry["old_view_delta"] = float(
+                            np.max(np.abs(during - ref))
+                        )
+                        if entry["old_view_delta"] >= 1e-6:
+                            problems.append(
+                                "old view served wrong scores under the "
+                                "failed swap"
+                            )
+                        router.refresh()  # unarmed: adopt epoch 1
+                        if router.view.epoch != 1:
+                            problems.append(
+                                "unarmed refresh did not adopt the new "
+                                "epoch"
+                            )
+                        after = np.asarray(router.score_rows(score_rows))
+                        if float(np.max(np.abs(after - ref))) >= 1e-6:
+                            problems.append(
+                                "post-swap scores off single-engine parity"
+                            )
+                finally:
+                    router.close()
+                    for server, _source in members:
+                        server.stop()
+
+            elif row == "member_hard_kill":
+                spec = fleet.ServingFleetSpec(
+                    workdir=os.path.join(workdir, row),
+                    model_dir=version_dir,
+                    fleet_size=3,
+                    traffic_seconds=traffic_seconds,
+                    traffic_hz=10.0,
+                    traffic_rows=6,
+                    traffic_features=(("global", 2), ("user", 2)),
+                    kill_member=1,
+                    kill_after_s=min(2.0, traffic_seconds / 3),
+                    relaunch=True,
+                    heartbeat_deadline_s=2.0,
+                )
+                run = fleet.run_serving_fleet(spec)
+                entry["routed_rows"] = run.get("routed_rows")
+                entry["degraded_scores"] = run.get("degraded_scores")
+                entry["degraded_fraction"] = run.get("degraded_fraction")
+                entry["failures"] = len(run.get("failures") or [])
+                entry["kill"] = run.get("kill")
+                entry["rcs"] = run.get("rcs")
+                if run.get("failures"):
+                    problems.append(
+                        "non-shed request failures under the kill: "
+                        + "; ".join(
+                            str(f) for f in run["failures"][:3]
+                        )
+                    )
+                if not run.get("degraded_scores"):
+                    problems.append(
+                        "hard kill shed nothing (did the outage window "
+                        "overlap traffic?)"
+                    )
+                if run.get("degraded_scores", 0) > run.get(
+                    "routed_rows", 0
+                ):
+                    problems.append(
+                        "degraded accounting exceeds routed rows"
+                    )
+                recovery = (run.get("kill") or {}).get("recovery_s")
+                if recovery is None:
+                    problems.append("no relaunch recovery recorded")
+                elif recovery > KILL_RECOVERY_BUDGET_S:
+                    problems.append(
+                        f"recovery took {recovery:.1f}s "
+                        f"(> {KILL_RECOVERY_BUDGET_S:.0f}s budget)"
+                    )
+                bad_rcs = {
+                    m: rc for m, rc in (run.get("rcs") or {}).items()
+                    if rc != 75
+                }
+                if bad_rcs:
+                    problems.append(
+                        f"members did not drain to exit 75: {bad_rcs}"
+                    )
+        except Exception as e:  # noqa: BLE001 — a row crash IS the finding
+            problems.append(f"row crashed: {type(e).__name__}: {e}")
+        finally:
+            faults.clear_plan()
+        _fail(entry, problems)
+        report["results"][row] = entry
+    report["elapsed_s"] = round(time.monotonic() - t0, 3)
+    return report
+
+
+def _version_lookups(version_dir: str) -> dict:
+    from photon_ml_tpu.serving import fleet_lookups_from_version_dir
+
+    _task, _link, lookups = fleet_lookups_from_version_dir(version_dir)
+    return lookups
+
+
+# ---------------------------------------------------------------------------
 # the worker fit (runs in the subprocess)
 # ---------------------------------------------------------------------------
 
@@ -463,6 +816,10 @@ def main(argv=None) -> int:
                         help="run the DISTRIBUTED matrix (2-process gloo "
                         "fleets, one member hard-killed per seam) instead "
                         "of the single-process write-path matrix")
+    parser.add_argument("--serving-fleet", action="store_true",
+                        help="run the SERVING matrix (shard-owning fleet "
+                        "seams + the hard-kill-under-traffic row) instead "
+                        "of the write-path matrix")
     parser.add_argument("--points", nargs="*",
                         help="subset of write-path points (default: all)")
     parser.add_argument("--nth", type=int, default=1,
@@ -479,7 +836,11 @@ def main(argv=None) -> int:
         return _worker_main(args.dir)
     if not args.workdir:
         parser.error("--workdir is required (or --worker --dir)")
-    if args.fleet:
+    if args.serving_fleet:
+        report = run_serving_matrix(
+            args.workdir, rows=args.points, budget_s=args.budget_s,
+        )
+    elif args.fleet:
         report = run_fleet_matrix(
             args.workdir, points=args.points, budget_s=args.budget_s,
         )
@@ -492,7 +853,12 @@ def main(argv=None) -> int:
         with open(args.json_out, "w", encoding="utf-8") as fh:
             json.dump(report, fh, indent=2, sort_keys=True)
     for point, entry in report["results"].items():
-        if args.fleet:
+        if args.serving_fleet:
+            status = "ok" if entry.get("passed") else "FAIL"
+            print(f"{status:4s} {point}  (degraded="
+                  f"{entry.get('degraded_scores')}, "
+                  f"error={entry.get('error')})")
+        elif args.fleet:
             status = "ok" if entry.get("passed") else "FAIL"
             print(f"{status:4s} {point}  (victim rc="
                   f"{entry.get('victim_rc')}, relaunches="

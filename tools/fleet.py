@@ -560,6 +560,634 @@ def run_fleet(spec: FleetSpec) -> dict:
     return report
 
 
+# ---------------------------------------------------------------------------
+# serving-fleet supervision (shard-owning members + in-process router)
+# ---------------------------------------------------------------------------
+
+
+def make_serving_model(
+    registry_dir: str,
+    n_entities: int = 48,
+    fe_dim: int = 4,
+    re_dim: int = 3,
+    n_buckets: int = 2,
+    task: str = "logistic",
+    seed: int = 20260807,
+) -> str:
+    """Build and publish one small deterministic GAME model (FE
+    ``global`` + per-``userId`` RE over ``n_entities`` entities) into
+    ``registry_dir``; returns the published version directory. The
+    serving chaos matrix, bench, and the e2e fleet test all share this
+    builder so their subprocess members score the same coefficients."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.game.models import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectBucketModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.serving import publish_version
+
+    rng = np.random.default_rng(seed)
+    fe = FixedEffectModel(
+        coefficients=jnp.asarray(rng.normal(size=fe_dim), jnp.float32),
+        shard_name="global",
+    )
+    w_users = rng.normal(size=(n_entities, re_dim))
+    entity_bucket = (np.arange(n_entities) % n_buckets).astype(np.int64)
+    entity_pos = np.zeros(n_entities, np.int64)
+    buckets = []
+    for b in range(n_buckets):
+        codes_b = np.nonzero(entity_bucket == b)[0]
+        entity_pos[codes_b] = np.arange(len(codes_b))
+        proj = np.tile(np.arange(re_dim, dtype=np.int32), (len(codes_b), 1))
+        buckets.append(
+            RandomEffectBucketModel(
+                coefficients=jnp.asarray(w_users[codes_b], jnp.float32),
+                projection=jnp.asarray(proj),
+                entity_codes=jnp.asarray(codes_b, jnp.int32),
+            )
+        )
+    re_model = RandomEffectModel(
+        id_name="userId",
+        shard_name="user",
+        buckets=tuple(buckets),
+        entity_bucket=entity_bucket,
+        entity_pos=entity_pos,
+        vocab=np.arange(n_entities),
+    )
+    model = GameModel(task=task, models={"fixed": fe, "perUser": re_model})
+    index_maps = {
+        "global": [f"g{j}" for j in range(fe_dim)],
+        "user": [f"u{j}" for j in range(re_dim)],
+    }
+    return publish_version(registry_dir, model, index_maps)
+
+
+@dataclasses.dataclass
+class ServingFleetSpec:
+    """One supervised SERVING fleet run: N shard-owning ``cli serve
+    --member`` processes, an in-process :class:`FleetRouter` driving
+    sustained traffic, and the same heartbeat/relaunch supervision the
+    training fleet uses — plus live elastic resizes through the
+    stage/commit barrier."""
+
+    workdir: str
+    #: published model directory (feature-indexes/ + model-metadata.json)
+    model_dir: str
+    fleet_size: int = 3
+    max_batch: int = 64
+    #: per-member slice HBM budget (the fleet's reason to exist); None
+    #: skips enforcement
+    hbm_budget_mb: Optional[float] = None
+    heartbeat_every_s: float = 0.25
+    #: staleness beyond which a member with no exit code counts dead
+    heartbeat_deadline_s: float = 3.0
+    #: how long one member gets to load + warm + announce
+    warm_timeout_s: float = 180.0
+    timeout_s: float = 600.0
+    #: router fan-out timeout per member call
+    member_timeout_s: float = 3.0
+    router_refresh_s: float = 0.15
+    # -- sustained traffic the supervisor drives through the router
+    traffic_seconds: float = 6.0
+    traffic_rows: int = 8
+    traffic_hz: float = 20.0
+    #: dense feature noise synthesized onto traffic rows as
+    #: ``((shard_name, n_cols), ...)`` — each row gets ``[col, value]``
+    #: pairs for cols [0, n_cols) on that shard (the bench/test owns the
+    #: model, so it knows the feature space; empty = ids-only rows)
+    traffic_features: tuple = ()
+    rng_seed: int = 20260807
+    # -- hard-kill one member mid-traffic (None = no kill)
+    kill_member: Optional[int] = None
+    kill_after_s: float = 1.5
+    relaunch: bool = True
+    # -- live resize schedule: [(after_s, new_fleet_size), ...]
+    resizes: tuple = ()
+    # -- fault plan armed onto exactly one member's environment
+    victim_plan: Optional[dict] = None
+    victim_member: int = 1
+    # -- live status surface (parallel.fleet_status)
+    status_file: Optional[str] = None
+    status_port: Optional[int] = None
+    status_interval_s: float = 0.5
+
+    def announce_dir(self) -> str:
+        return os.path.join(self.workdir, "announce")
+
+    def fleet_dir(self) -> str:
+        return os.path.join(self.workdir, "fleet")
+
+    def telemetry_base(self) -> str:
+        return os.path.join(self.workdir, "telemetry", "serving.jsonl")
+
+
+@dataclasses.dataclass
+class _ServingMember:
+    proc: subprocess.Popen
+    member: int
+    fleet_size: int
+    epoch: int
+    out_path: str
+    err_path: str
+    rc: Optional[int] = None
+
+
+def _serving_member_env(spec: ServingFleetSpec, member: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PHOTON_PROC_ID"] = str(member)
+    env.pop("PHOTON_FAULT_PLAN", None)
+    if spec.victim_plan is not None and member == spec.victim_member:
+        env["PHOTON_FAULT_PLAN"] = json.dumps(spec.victim_plan)
+    return env
+
+
+def _launch_serving_member(
+    spec: ServingFleetSpec, member: int, fleet_size: int, epoch: int
+) -> _ServingMember:
+    from photon_ml_tpu.telemetry import identity
+
+    os.makedirs(spec.workdir, exist_ok=True)
+    os.makedirs(os.path.dirname(spec.telemetry_base()), exist_ok=True)
+    out_path = os.path.join(spec.workdir, f"member{member}-e{epoch}.out")
+    err_path = os.path.join(spec.workdir, f"member{member}-e{epoch}.err")
+    argv = [
+        sys.executable, "-m", "photon_ml_tpu.cli", "serve",
+        "--model-dir", spec.model_dir,
+        "--member", str(member),
+        "--fleet-size", str(fleet_size),
+        "--announce-dir", spec.announce_dir(),
+        "--epoch", str(epoch),
+        "--host", "127.0.0.1", "--port", "0",
+        "--max-batch", str(spec.max_batch),
+        "--heartbeat-dir", spec.fleet_dir(),
+        "--telemetry-out",
+        identity.member_artifact_path(spec.telemetry_base(), member),
+    ]
+    if spec.hbm_budget_mb is not None:
+        argv += ["--hbm-budget-mb", str(spec.hbm_budget_mb)]
+    with open(out_path, "wb") as out, open(err_path, "wb") as err:
+        proc = subprocess.Popen(
+            argv,
+            env=_serving_member_env(spec, member),
+            cwd=_repo_root(),
+            stdout=out,
+            stderr=err,
+        )
+    return _ServingMember(
+        proc, member, fleet_size, epoch, out_path, err_path
+    )
+
+
+def _admin_post(url: str, op: str, payload: dict, timeout_s: float) -> dict:
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{url}/v1/admin/{op}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_for_epoch(
+    spec: ServingFleetSpec, epoch: int, fleet_size: int, deadline: float
+) -> dict:
+    """Block until every member of ``(epoch, fleet_size)`` has announced
+    ready; returns {member: record}."""
+    from photon_ml_tpu.serving import scan_announce
+
+    want = set(range(fleet_size))
+    records: dict[int, dict] = {}
+    while time.monotonic() < deadline:
+        records = {
+            int(r["member"]): r
+            for r in scan_announce(spec.announce_dir())
+            if int(r.get("epoch", -1)) == epoch
+            and int(r.get("fleet_size", -1)) == fleet_size
+            and r.get("ready")
+        }
+        if set(records) == want:
+            return records
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"serving fleet epoch {epoch} (size {fleet_size}) incomplete "
+        f"after warm timeout; have {sorted(records)}"
+    )
+
+
+class _TrafficDriver:
+    """Sustained closed-loop traffic through the router on a thread:
+    per-request wall latency samples with timestamps, so disturbance
+    windows (kill, resize) can be cut out and compared afterward."""
+
+    def __init__(self, router, rows_fn, hz: float):
+        import threading
+
+        self.router = router
+        self.rows_fn = rows_fn
+        self.period_s = 1.0 / max(hz, 0.1)
+        self.samples: list = []  # (t_rel, latency_ms, rows)
+        self.failures: list = []  # (t_rel, error string)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="serving-traffic", daemon=True
+        )
+        self.t0 = 0.0
+
+    def start(self) -> "_TrafficDriver":
+        self.t0 = time.monotonic()
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            rows = self.rows_fn()
+            t_start = time.monotonic()
+            try:
+                self.router.score_rows(rows)
+                self.samples.append(
+                    (
+                        round(t_start - self.t0, 4),
+                        round((time.monotonic() - t_start) * 1000.0, 3),
+                        len(rows),
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — a non-shed failure IS the finding
+                self.failures.append(
+                    (round(t_start - self.t0, 4), f"{type(e).__name__}: {e}")
+                )
+            rest = self.period_s - (time.monotonic() - t_start)
+            if rest > 0:
+                self._stop.wait(rest)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+    def p99_between(self, t_lo: float, t_hi: float) -> Optional[float]:
+        import numpy as np
+
+        lat = [s[1] for s in self.samples if t_lo <= s[0] < t_hi]
+        if not lat:
+            return None
+        return float(np.percentile(np.asarray(lat), 99))
+
+
+def _traffic_rows_fn(spec: ServingFleetSpec, lookups: dict):
+    """Deterministic traffic generator: every request sprays ids across
+    the full vocab of every coordinate (so every member owns some of
+    every batch) plus optional dense feature noise."""
+    import numpy as np
+
+    rng = np.random.default_rng(spec.rng_seed)
+    values = {
+        id_name: list(table) for id_name, table in lookups.items()
+    }
+
+    def rows_fn():
+        rows = []
+        for _ in range(spec.traffic_rows):
+            row: dict = {
+                "features": {
+                    shard: [
+                        [j, float(rng.normal())] for j in range(n_cols)
+                    ]
+                    for shard, n_cols in spec.traffic_features
+                },
+                "ids": {
+                    id_name: str(vals[int(rng.integers(len(vals)))])
+                    for id_name, vals in values.items()
+                    if vals
+                },
+            }
+            rows.append(row)
+        return rows
+
+    return rows_fn
+
+
+def run_serving_fleet(spec: ServingFleetSpec) -> dict:
+    """Supervise a shard-owning serving fleet end to end: launch N
+    members, route sustained traffic, survive a hard kill (heartbeat
+    detection -> same-slot relaunch -> degraded window closes), execute
+    live resizes through the stage/commit barrier, and drain everyone at
+    the end. JSON-safe report with latency samples, shed accounting, and
+    per-event timings."""
+    import numpy as np  # noqa: F401 — percentile in the driver
+
+    from photon_ml_tpu import telemetry
+    from photon_ml_tpu.parallel import multihost
+    from photon_ml_tpu.serving import (
+        FleetRouter,
+        fleet_lookups_from_version_dir,
+    )
+    from photon_ml_tpu.telemetry import identity
+    from photon_ml_tpu.telemetry.progress import tail_heartbeat_fields
+
+    os.makedirs(spec.workdir, exist_ok=True)
+    os.makedirs(spec.announce_dir(), exist_ok=True)
+    os.makedirs(spec.fleet_dir(), exist_ok=True)
+    deadline = time.monotonic() + spec.timeout_s
+    report: dict = {"workdir": spec.workdir, "events": []}
+    task, link, lookups = fleet_lookups_from_version_dir(spec.model_dir)
+    fleet_size = spec.fleet_size
+    epoch = 0
+    members: dict[int, _ServingMember] = {}
+    retired: list[_ServingMember] = []
+    router = None
+    traffic = None
+    status = None
+    degraded0 = telemetry.counter("serving.degraded_scores").value
+    routed0 = telemetry.counter("serving.routed_rows").value
+    member_failures0 = telemetry.counter("serving.member_failures").value
+
+    def _push_status(records: dict) -> None:
+        if status is None:
+            return
+        extras = {}
+        down = router.members_status() if router is not None else {}
+        for m, rec in records.items():
+            entry = {
+                "url": rec.get("url"),
+                "model_version": rec.get("version"),
+                "owned": rec.get("owned") or {},
+                "degraded": bool(
+                    down.get(m, {}).get("cooling_down", False)
+                ),
+            }
+            tail = tail_heartbeat_fields(
+                identity.member_artifact_path(spec.telemetry_base(), m),
+                expect_proc=m,
+            )
+            if tail is not None:
+                last_t, last_total = _req_cursor.get(m, (None, None))
+                total = tail.get("serving_requests_total")
+                now = time.monotonic()
+                if (
+                    total is not None
+                    and last_total is not None
+                    and now > last_t
+                ):
+                    entry["requests_per_s"] = round(
+                        max(total - last_total, 0) / (now - last_t), 2
+                    )
+                if total is not None:
+                    _req_cursor[m] = (now, total)
+            extras[m] = entry
+        status.update(
+            num_processes=fleet_size, generation=epoch,
+            member_extras=extras,
+        )
+
+    _req_cursor: dict[int, tuple] = {}
+    try:
+        if spec.status_file is not None or spec.status_port is not None:
+            from photon_ml_tpu.parallel.fleet_status import FleetStatusWriter
+
+            status = FleetStatusWriter(
+                fleet_dir=spec.fleet_dir(),
+                num_processes=fleet_size,
+                heartbeat_deadline_s=spec.heartbeat_deadline_s,
+                status_file=spec.status_file,
+                port=spec.status_port,
+                telemetry_out=spec.telemetry_base(),
+                interval_s=spec.status_interval_s,
+            ).start()
+            report["status_port"] = status.port
+            report["status_file"] = spec.status_file
+        for m in range(fleet_size):
+            members[m] = _launch_serving_member(spec, m, fleet_size, epoch)
+        records = _wait_for_epoch(
+            spec, epoch, fleet_size,
+            min(deadline, time.monotonic() + spec.warm_timeout_s),
+        )
+        version = str(records[0]["version"])
+        router = FleetRouter(
+            spec.announce_dir(),
+            lookups,
+            task=task,
+            link=link,
+            member_timeout_s=spec.member_timeout_s,
+            refresh_interval_s=spec.router_refresh_s,
+            retries=1,
+            backoff_s=0.05,
+            cooldown_s=0.4,
+        )
+        router.refresh()
+        _push_status(records)
+        traffic = _TrafficDriver(
+            router, _traffic_rows_fn(spec, lookups), spec.traffic_hz
+        ).start()
+        t0 = traffic.t0
+
+        def _rel() -> float:
+            return round(time.monotonic() - t0, 4)
+
+        # -- event schedule: kill + resizes interleave on the timeline --
+        kill_at = (
+            None if spec.kill_member is None
+            else t0 + spec.kill_after_s
+        )
+        resize_plan = [
+            (t0 + after_s, int(new_size)) for after_s, new_size in spec.resizes
+        ]
+        traffic_end = t0 + spec.traffic_seconds
+        killed: Optional[dict] = None
+        # a resize that slipped past traffic_end (slow warms on small
+        # hosts) still completes before teardown: the headline is that
+        # EVERY scheduled swap lands under live traffic, not that it
+        # lands on a wall-clock mark — so traffic keeps flowing while
+        # the plan has entries left
+        while time.monotonic() < deadline and (
+            time.monotonic() < traffic_end or resize_plan
+        ):
+            now = time.monotonic()
+            if kill_at is not None and now >= kill_at:
+                kill_at = None
+                victim = members[spec.kill_member]
+                t_kill = _rel()
+                victim.proc.kill()
+                victim.proc.wait()
+                victim.rc = victim.proc.returncode
+                killed = {"member": spec.kill_member, "t_kill": t_kill}
+                report["events"].append({"kill": dict(killed)})
+                # heartbeat-staleness detection, then same-slot relaunch
+                # (same epoch: the announce refresh is an endpoint update,
+                # not an ownership change — serving.resize_swap must NOT
+                # fire for it)
+                while time.monotonic() < deadline:
+                    if spec.kill_member in multihost.dead_peers(
+                        spec.fleet_dir(), fleet_size,
+                        spec.heartbeat_deadline_s,
+                    ):
+                        break
+                    time.sleep(0.05)
+                killed["detect_s"] = round(_rel() - t_kill, 3)
+                if spec.relaunch:
+                    members[spec.kill_member] = _launch_serving_member(
+                        spec, spec.kill_member, fleet_size, epoch
+                    )
+                    old_pid = records[spec.kill_member].get("pid")
+                    while time.monotonic() < deadline:
+                        recs = {
+                            int(r["member"]): r
+                            for r in _scan_ready(spec, epoch, fleet_size)
+                        }
+                        fresh = recs.get(spec.kill_member)
+                        if fresh is not None and fresh.get("pid") != old_pid:
+                            records = recs
+                            break
+                        time.sleep(0.05)
+                    router.refresh()
+                    killed["recovery_s"] = round(_rel() - t_kill, 3)
+                continue
+            if resize_plan and now >= resize_plan[0][0]:
+                _t, new_size = resize_plan.pop(0)
+                event = {
+                    "resize": {
+                        "from": fleet_size,
+                        "to": new_size,
+                        "t_start": _rel(),
+                        "epoch": epoch + 1,
+                    }
+                }
+                survivors = list(range(min(fleet_size, new_size)))
+                # 1) growth: launch the new slots straight into epoch+1
+                #    FIRST — their load+warm overlaps the survivors'
+                #    staging below instead of serializing after it
+                for m in range(fleet_size, new_size):
+                    members[m] = _launch_serving_member(
+                        spec, m, new_size, epoch + 1
+                    )
+                # 2) stage the new slice on every surviving member while
+                #    the old one keeps serving (concurrently: staging is
+                #    member-local work in N separate processes)
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                    max_workers=max(len(survivors), 1)
+                ) as stage_pool:
+                    stage_futs = [
+                        stage_pool.submit(
+                            _admin_post,
+                            records[m]["url"], "stage",
+                            {"fleet_size": new_size, "version": version},
+                            spec.warm_timeout_s,
+                        )
+                        for m in survivors
+                    ]
+                    for fut in stage_futs:
+                        fut.result()
+                # 3) barrier: commit the survivors (their on_commit hook
+                #    re-announces at the new size/epoch)
+                for m in survivors:
+                    _admin_post(
+                        records[m]["url"], "commit",
+                        {
+                            "fleet_size": new_size,
+                            "version": version,
+                            "epoch": epoch + 1,
+                        },
+                        spec.member_timeout_s * 4,
+                    )
+                old_size, old_records = fleet_size, records
+                epoch += 1
+                records = _wait_for_epoch(
+                    spec, epoch, new_size,
+                    min(deadline, time.monotonic() + spec.warm_timeout_s),
+                )
+                fleet_size = new_size
+                router.refresh()
+                event["resize"]["t_swap"] = _rel()
+                # 4) shrink: retire the now-unowned slots via graceful
+                #    drain (SIGTERM -> 503 + Retry-After -> exit 75)
+                for m in range(new_size, old_size):
+                    gone = members.pop(m)
+                    gone.proc.send_signal(signal.SIGTERM)
+                    retired.append(gone)
+                    try:
+                        os.unlink(
+                            os.path.join(
+                                spec.announce_dir(), f"member-{m}.json"
+                            )
+                        )
+                    except OSError:
+                        pass
+                report["events"].append(event)
+                _push_status(records)
+                continue
+            _push_status(records)
+            time.sleep(0.05)
+        traffic.stop()
+        if killed is not None:
+            report["kill"] = killed
+        # -- graceful teardown: every member drains and exits 75 --------
+        for m in list(members.values()) + retired:
+            if m.proc.poll() is None:
+                m.proc.send_signal(signal.SIGTERM)
+        for m in list(members.values()) + retired:
+            try:
+                m.rc = m.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                m.proc.kill()
+                m.rc = m.proc.wait()
+        report["rcs"] = {
+            m.member: m.rc for m in list(members.values()) + retired
+        }
+        report["samples"] = traffic.samples
+        report["failures"] = traffic.failures
+        report["routed_rows"] = int(
+            telemetry.counter("serving.routed_rows").value - routed0
+        )
+        report["degraded_scores"] = int(
+            telemetry.counter("serving.degraded_scores").value - degraded0
+        )
+        report["member_failures"] = int(
+            telemetry.counter("serving.member_failures").value
+            - member_failures0
+        )
+        report["degraded_fraction"] = (
+            report["degraded_scores"] / report["routed_rows"]
+            if report["routed_rows"]
+            else 0.0
+        )
+        report["fleet_size"] = fleet_size
+        report["epoch"] = epoch
+        report["ok"] = not traffic.failures
+        return report
+    finally:
+        if traffic is not None and traffic._thread.is_alive():
+            traffic.stop()
+        if router is not None:
+            router.close()
+        if status is not None:
+            status.stop()
+        for m in list(members.values()) + retired:
+            if m.proc.poll() is None:
+                m.proc.kill()
+                m.proc.wait()
+
+
+def _scan_ready(
+    spec: ServingFleetSpec, epoch: int, fleet_size: int
+) -> list[dict]:
+    from photon_ml_tpu.serving import scan_announce
+
+    return [
+        r
+        for r in scan_announce(spec.announce_dir())
+        if int(r.get("epoch", -1)) == epoch
+        and int(r.get("fleet_size", -1)) == fleet_size
+        and r.get("ready")
+    ]
+
+
 def verify_certified_checkpoints(
     checkpoint_dir: str, num_entities: int, dim: int
 ) -> list[str]:
@@ -816,7 +1444,33 @@ def main(argv=None) -> int:
                         help="disable the per-member trace/telemetry "
                         "artifact streams (on by default under "
                         "<workdir>/telemetry)")
+    parser.add_argument("--serve-model-dir",
+                        help="supervise a SERVING fleet of shard-owning "
+                        "cli-serve members over this published model "
+                        "directory instead of a training fit")
+    parser.add_argument("--serve-fleet-size", type=int, default=3,
+                        help="serving fleet size (entity counts must "
+                        "divide by it)")
+    parser.add_argument("--serve-seconds", type=float, default=6.0,
+                        help="how long to drive router traffic")
     args = parser.parse_args(argv)
+    if args.serve_model_dir:
+        if not args.workdir:
+            parser.error("--serve-model-dir requires --workdir")
+        report = run_serving_fleet(ServingFleetSpec(
+            workdir=args.workdir,
+            model_dir=args.serve_model_dir,
+            fleet_size=args.serve_fleet_size,
+            traffic_seconds=args.serve_seconds,
+            status_file=args.status_file,
+            status_port=args.status_port,
+            status_interval_s=args.status_interval,
+        ))
+        if args.json_out:
+            with open(args.json_out, "w", encoding="utf-8") as fh:
+                json.dump(report, fh, indent=2, sort_keys=True)
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report.get("ok") else 1
     if args.worker:
         if not args.dir:
             parser.error("--worker requires --dir")
