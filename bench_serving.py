@@ -69,6 +69,10 @@ FLEET_METRICS = (
     "serving_fleet_kill_recovery_s",
 )
 
+TRACE_OVERHEAD_METRICS = (
+    "serving_trace_overhead_ratio",
+)
+
 #: Offered load at/below engine capacity may shed at most this fraction
 #: of requests — the SLO error budget.
 SHED_BUDGET = 0.01
@@ -483,6 +487,93 @@ def run_serving_slo(
     return results
 
 
+def run_trace_overhead(
+    deadline=None,
+    *,
+    n_features=512,
+    n_entities=2_000,
+    local_dim=8,
+    row_nnz=12,
+    max_batch=32,
+    requests_per_arm=250,
+    blocks=2,
+    detail_out=None,
+) -> dict:
+    """Request-tracing cost on the serving hot path:
+    ``serving_trace_overhead_ratio`` = closed-loop wall clock with the
+    request tracer ON (ring record + tail-sampling accounting per
+    request) over the same traffic with ``requests.configure(enabled=
+    False)``. 1.0 = tracing is free; the acceptance line is <= 1.05.
+    Arms alternate in blocks so drift (frequency scaling, page cache)
+    lands on both sides."""
+    from photon_ml_tpu.serving import MicroBatcher, ScoringEngine
+    from photon_ml_tpu.telemetry import requests as rq
+
+    results: dict = {m: None for m in TRACE_OVERHEAD_METRICS}
+    detail = detail_out if detail_out is not None else {}
+    if deadline is not None and deadline - time.monotonic() < 30:
+        return results
+    if deadline is not None and deadline - time.monotonic() < 90:
+        requests_per_arm = max(50, requests_per_arm // 4)
+    rng = np.random.default_rng(3)
+    engine = ScoringEngine(
+        build_model(n_features, n_entities, local_dim, seed=2),
+        max_batch=max_batch,
+        max_row_nnz=row_nnz + 8,
+        version="bench-trace",
+    )
+    engine.warmup()
+    batcher = MicroBatcher(
+        lambda rows: (engine.score_rows(rows), engine.version),
+        max_batch=max_batch,
+        max_delay_ms=0.5,
+        queue_depth=4096,
+    ).start()
+    pool = [
+        make_rows(rng, 4, n_features, n_entities, row_nnz)
+        for _ in range(64)
+    ]
+    try:
+        def arm(traced: bool, count: int) -> float:
+            rq.configure(enabled=traced)
+            t0 = time.monotonic()
+            for i in range(count):
+                # the server path: every request carries a ctx; with the
+                # tracer disabled begin() returns None and the batcher's
+                # bookkeeping short-circuits — that delta IS the metric
+                fut = batcher.submit(
+                    pool[i % len(pool)], ctx=rq.make_context()
+                )
+                fut.result(timeout=30)
+            return time.monotonic() - t0
+
+        arm(True, 32)   # warm both arms off the measured blocks
+        arm(False, 32)
+        traced_s = untraced_s = 0.0
+        for _ in range(blocks):
+            untraced_s += arm(False, requests_per_arm)
+            traced_s += arm(True, requests_per_arm)
+        if untraced_s > 0:
+            results["serving_trace_overhead_ratio"] = round(
+                traced_s / untraced_s, 4
+            )
+        total = requests_per_arm * blocks
+        detail["trace_overhead"] = {
+            "requests_per_arm": requests_per_arm,
+            "blocks": blocks,
+            "traced_s": round(traced_s, 4),
+            "untraced_s": round(untraced_s, 4),
+            "traced_us_per_req": round(traced_s / total * 1e6, 1),
+            "untraced_us_per_req": round(untraced_s / total * 1e6, 1),
+            "ring_dropped": rq.REQUESTS.dropped,
+        }
+    finally:
+        batcher.stop()
+        rq.configure(enabled=True)
+        rq.reset()
+    return results
+
+
 def run_serving_fleet_bench(
     deadline=None,
     *,
@@ -603,7 +694,8 @@ def main() -> int:
 
     deadline = budget_deadline()
     if deadline is not None and deadline - time.monotonic() < 30:
-        for metric in SERVING_METRICS + SLO_METRICS + FLEET_METRICS:
+        for metric in (SERVING_METRICS + SLO_METRICS
+                       + TRACE_OVERHEAD_METRICS + FLEET_METRICS):
             print(truncated_line(metric), flush=True)
         return 0
 
@@ -711,6 +803,29 @@ def main() -> int:
                     ),
                     "vs_baseline": None,
                     "detail": slo_detail,
+                }
+            ),
+            flush=True,
+        )
+
+    # -- request-tracing overhead ----------------------------------------
+    trace_detail: dict = {}
+    trace_metrics = run_trace_overhead(
+        deadline=deadline, detail_out=trace_detail
+    )
+    for metric in TRACE_OVERHEAD_METRICS:
+        value = trace_metrics.get(metric)
+        if value is None:
+            print(truncated_line(metric), flush=True)
+            continue
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": value,
+                    "unit": "ratio",
+                    "vs_baseline": None,
+                    "detail": trace_detail,
                 }
             ),
             flush=True,

@@ -69,6 +69,10 @@ LOWER_IS_BETTER_METRICS = frozenset({
     # p99 flatness and hard-kill recovery both regress upward
     "serving_fleet_p99_resize_ratio",
     "serving_fleet_kill_recovery_s",
+    # request-scoped tracing (bench_serving run_trace_overhead): traced
+    # over untraced wall clock — the tracer's ring+tail-sampling cost
+    # per request regresses upward; the acceptance line is <= 1.05
+    "serving_trace_overhead_ratio",
     # fleet observability (bench_multichip): time lost waiting at
     # collectives and per-member MFU imbalance both regress upward
     "fleet_collective_wait_fraction",
@@ -532,9 +536,10 @@ def main(argv=None) -> int:
         action="store_true",
         help="also run bench_serving.py's sustained-load SLO sweep "
         "(offered-load grid, p99-across-hot-swap and across-nearline "
-        "flatness, time-to-applied-update) and include the serving_slo_* "
-        "metrics in the gate; baselines that predate them skip with a "
-        "note",
+        "flatness, time-to-applied-update) plus the request-tracing "
+        "overhead A/B and include the serving_slo_* and "
+        "serving_trace_overhead_ratio metrics in the gate; baselines "
+        "that predate them skip with a note",
     )
     args = parser.parse_args(argv)
     from photon_ml_tpu import faults
@@ -572,9 +577,10 @@ def main(argv=None) -> int:
 
         results.update(run_freshness(deadline=deadline))
     if args.serving:
-        from bench_serving import run_serving_slo
+        from bench_serving import run_serving_slo, run_trace_overhead
 
         results.update(run_serving_slo(deadline=deadline))
+        results.update(run_trace_overhead(deadline=deadline))
     if args.gate:
         return run_gate(
             results, load_gate_baseline(args.gate), args.gate_threshold

@@ -4,7 +4,7 @@
         --trace run.trace.jsonl --telemetry run.metrics.jsonl \
         --checkpoint-dir ckpt/ --out report.md [--json report.json] \
         [--compare baseline.report.json] [--fail-on-regress] \
-        [--threshold 0.2] [--hot [N]]
+        [--threshold 0.2] [--hot [N]] [--requests [N]]
 
 Merges a span JSONL (``--trace-out``), a telemetry JSONL (metrics
 snapshot + heartbeat lines), and a checkpoint directory's manifests into
@@ -85,6 +85,17 @@ def main(argv: Optional[list] = None) -> int:
         help="render ONLY the hot-executables table (top N by profiled "
         "exclusive device seconds, default 10) instead of the full "
         "report — the quick 'where did the time go' view",
+    )
+    parser.add_argument(
+        "--requests",
+        nargs="?",
+        const=10,
+        type=int,
+        metavar="N",
+        help="render ONLY the request-tracing section (the N slowest "
+        "persisted request traces, default 10) — with --fleet the "
+        "traces are joined across router and member streams by "
+        "trace_id",
     )
     parser.add_argument(
         "--compare",
@@ -184,7 +195,15 @@ def main(argv: Optional[list] = None) -> int:
                     file=sys.stderr,
                 )
 
-    if args.hot is not None:
+    if args.requests is not None:
+        req_lines = report._requests_markdown(args.requests)
+        md = (
+            "\n".join(req_lines).rstrip() + "\n"
+            if req_lines
+            else "No request traces (run carried no request.* metrics "
+            "or persisted request:* spans).\n"
+        )
+    elif args.hot is not None:
         hot_lines = report._hot_executables_markdown(args.hot)
         md = (
             "\n".join(hot_lines).rstrip() + "\n"

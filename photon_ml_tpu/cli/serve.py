@@ -292,7 +292,20 @@ def main(argv=None) -> int:
     fleet.add_argument(
         "--telemetry-out",
         help="append member-attributed serving heartbeat JSONL here "
-        "(requests/s for the fleet status surface)",
+        "(requests/s for the fleet status surface); the final metrics "
+        "snapshot flushes to the same stream on graceful drain",
+    )
+    parser.add_argument(
+        "--trace-out",
+        help="span JSONL sink (member-suffixed in a fleet); request "
+        "records tail-sample into it, and the drain path dumps the "
+        "flight recorder (flight-proc-<i>.json) next to it",
+    )
+    fleet.add_argument(
+        "--trace-sample-every", type=int, default=0,
+        help="router: explicitly sample every Nth routed batch (full "
+        "trace persisted on router AND members); 0 disables explicit "
+        "sampling — slow/degraded/errored requests still persist",
     )
     fleet.add_argument(
         "--member-timeout-s", type=float, default=5.0,
@@ -306,11 +319,17 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     setup_logging()
-    from photon_ml_tpu import faults
+    from photon_ml_tpu import faults, telemetry
 
     # a serving process with an armed fault plan WILL fail requests on
     # purpose — say so at startup, loudly
     faults.warn_if_armed()
+    if args.trace_out:
+        # member-suffixed (idempotent): N fleet processes pointed at one
+        # --trace-out value write N streams, the --fleet report contract
+        telemetry.configure(
+            trace_out=telemetry.member_artifact_path(args.trace_out)
+        )
     from photon_ml_tpu.serving import (
         AsyncScoringServer,
         FleetRouter,
@@ -410,6 +429,7 @@ def main(argv=None) -> int:
             member_timeout_s=args.member_timeout_s,
             refresh_interval_s=args.router_refresh_s,
             max_batch=args.max_batch,
+            sample_every=args.trace_sample_every,
         )
     elif args.model_dir:
         source = ScoringEngine.load(
@@ -566,6 +586,28 @@ def main(argv=None) -> int:
         )
         service.drain()
         server.stop()
+        # the flight recorder's drain-path dump: the last seconds of
+        # request records land atomically next to the telemetry
+        # artifacts, so even a drained member leaves its last words
+        flight_dir = next(
+            (
+                os.path.dirname(os.path.abspath(p))
+                for p in (args.trace_out, args.telemetry_out)
+                if p
+            ),
+            None,
+        )
+        if flight_dir is not None:
+            from photon_ml_tpu.telemetry import identity, requests
+
+            proc = identity.fleet_process_index()
+            if proc is None:
+                proc = args.member or 0
+            requests.flight_dump(requests.flight_path(flight_dir, proc))
+        if args.telemetry_out:
+            # the final metrics snapshot: its presence is what marks this
+            # member "ok" (not lost) in the fleet report
+            telemetry.flush_metrics(args.telemetry_out)
         return stop.hard_exit_code
     finally:
         if beat is not None:

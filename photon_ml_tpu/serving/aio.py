@@ -36,6 +36,7 @@ sanctioned ``telemetry.sync_fetch``.
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import logging
 import threading
@@ -48,6 +49,7 @@ from photon_ml_tpu.serving.server import (
     ScoringService,
     _json_scores,
 )
+from photon_ml_tpu.telemetry import requests as request_trace
 
 logger = logging.getLogger("photon_ml_tpu.serving.aio")
 
@@ -141,7 +143,9 @@ class AsyncScoringServer:
                 if request is None:
                     break
                 method, path, headers, body = request
-                code, obj, extra = await self._route(method, path, body)
+                code, obj, extra = await self._route(
+                    method, path, body, headers
+                )
                 await self._reply(writer, code, obj, extra)
                 if headers.get("connection", "").lower() == "close":
                     break
@@ -223,7 +227,13 @@ class AsyncScoringServer:
         "/v1/admin/commit",
     )
 
-    async def _route(self, method: str, path: str, body: bytes):
+    async def _route(
+        self,
+        method: str,
+        path: str,
+        body: bytes,
+        headers: Optional[dict] = None,
+    ):
         """Returns ``(code, obj, extra_headers_or_None)``."""
         if method == "GET":
             # answered inline on the loop — NEVER behind the batcher, so
@@ -240,6 +250,11 @@ class AsyncScoringServer:
         except ValueError:
             return 400, {"error": "bad_request",
                          "detail": "body is not valid JSON"}, None
+        # _read_request lowercases header names; a malformed trace header
+        # parses to None and the request proceeds untraced
+        ctx = request_trace.parse_header(
+            (headers or {}).get(request_trace.TRACE_HEADER.lower())
+        )
         loop = asyncio.get_running_loop()
         try:
             if path == "/v1/update":
@@ -248,7 +263,10 @@ class AsyncScoringServer:
                 # device work runs off-loop: the margin fold is a blocking
                 # engine call, and the loop must keep accepting traffic
                 result = await loop.run_in_executor(
-                    None, self.service.margin_request, payload
+                    None,
+                    functools.partial(
+                        self.service.margin_request, payload, ctx=ctx
+                    ),
                 )
                 return 200, result, None
             if path.startswith("/v1/admin/"):
@@ -259,7 +277,7 @@ class AsyncScoringServer:
                     None, self.service.admin_request, op, payload
                 )
                 return 200, result, None
-            return 200, await self._score(payload), None
+            return 200, await self._score(payload, ctx), None
         except Draining as e:
             return (
                 503,
@@ -281,10 +299,10 @@ class AsyncScoringServer:
             logger.exception("async score request failed")
             return 500, {"error": "internal", "detail": str(e)}, None
 
-    async def _score(self, payload) -> dict:
+    async def _score(self, payload, ctx=None) -> dict:
         """Submit to the shared batcher and await the wrapped future —
         the loop stays free while the batch runs on the device."""
-        future = self.service.submit_rows(payload)
+        future = self.service.submit_rows(payload, ctx=ctx)
         try:
             result = await asyncio.wait_for(
                 asyncio.wrap_future(future),

@@ -31,6 +31,7 @@ from typing import Callable, Mapping, Sequence, Tuple
 
 from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.serving.engine import BadRequest
+from photon_ml_tpu.telemetry import requests as request_trace
 
 #: scorer contract: flat request rows -> (scores aligned to rows, version)
 Scorer = Callable[[Sequence[Mapping]], Tuple[Sequence[float], str]]
@@ -66,12 +67,15 @@ class Draining(RuntimeError):
 
 
 class _Unit:
-    __slots__ = ("rows", "future", "t_enqueue")
+    __slots__ = ("rows", "future", "t_enqueue", "ctx")
 
-    def __init__(self, rows):
+    def __init__(self, rows, ctx=None):
         self.rows = rows
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
+        # inbound trace context (X-Photon-Trace); None mints one at
+        # dispatch so every unit still lands in the request ring
+        self.ctx = ctx
 
 
 class MicroBatcher:
@@ -128,10 +132,12 @@ class MicroBatcher:
 
     # -- producer side -------------------------------------------------------
 
-    def submit(self, rows: Sequence[Mapping]) -> Future:
+    def submit(self, rows: Sequence[Mapping], ctx=None) -> Future:
         """Enqueue one request unit; resolves to
-        ``{"scores": <aligned array>, "model_version": <str>}``."""
-        unit = _Unit(list(rows))
+        ``{"scores": <aligned array>, "model_version": <str>}``.
+        ``ctx`` tags the unit's request record with the caller's trace
+        context."""
+        unit = _Unit(list(rows), ctx=ctx)
         if len(unit.rows) > self.queue_depth:
             # shedding this as Overloaded would invite a retry that can
             # NEVER succeed — it is a malformed request, not back-pressure
@@ -208,8 +214,27 @@ class MicroBatcher:
             return
         t0 = time.monotonic()
         queue_ms = telemetry.histogram("serving.queue_ms")
+        recs: dict[int, object] = {}
         for u in units:
-            queue_ms.observe((t0 - u.t_enqueue) * 1000.0)
+            wait_ms = (t0 - u.t_enqueue) * 1000.0
+            queue_ms.observe(wait_ms)
+            # every unit becomes a request record (the per-process ring);
+            # the record's clock starts at ENQUEUE so queue wait is part
+            # of the request, not hidden before it
+            rec = request_trace.begin(
+                "score",
+                ctx=u.ctx,
+                role="member",
+                t_start=request_trace.trace_time(u.t_enqueue),
+                rows=len(u.rows),
+            )
+            if rec is not None:
+                rec.phase(
+                    "batcher_wait",
+                    wait_ms,
+                    ts=request_trace.trace_time(u.t_enqueue),
+                )
+                recs[id(u)] = rec
         flat = [r for u in units for r in u.rows]
         telemetry.histogram("serving.batch_size").observe(len(flat))
         try:
@@ -218,6 +243,10 @@ class MicroBatcher:
         except Exception as e:  # noqa: BLE001 — failure belongs to callers
             if len(units) == 1:
                 self._deliver(units[0], error=e)
+                request_trace.finish(
+                    recs.get(id(units[0])), status="error",
+                    error=f"{type(e).__name__}: {e}",
+                )
             else:
                 # isolate the offender: one malformed co-batched request
                 # must not fail the valid ones riding the same batch
@@ -227,10 +256,17 @@ class MicroBatcher:
                         self._deliver(
                             u, result={"scores": s, "model_version": v}
                         )
+                        request_trace.finish(recs.get(id(u)))
                     except Exception as unit_err:  # noqa: BLE001
                         self._deliver(u, error=unit_err)
+                        request_trace.finish(
+                            recs.get(id(u)), status="error",
+                            error=f"{type(unit_err).__name__}: {unit_err}",
+                        )
             return
         t1 = time.monotonic()
+        dispatch_ms = (t1 - t0) * 1000.0
+        dispatch_ts = request_trace.trace_time(t0)
         total_ms = telemetry.histogram("serving.total_ms")
         offset = 0
         for u in units:
@@ -242,6 +278,11 @@ class MicroBatcher:
             )
             total_ms.observe((t1 - u.t_enqueue) * 1000.0)
             offset += k
+            rec = recs.get(id(u))
+            if rec is not None:
+                rec.phase("device_dispatch", dispatch_ms, ts=dispatch_ts)
+                rec.set_attr(version=version, batch_rows=len(flat))
+                request_trace.finish(rec)
 
     def _loop(self) -> None:
         while True:

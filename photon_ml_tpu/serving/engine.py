@@ -843,6 +843,16 @@ class ScoringEngine:
         self.warm = True
         return self
 
+    def request_attrs(self) -> dict:
+        """The serving attributes every request record carries — the
+        per-request ``{version, nearline_seq}`` attribution ROADMAP's
+        event->served staleness SLO joins on (the request tracer adds
+        ``fleet_size`` from the routed payload)."""
+        return {
+            "version": self.version,
+            "nearline_seq": int(self.nearline_seq or 0),
+        }
+
     def compile_summary(self) -> dict[str, dict]:
         """Per-batch-bucket compile state from the executable registry
         (populated at :meth:`warmup`): compile wall seconds plus the XLA

@@ -32,6 +32,7 @@ shed, never blend coefficients from two versions in one score.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import os
 import threading
@@ -45,6 +46,7 @@ import numpy as np
 
 from photon_ml_tpu import faults, telemetry
 from photon_ml_tpu.parallel.sharding import owner_of_row
+from photon_ml_tpu.telemetry import requests as request_trace
 from photon_ml_tpu.utils.atomic import atomic_write_json
 
 _FP_ROUTE_FANOUT = faults.register_point(
@@ -191,6 +193,7 @@ class FleetRouter:
         refresh_interval_s: float = 0.5,
         cooldown_s: float = 1.0,
         max_batch: int = 1024,
+        sample_every: int = 0,
     ):
         self.announce_dir = announce_dir
         self._lookups = {
@@ -217,6 +220,10 @@ class FleetRouter:
         self.entity_axis = None
         self.nearline_seq = 0
         self.lineage = None
+        # mark every Nth routed batch explicitly sampled (its full trace
+        # persists on router AND members via header propagation); 0 = off
+        self.sample_every = int(sample_every)
+        self._req_seq = itertools.count(1)
         self._view: Optional[FleetView] = None
         self._view_lock = threading.Lock()
         self._down_until: dict[int, float] = {}
@@ -305,27 +312,83 @@ class FleetRouter:
         return view
 
     def members_status(self) -> dict[int, dict]:
-        """Per-member router's-eye liveness for the status surface."""
+        """Per-member router's-eye liveness for the status surface:
+        cooldown/degraded state plus the fan-out RTT summary (the
+        ``serving.fanout_rtt_ms.m<i>`` histogram) — what the supervisor
+        publishes into ``/statusz``."""
         view = self._view
         if view is None:
             return {}
         now = time.monotonic()
-        return {
-            m: {
+        out: dict[int, dict] = {}
+        hists = telemetry.snapshot().get("histograms", {})
+        for m in range(view.fleet_size):
+            until = self._down_until.get(m, 0.0)
+            entry: dict = {
                 "url": view.endpoints[m],
-                "cooling_down": self._down_until.get(m, 0.0) > now,
+                "cooling_down": until > now,
+                "cooldown_remaining_s": round(max(0.0, until - now), 3),
+                # cooldown IS the router's degraded signal: rows owned by
+                # a cooling member shed to FE-only until it recovers
+                "degraded": until > now,
             }
-            for m in range(view.fleet_size)
-        }
+            rtt = hists.get(f"serving.fanout_rtt_ms.m{m}")
+            if rtt:
+                entry["fanout_rtt_ms"] = rtt
+            out[m] = entry
+        return out
 
     # -- request path --------------------------------------------------------
 
-    def score_rows(self, rows: Sequence[Mapping]) -> np.ndarray:
+    def score_rows(
+        self,
+        rows: Sequence[Mapping],
+        ctx: Optional[request_trace.TraceContext] = None,
+    ) -> np.ndarray:
         """Mean predictions for ``rows`` — the
-        ``ScoringEngine.score_rows`` contract, served by the fleet."""
+        ``ScoringEngine.score_rows`` contract, served by the fleet.
+
+        The router is the MINTING end of request tracing: when no
+        inbound ``ctx`` arrives it creates one per routed batch and
+        propagates it to every member over ``X-Photon-Trace``, so the
+        member-side spans join this call's record by ``trace_id``."""
         if not rows:
             return np.zeros((0,), np.float32)
-        view = self._current_view()
+        if ctx is None:
+            sampled = (
+                self.sample_every > 0
+                and next(self._req_seq) % self.sample_every == 0
+            )
+            ctx = request_trace.make_context(sampled=sampled)
+        rec = request_trace.begin(
+            "route", ctx=ctx, role="router", rows=len(rows)
+        )
+        try:
+            view = self._current_view()
+        except FleetUnavailable as e:
+            request_trace.finish(rec, status="error", error=str(e))
+            raise
+        if rec is not None:
+            rec.set_attr(
+                fleet_size=view.fleet_size,
+                version=view.version,
+                epoch=view.epoch,
+            )
+        try:
+            scores = self._score_routed(rows, view, ctx, rec)
+        except FleetUnavailable as e:
+            request_trace.finish(rec, status="error", error=str(e))
+            raise
+        request_trace.finish(rec)
+        return scores
+
+    def _score_routed(
+        self,
+        rows: Sequence[Mapping],
+        view: FleetView,
+        ctx: Optional[request_trace.TraceContext],
+        rec,
+    ) -> np.ndarray:
         n, fleet = len(rows), view.fleet_size
         offsets = np.zeros((n,), np.float64)
         # plan: row -> owning members (one per entity) + one FE owner
@@ -354,6 +417,7 @@ class FleetRouter:
                 member_rows.setdefault(m, []).append(i)
                 # plain bool: this list is json-serialized onto the wire
                 member_fe.setdefault(m, []).append(bool(m == fe_owner[i]))
+        t_fanout = time.monotonic()
         futures = {
             m: self._pool.submit(
                 self._call_member,
@@ -361,6 +425,8 @@ class FleetRouter:
                 m,
                 [self._sub_row(rows[i]) for i in idxs],
                 member_fe[m],
+                ctx,
+                rec,
             )
             for m, idxs in member_rows.items()
         }
@@ -383,9 +449,16 @@ class FleetRouter:
                     # losslessly-retried FE designate is not degraded
                     if self._row_had_entities(rows[i], m, fleet):
                         degraded[i] = True
+        if rec is not None:
+            rec.phase(
+                "fanout",
+                (time.monotonic() - t_fanout) * 1000.0,
+                ts=request_trace.trace_time(t_fanout),
+            )
+        t_fold = time.monotonic()
         if fe_orphans:
             totals[fe_orphans] += self._fe_fallback(
-                view, [rows[i] for i in fe_orphans], failed
+                view, [rows[i] for i in fe_orphans], failed, ctx, rec
             )
         shed = int(np.count_nonzero(degraded))
         if shed:
@@ -395,6 +468,17 @@ class FleetRouter:
         link_fn = _LINKS.get(self._link)
         if link_fn is not None:
             scores = link_fn(scores)
+        if rec is not None:
+            rec.phase(
+                "fold",
+                (time.monotonic() - t_fold) * 1000.0,
+                ts=request_trace.trace_time(t_fold),
+            )
+            rec.set_attr(
+                degraded=bool(shed),
+                members=sorted(member_rows),
+                failed_members=sorted(failed),
+            )
         return np.asarray(scores, np.float32)
 
     @staticmethod
@@ -424,7 +508,12 @@ class FleetRouter:
         return False
 
     def _fe_fallback(
-        self, view: FleetView, rows: Sequence[Mapping], failed: set
+        self,
+        view: FleetView,
+        rows: Sequence[Mapping],
+        failed: set,
+        ctx: Optional[request_trace.TraceContext] = None,
+        rec=None,
     ) -> np.ndarray:
         """Fixed-effect margins for rows whose FE designate died,
         retried on any alive member (FE vectors are replicated; ids are
@@ -440,7 +529,7 @@ class FleetRouter:
                 continue
             try:
                 margins = self._call_member(
-                    view, m, stripped, [True] * len(stripped)
+                    view, m, stripped, [True] * len(stripped), ctx, rec
                 )
                 return np.asarray(margins, np.float64)
             except _MemberUnavailable as e:
@@ -457,10 +546,15 @@ class FleetRouter:
         member: int,
         sub_rows: list,
         include_fixed: list,
+        ctx: Optional[request_trace.TraceContext] = None,
+        rec=None,
     ) -> list:
         """One member's margin batch, with bounded retry/backoff and a
         down-cooldown so a dead member costs one timeout per cooldown
-        window, not per request."""
+        window, not per request. Each attempt's RTT lands in the
+        per-member ``serving.fanout_rtt_ms.m<i>`` histogram; the call's
+        total wall time becomes a ``member<i>_rtt`` phase of ``rec``
+        (appended from the pool thread — list append is GIL-atomic)."""
         now = time.monotonic()
         if self._down_until.get(member, 0.0) > now:
             raise _MemberUnavailable(f"member {member} cooling down")
@@ -473,6 +567,9 @@ class FleetRouter:
             raise _MemberUnavailable(
                 f"member {member} fan-out fault: {e}"
             ) from e
+        headers = {"Content-Type": "application/json"}
+        if ctx is not None:
+            headers[request_trace.TRACE_HEADER] = ctx.to_header()
         body = json.dumps({
             "rows": sub_rows,
             "include_fixed": include_fixed,
@@ -480,17 +577,29 @@ class FleetRouter:
             "version": view.version,
         }).encode()
         url = view.endpoints[member] + "/v1/margins"
+        rtt_hist = telemetry.histogram(f"serving.fanout_rtt_ms.m{member}")
+        t_call = time.monotonic()
+
+        def _rtt_phase() -> None:
+            if rec is not None:
+                rec.phase(
+                    f"member{member}_rtt",
+                    (time.monotonic() - t_call) * 1000.0,
+                    ts=request_trace.trace_time(t_call),
+                )
+
         last_err: Optional[Exception] = None
         for attempt in range(self.retries + 1):
+            t_attempt = time.monotonic()
             try:
                 req = urllib.request.Request(
-                    url, data=body,
-                    headers={"Content-Type": "application/json"},
+                    url, data=body, headers=headers,
                 )
                 with urllib.request.urlopen(
                     req, timeout=self.member_timeout_s
                 ) as resp:
                     payload = json.loads(resp.read())
+                rtt_hist.observe((time.monotonic() - t_attempt) * 1000.0)
                 self._down_until.pop(member, None)
                 margins = payload["margins"]
                 if len(margins) != len(sub_rows):
@@ -498,19 +607,25 @@ class FleetRouter:
                         f"member {member} returned {len(margins)} margins "
                         f"for {len(sub_rows)} rows"
                     )
+                _rtt_phase()
                 return margins
             except urllib.error.HTTPError as e:
                 # 409: the member holds no engine for our pinned
                 # (fleet_size, version) — a mixed-swap window; shed this
                 # member for the request rather than blend versions
+                rtt_hist.observe((time.monotonic() - t_attempt) * 1000.0)
                 last_err = e
                 if e.code == 409:
                     break
             except (OSError, ValueError, KeyError) as e:
+                # a timeout's RTT is as real as a success's — without it
+                # the histogram hides exactly the calls that hurt
+                rtt_hist.observe((time.monotonic() - t_attempt) * 1000.0)
                 last_err = e
             if attempt < self.retries:
                 time.sleep(self.backoff_s * (2 ** attempt))
         self._down_until[member] = time.monotonic() + self.cooldown_s
+        _rtt_phase()
         raise _MemberUnavailable(
             f"member {member} at {url}: {last_err}"
         ) from last_err

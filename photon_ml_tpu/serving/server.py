@@ -23,11 +23,13 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Mapping, Optional
 
 from photon_ml_tpu import telemetry
+from photon_ml_tpu.telemetry import requests as request_trace
 from photon_ml_tpu.serving.batcher import (
     ContinuousBatcher,
     Draining,
@@ -185,26 +187,43 @@ class ScoringService:
 
     # -- scoring -------------------------------------------------------------
 
-    def submit_rows(self, payload: Mapping):
+    def submit_rows(self, payload: Mapping, ctx=None):
         """Validate one ``/v1/score`` body and enqueue it; the batcher
         Future (resolves to ``{"scores", "model_version"}``). Shared by
-        the blocking (:meth:`score_request`) and asyncio front ends."""
+        the blocking (:meth:`score_request`) and asyncio front ends.
+        ``ctx`` is the inbound trace context (``X-Photon-Trace``); the
+        batcher carries it through queue wait and dispatch."""
         if self._draining:
             raise Draining("server is draining; retry elsewhere")
         rows = payload.get("rows") if isinstance(payload, Mapping) else None
         if not isinstance(rows, list):
             raise BadRequest('request body must be {"rows": [...]}')
-        return self._batcher.submit(rows)
+        return self._batcher.submit(rows, ctx=ctx)
 
     # -- fleet-member endpoints ----------------------------------------------
 
-    def margin_request(self, payload: Mapping) -> dict:
+    def margin_request(self, payload: Mapping, ctx=None) -> dict:
         """One ``/v1/margins`` body — the router's fan-out unit:
         ``{"rows": [...], "include_fixed": [bool, ...]?, "fleet_size":
         N?, "version": "v-..."?}``. Scores DIRECTLY on the resolved
         engine (router batches upstream; re-coalescing here would add a
         deadline per member). Full-precision margins: the router's fold
-        is exact, so no wire rounding."""
+        is exact, so no wire rounding.
+
+        ``ctx`` is the router's propagated trace context: the member-side
+        record (engine-dispatch phase + ``{version, nearline_seq,
+        fleet_size}``) carries its ids, so the fleet report joins this
+        hop under the router's tree."""
+        rec = request_trace.begin("margins", ctx=ctx, role="member")
+        try:
+            return self._margin_request(payload, rec)
+        except Exception as e:
+            request_trace.finish(
+                rec, status="error", error=f"{type(e).__name__}: {e}"
+            )
+            raise
+
+    def _margin_request(self, payload: Mapping, rec) -> dict:
         if self._draining:
             raise Draining("server is draining; retry elsewhere")
         if not isinstance(payload, Mapping):
@@ -217,7 +236,24 @@ class ScoringService:
         if include_fixed is not None and not isinstance(include_fixed, list):
             raise BadRequest("include_fixed must be a list of booleans")
         telemetry.counter("serving.requests").inc()
+        t0 = time.monotonic()
         margins = engine.margin_rows(rows, include_fixed)
+        if rec is not None:
+            rec.phase(
+                "engine_dispatch",
+                (time.monotonic() - t0) * 1000.0,
+                ts=request_trace.trace_time(t0),
+            )
+            attrs = (
+                engine.request_attrs()
+                if hasattr(engine, "request_attrs")
+                else {"version": engine.version}
+            )
+            fleet_size = payload.get("fleet_size")
+            if fleet_size is not None:
+                attrs["fleet_size"] = fleet_size
+            rec.set_attr(rows=len(rows), **attrs)
+        request_trace.finish(rec)
         return {
             # host numpy from the engine's sync_fetch; float() is JSON
             # shaping, not a device crossing
@@ -268,8 +304,8 @@ class ScoringService:
             )
         return _engine_of(src)
 
-    def score_request(self, payload: Mapping) -> dict:
-        future = self.submit_rows(payload)
+    def score_request(self, payload: Mapping, ctx=None) -> dict:
+        future = self.submit_rows(payload, ctx=ctx)
         try:
             result = future.result(timeout=self.request_timeout_s)
         except FutureTimeout:
@@ -357,16 +393,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": "bad_request",
                               "detail": "body is not valid JSON"})
             return
+        # the inbound trace context (router fan-out propagation); a
+        # malformed header parses to None and the request proceeds
+        ctx = request_trace.parse_header(
+            self.headers.get(request_trace.TRACE_HEADER)
+        )
         try:
             if self.path == "/v1/update":
                 self._reply(200, service.update_request(payload))
             elif self.path == "/v1/margins":
-                self._reply(200, service.margin_request(payload))
+                self._reply(200, service.margin_request(payload, ctx=ctx))
             elif self.path.startswith("/v1/admin/"):
                 op = self.path.rsplit("/", 1)[1]
                 self._reply(200, service.admin_request(op, payload))
             else:
-                self._reply(200, service.score_request(payload))
+                self._reply(200, service.score_request(payload, ctx=ctx))
         except Draining as e:
             self._reply(
                 503, {"error": "draining", "detail": str(e)},

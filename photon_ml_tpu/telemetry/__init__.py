@@ -69,6 +69,7 @@ from photon_ml_tpu.telemetry import (  # noqa: F401
     trace,
     xla,
 )
+from photon_ml_tpu.telemetry import requests  # noqa: F401  (needs trace)
 from photon_ml_tpu.telemetry.identity import member_artifact_path  # noqa: F401
 from photon_ml_tpu.telemetry.device import (  # noqa: F401
     install_compile_hooks,
@@ -119,6 +120,7 @@ __all__ = [
     "member_artifact_path",
     "xla",
     "profile",
+    "requests",
     "instrumented_jit",
     "record_collective",
     "XLA_REGISTRY",
@@ -189,6 +191,7 @@ def reset() -> None:
     memory.reset()
     xla.reset()
     profile.reset()
+    requests.reset()
     flush = _env_state["atexit_flush"]
     if flush is not None:
         import atexit

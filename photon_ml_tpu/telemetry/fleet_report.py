@@ -64,12 +64,17 @@ __all__ = [
     "FLEET_KEY_METRIC_DIRECTIONS",
     "FLEET_REPORT_FORMAT_VERSION",
     "discover_member_streams",
+    "discover_flight_records",
+    "discover_router_trace",
 ]
 
 FLEET_REPORT_FORMAT_VERSION = 1
 
 _PROC_RE = re.compile(r"\.proc-(\d+)\.jsonl$")
 _GEN_RE = re.compile(r"^gen(\d+)$")
+#: anchored at the exact ``.json`` suffix, so the ``.tmp`` shadow a kill
+#: mid-dump leaves behind is never adopted as a flight record
+_FLIGHT_RE = re.compile(r"^flight-proc-(\d+)\.json$")
 
 #: Aggregated fleet metrics and their goodness direction (the
 #: ``cli report --fleet --compare`` gate set). Single-run directions are
@@ -110,20 +115,8 @@ def discover_member_streams(fleet_dir: str) -> dict[int, dict]:
     works on a supervisor directory directly and reads the final
     generation's run.
     """
-    candidates = [fleet_dir, os.path.join(fleet_dir, "telemetry")]
-    for base in list(candidates):
-        gens = sorted(
-            (
-                d
-                for d in _glob.glob(os.path.join(base, "gen*"))
-                if os.path.isdir(d) and _GEN_RE.match(os.path.basename(d))
-            ),
-            key=lambda d: int(os.path.basename(d)[3:]),
-        )
-        if gens:
-            candidates.append(gens[-1])
     out: dict[int, dict] = {}
-    for directory in candidates:
+    for directory in _candidate_dirs(fleet_dir):
         for path in sorted(_glob.glob(os.path.join(directory, "*.jsonl"))):
             m = _PROC_RE.search(os.path.basename(path))
             if not m:
@@ -145,6 +138,57 @@ def discover_member_streams(fleet_dir: str) -> dict[int, dict]:
         if out:
             break
     return out
+
+
+def _candidate_dirs(fleet_dir: str) -> list[str]:
+    """The directories one fleet run's artifacts may live in: the dir
+    itself, a ``telemetry/`` subdirectory (the tools/fleet.py workdir
+    layout), and the NEWEST ``gen<g>`` generation under either."""
+    candidates = [fleet_dir, os.path.join(fleet_dir, "telemetry")]
+    for base in list(candidates):
+        gens = sorted(
+            (
+                d
+                for d in _glob.glob(os.path.join(base, "gen*"))
+                if os.path.isdir(d) and _GEN_RE.match(os.path.basename(d))
+            ),
+            key=lambda d: int(os.path.basename(d)[3:]),
+        )
+        if gens:
+            candidates.append(gens[-1])
+    return candidates
+
+
+def discover_flight_records(fleet_dir: str) -> dict[int, str]:
+    """``process_index -> flight-proc-<i>.json`` under the first
+    candidate directory holding any. Only the exact ``.json`` name
+    matches — a process killed mid-dump leaves a ``.tmp`` that is
+    invisible here (the crash-safety contract of the flight recorder)."""
+    for directory in _candidate_dirs(fleet_dir):
+        out: dict[int, str] = {}
+        for path in sorted(
+            _glob.glob(os.path.join(directory, "flight-proc-*.json"))
+        ):
+            m = _FLIGHT_RE.match(os.path.basename(path))
+            if m:
+                out[int(m.group(1))] = path
+        if out:
+            return out
+    return {}
+
+
+def discover_router_trace(fleet_dir: str) -> Optional[str]:
+    """The serving ROUTER's own span stream (``trace.router.jsonl``):
+    the supervisor process carries no member suffix, but its
+    ``request:route`` spans are one half of every fan-out trace."""
+    for directory in _candidate_dirs(fleet_dir):
+        for path in sorted(
+            _glob.glob(os.path.join(directory, "*.router.jsonl"))
+        ):
+            kind, _first = _classify_stream(path)
+            if kind == "trace":
+                return path
+    return None
 
 
 def _classify_stream(path: str) -> tuple[Optional[str], dict]:
@@ -187,6 +231,10 @@ class FleetMember:
     #: estimated clock skew vs the reference member (seconds; 0 for the
     #: reference itself or when no shared rendezvous exists)
     clock_skew_s: float = 0.0
+    #: adopted flight record (drain-path dump or supervisor harvest);
+    #: None when absent or torn
+    flight: Optional[dict] = None
+    flight_path: Optional[str] = None
     # derived-view memos: RunReport.key_metrics()/phase_tree() walk every
     # span, and a fleet report consumes them from rows(), key_metrics(),
     # markdown AND to_json — compute once per member (the underlying
@@ -274,9 +322,15 @@ class FleetMember:
             "heartbeat_gap_max_s": self.heartbeat_gap_max_s(),
             "last_heartbeat": last_hb,
             "clock_skew_s": round(self.clock_skew_s, 6),
+            "flight_records": (
+                len(self.flight.get("records") or [])
+                if self.flight is not None
+                else None
+            ),
             "artifacts": {
                 "trace": self.trace_path,
                 "telemetry": self.telemetry_path,
+                "flight": self.flight_path,
             },
         }
 
@@ -315,6 +369,12 @@ class FleetReport:
     fleet_dir: str
     members: list[FleetMember] = dataclasses.field(default_factory=list)
     num_processes: int = 0
+    #: the router's own span stream + pseudo-member (process_index -1),
+    #: joined into request traces but excluded from member accounting
+    router_trace_path: Optional[str] = None
+    router: Optional[FleetMember] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     # -- construction --------------------------------------------------------
 
@@ -350,6 +410,7 @@ class FleetReport:
             # its spans/heartbeats are real but the run is incomplete
             member.lost = not report.snapshot
             members.append(member)
+        flights = discover_flight_records(fleet_dir)
         expected = 0
         for member in members:
             nproc = member.header.get("num_processes")
@@ -357,6 +418,8 @@ class FleetReport:
                 expected = max(expected, nproc)
         if members:
             expected = max(expected, members[-1].process_index + 1)
+        if flights:
+            expected = max(expected, max(flights) + 1)
         present = {m.process_index for m in members}
         for proc in range(expected):
             if proc not in present:
@@ -364,10 +427,33 @@ class FleetReport:
                     FleetMember(process_index=proc, lost=True)
                 )
         members.sort(key=lambda m: m.process_index)
+        # adopt flight records (the torn-.tmp case parses to None and the
+        # member simply has no last words)
+        from photon_ml_tpu.telemetry import requests as _requests
+
+        for member in members:
+            path = flights.get(member.process_index)
+            if path is not None:
+                member.flight_path = path
+                member.flight = _requests.read_flight(path)
+        router_path = discover_router_trace(fleet_dir)
+        router = None
+        if router_path is not None:
+            kind, first = _classify_stream(router_path)
+            router = FleetMember(
+                process_index=-1,
+                trace_path=router_path,
+                report=RunReport.load(trace=router_path),
+                header=(
+                    first if first.get("type") == "trace_header" else {}
+                ),
+            )
         report = cls(
             fleet_dir=fleet_dir,
             members=members,
             num_processes=max(expected, len(members)),
+            router_trace_path=router_path,
+            router=router,
         )
         report._estimate_skew()
         return report
@@ -425,6 +511,123 @@ class FleetReport:
             )
         )
         return merged
+
+    def request_traces(self) -> list[dict[str, Any]]:
+        """Per-REQUEST joined views: every persisted ``request:*`` root
+        span (tail sampling — slow/degraded/errored/sampled) from the
+        router stream and each member stream, plus flight-record
+        entries, grouped by ``trace_id``. One user request that fanned
+        out through the router reads as one trace whose hops span
+        processes. Slowest first (by the slowest hop)."""
+        traces: dict[str, dict[str, Any]] = {}
+        seen: set[tuple] = set()
+
+        def _hop(trace_id: str, entry: dict[str, Any]) -> None:
+            key = (
+                trace_id,
+                entry.get("source"),
+                entry.get("name"),
+                entry.get("request_id"),
+                entry.get("dur_ms"),
+            )
+            if key in seen:
+                # a harvested flight re-reads the same span stream its
+                # member already persisted to — one hop, not two
+                return
+            seen.add(key)
+            traces.setdefault(
+                trace_id, {"trace_id": trace_id, "hops": []}
+            )["hops"].append(entry)
+
+        def _span_hop(member: FleetMember, label: str, s: dict) -> None:
+            name = s.get("name") or ""
+            if not name.startswith("request:"):
+                return
+            attrs = s.get("attrs") or {}
+            tid = attrs.get("trace_id")
+            if not tid or "request_id" not in attrs:
+                return  # phase children join via their root
+            entry: dict[str, Any] = {
+                "source": label,
+                "process_index": member.process_index,
+                "name": name[len("request:"):],
+                "request_id": attrs.get("request_id"),
+                "role": attrs.get("role"),
+                "status": attrs.get("status"),
+                "sampled_reason": attrs.get("sampled_reason"),
+                "dur_ms": attrs.get("dur_ms"),
+                "phases": attrs.get("phases") or {},
+                "attrs": attrs,
+            }
+            ts = s.get("ts")
+            if isinstance(ts, (int, float)):
+                abs_ts = member._abs_time(ts)
+                if abs_ts is not None:
+                    entry["abs_ts"] = round(abs_ts, 6)
+            _hop(tid, entry)
+
+        sources = list(self.members)
+        if self.router is not None:
+            sources.append(self.router)
+        for member in sources:
+            label = (
+                "router"
+                if member.process_index < 0
+                else f"proc-{member.process_index}"
+            )
+            for s in member.report.spans:
+                _span_hop(member, label, s)
+            fl = member.flight
+            if not fl:
+                continue
+            for r in fl.get("records") or []:
+                if not isinstance(r, dict):
+                    continue
+                if r.get("type") == "request" and r.get("trace_id"):
+                    _hop(
+                        r["trace_id"],
+                        {
+                            "source": label,
+                            "process_index": member.process_index,
+                            "name": r.get("name"),
+                            "request_id": r.get("request_id"),
+                            "role": r.get("role"),
+                            "status": r.get("status"),
+                            "dur_ms": r.get("dur_ms"),
+                            "phases": {
+                                p["name"]: p["ms"]
+                                for p in r.get("phases") or []
+                                if isinstance(p, dict) and "name" in p
+                            },
+                            "attrs": r.get("attrs") or {},
+                            "from_flight": True,
+                        },
+                    )
+                elif r.get("type") == "span":
+                    _span_hop(member, label, r)
+        out = list(traces.values())
+        for t in out:
+            durs = [
+                h["dur_ms"]
+                for h in t["hops"]
+                if isinstance(h.get("dur_ms"), (int, float))
+            ]
+            t["dur_ms"] = max(durs) if durs else None
+            t["status"] = (
+                "error"
+                if any(h.get("status") == "error" for h in t["hops"])
+                else "ok"
+            )
+            t["sources"] = sorted({h["source"] for h in t["hops"]})
+            t["hops"].sort(
+                key=lambda h: (
+                    h.get("abs_ts") is None,
+                    h.get("abs_ts") or 0.0,
+                    h.get("source") or "",
+                )
+            )
+        out.sort(key=lambda t: -(t["dur_ms"] or 0.0))
+        return out
 
     def rows(self) -> list[dict[str, Any]]:
         return [m.row() for m in self.members]
@@ -534,6 +737,80 @@ class FleetReport:
         lines.append("")
         return lines
 
+    def _requests_markdown(self, k: int = 10) -> list[str]:
+        traces = self.request_traces()
+        if not traces:
+            return []
+        lines = [
+            "## Requests",
+            "",
+            "_Persisted request traces (tail sampling: slow / degraded / "
+            "errored / explicitly sampled), joined across router and "
+            "member streams by `trace_id`; slowest hop first._",
+            "",
+            "| trace | ms | status | hops | phases |",
+            "|---|---|---|---|---|",
+        ]
+        for t in traces[:k]:
+            phases: list[str] = []
+            for h in t["hops"]:
+                for name, ms in (h.get("phases") or {}).items():
+                    if isinstance(ms, (int, float)):
+                        phases.append(f"{name} {ms:.1f}")
+            lines.append(
+                f"| `{t['trace_id']}` | {_fmt_or_unknown(t['dur_ms'])} | "
+                f"{t['status']} | {', '.join(t['sources'])} | "
+                f"{'; '.join(phases[:8])} |"
+            )
+        lines.append("")
+        return lines
+
+    def _last_words_markdown(self, k: int = 5) -> list[str]:
+        """Flight-recorder renderings for LOST members: the last entries
+        of each harvested/dumped flight record — what the member was
+        doing when it died."""
+        lines: list[str] = []
+        for m in self.members:
+            if not m.lost or not m.flight:
+                continue
+            recs = m.flight.get("records") or []
+            how = (
+                "harvested from the span-stream tail"
+                if m.flight.get("harvested")
+                else "drain-path dump"
+            )
+            note = (
+                f"_{len(recs)} record(s) in the final "
+                f"{_fmt(m.flight.get('window_s'))}s window ({how}"
+            )
+            if m.flight.get("dropped"):
+                note += f"; {m.flight['dropped']} ring drop(s)"
+            note += ")._"
+            lines += [f"### Last words — member {m.process_index}", "", note, ""]
+            for r in recs[-k:]:
+                if not isinstance(r, dict):
+                    continue
+                if r.get("type") == "request":
+                    desc = (
+                        f"- `{r.get('name')}` {r.get('status')} "
+                        f"{_fmt_or_unknown(r.get('dur_ms'))} ms"
+                    )
+                    if r.get("error"):
+                        desc += f" — {r['error']}"
+                else:
+                    desc = f"- span `{r.get('name')}`"
+                    dur = r.get("dur")
+                    if isinstance(dur, (int, float)):
+                        desc += f" {dur * 1000.0:.1f} ms"
+                    err = (r.get("attrs") or {}).get("error")
+                    if err:
+                        desc += f" — {err}"
+                lines.append(desc)
+            lines.append("")
+        if lines:
+            lines = ["## Flight recorder", ""] + lines
+        return lines
+
     def key_metrics(self) -> dict[str, float]:
         """The aggregated scalar summary ``compare()`` gates on."""
         out: dict[str, float] = {
@@ -615,6 +892,8 @@ class FleetReport:
             "members": self.rows(),
             "straggler": self.straggler(),
             "hot_executables": self.merged_hot_executables(),
+            "router_trace": self.router_trace_path,
+            "request_traces": self.request_traces()[:20],
         }
 
     def save_json(self, path: str) -> dict[str, Any]:
@@ -684,6 +963,8 @@ class FleetReport:
             )
         lines.append("")
 
+        lines += self._last_words_markdown()
+        lines += self._requests_markdown()
         lines += self._hot_executables_markdown()
 
         straggler = self.straggler()

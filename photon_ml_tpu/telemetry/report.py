@@ -821,6 +821,8 @@ class RunReport:
             "hot_executables": self.hot_executables(),
             "ingestion": self.ingestion_summary(),
             "serving": self.serving_summary(),
+            "requests": self.requests_summary(),
+            "slowest_requests": self.slowest_requests(),
             "recovery": self.recovery_summary(),
             "freshness": self.freshness_summary(),
             "counters": counters,
@@ -889,6 +891,7 @@ class RunReport:
         lines += self._accounting_markdown()
         lines += self._ingestion_markdown()
         lines += self._serving_markdown()
+        lines += self._requests_markdown()
         lines += self._recovery_markdown()
         lines += self._freshness_markdown()
         lines += self._memory_markdown()
@@ -1241,6 +1244,121 @@ class RunReport:
             out.append(
                 f"- {unseen} unseen-entity row(s) served fixed-effect-only"
             )
+        out.append("")
+        return out
+
+    def requests_summary(self) -> Optional[dict[str, Any]]:
+        """Request-scoped tracing accounting (the request layer of the
+        observability stack), or None when no request records were
+        taken: ring volume, tail-sampling persistence, drop-counted
+        overflow, and p50/p99 latency DECOMPOSED by phase (batcher
+        wait, device dispatch, fan-out, fold, ...)."""
+        c = self.snapshot.get("counters", {})
+        h = self.snapshot.get("histograms", {})
+        if not c.get("request.records"):
+            return None
+        total = h.get("request.total_ms") or {}
+        phases: dict[str, Any] = {}
+        prefix = "request.phase."
+        for name, summary in sorted(h.items()):
+            if name.startswith(prefix) and name.endswith("_ms"):
+                phases[name[len(prefix):-3]] = {
+                    "count": summary.get("count"),
+                    "p50_ms": summary.get("p50"),
+                    "p99_ms": summary.get("p99"),
+                }
+        return {
+            "records": int(c.get("request.records", 0)),
+            "persisted": int(c.get("request.persisted", 0)),
+            "dropped": int(c.get("telemetry.trace_dropped", 0)),
+            "p50_ms": total.get("p50"),
+            "p99_ms": total.get("p99"),
+            "phases": phases,
+        }
+
+    def slowest_requests(self, k: int = 10) -> list[dict[str, Any]]:
+        """The slowest PERSISTED request traces (``request:*`` root
+        spans from tail sampling), slowest first: trace/request ids,
+        terminal status, why it was persisted, and its phase
+        decomposition."""
+        out = []
+        for s in self.spans:
+            name = s.get("name") or ""
+            attrs = s.get("attrs") or {}
+            if not name.startswith("request:"):
+                continue
+            if "request_id" not in attrs:
+                continue  # phase child spans ride under their root
+            out.append(
+                {
+                    "name": name[len("request:"):],
+                    "trace_id": attrs.get("trace_id"),
+                    "request_id": attrs.get("request_id"),
+                    "role": attrs.get("role"),
+                    "status": attrs.get("status"),
+                    "sampled_reason": attrs.get("sampled_reason"),
+                    "dur_ms": attrs.get("dur_ms"),
+                    "phases": attrs.get("phases") or {},
+                    "error": attrs.get("error"),
+                }
+            )
+        out.sort(
+            key=lambda r: (
+                -(r["dur_ms"] if isinstance(r["dur_ms"], (int, float))
+                  else 0.0)
+            )
+        )
+        return out[:k]
+
+    def _requests_markdown(self, k: int = 5) -> list[str]:
+        rs = self.requests_summary()
+        if rs is None:
+            return []
+        out = ["## Requests", ""]
+        line = f"- {rs['records']} request record(s)"
+        if rs.get("p99_ms") is not None:
+            line += (
+                f" — p50 {rs['p50_ms']:.1f} ms / p99 {rs['p99_ms']:.1f} ms"
+            )
+        line += (
+            f"; {rs['persisted']} persisted by tail sampling"
+        )
+        if rs.get("dropped"):
+            line += f"; **{rs['dropped']} ring overflow drop(s)**"
+        out.append(line)
+        if rs["phases"]:
+            out += [
+                "",
+                "| phase | count | p50 ms | p99 ms |",
+                "|---|---|---|---|",
+            ]
+            for pname, p in rs["phases"].items():
+                out.append(
+                    f"| `{pname}` | {p['count']} | "
+                    f"{_fmt_or_unknown(p['p50_ms'])} | "
+                    f"{_fmt_or_unknown(p['p99_ms'])} |"
+                )
+        slow = self.slowest_requests(k=k)
+        if slow:
+            out += [
+                "",
+                "_Slowest persisted traces (tail sampling: "
+                "slow / degraded / errored / sampled):_",
+                "",
+                "| request | ms | status | why | phases |",
+                "|---|---|---|---|---|",
+            ]
+            for r in slow:
+                phases = "; ".join(
+                    f"{n} {ms:.1f}"
+                    for n, ms in r["phases"].items()
+                    if isinstance(ms, (int, float))
+                )
+                out.append(
+                    f"| `{r['name']}` `{r['trace_id']}` | "
+                    f"{_fmt_or_unknown(r['dur_ms'])} | {r['status']} | "
+                    f"{r['sampled_reason']} | {phases} |"
+                )
         out.append("")
         return out
 

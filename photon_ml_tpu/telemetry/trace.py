@@ -31,6 +31,7 @@ from __future__ import annotations
 import datetime
 import itertools
 import json
+import os
 import threading
 import time
 from contextlib import contextmanager
@@ -285,6 +286,30 @@ class Tracer:
         if cur is not None:
             cur.add_event(name, ts=self.now(), **attrs)
 
+    def emit(
+        self,
+        name: str,
+        ts: float,
+        dur: float,
+        parent: Optional[int] = None,
+        **attrs: Any,
+    ) -> int:
+        """Record an already-measured span retroactively (no context
+        manager): the request tracer's tail sampler decides AFTER a
+        request finished whether its phases deserve full spans. Returns
+        the span id so callers can parent children under it."""
+        s = Span(
+            name=name,
+            span_id=next(self._ids),
+            parent_id=parent,
+            ts=float(ts),
+            thread=threading.current_thread().name,
+            attrs=dict(attrs),
+        )
+        s.dur = max(0.0, float(dur))
+        self._finish(s)
+        return s.span_id
+
     def _finish(self, s: Span) -> None:
         dropped = 0
         with self._lock:
@@ -335,14 +360,22 @@ set_annotation_factory = TRACER.set_annotation_factory
 # -- Chrome trace (Perfetto) export ------------------------------------------
 
 
-def to_chrome_trace(records: Iterable[dict]) -> dict:
+def to_chrome_trace(records: Iterable[dict] | str) -> dict:
     """Convert span dicts (``Span.to_dict()`` / JSONL lines) to the Chrome
     trace-event JSON object Perfetto and chrome://tracing load directly.
 
     Complete spans become ``ph: "X"`` duration events; span events become
     ``ph: "i"`` thread-scoped instants. Timestamps are microseconds on the
     tracer's monotonic timebase.
+
+    ``records`` may instead be a FLEET telemetry directory path: every
+    member's ``trace.proc-<i>.jsonl`` stream merges into one file with a
+    Perfetto track per process (``proc-<i> (<hostname>)``) and timestamps
+    aligned through the PR 13 skew anchors — a request that fanned out
+    across members renders as one timeline.
     """
+    if isinstance(records, str):
+        return _fleet_chrome_trace(records)
     tids: dict[str, int] = {}
     events: list[dict] = []
     meta: list[dict] = []
@@ -393,6 +426,100 @@ def to_chrome_trace(records: Iterable[dict]) -> dict:
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
+def _fleet_chrome_trace(fleet_dir: str) -> dict:
+    """One Chrome trace for a whole fleet directory: per-process tracks,
+    member timelines aligned on FleetReport's absolute (anchor + skew)
+    timebase, origin at the earliest anchored span."""
+    # local import: fleet_report imports report which imports this module
+    from photon_ml_tpu.telemetry.fleet_report import FleetReport
+
+    fleet = FleetReport.load(fleet_dir)
+    merged = fleet.merged_spans()
+    anchored = [
+        r["abs_ts"] for r in merged if isinstance(r.get("abs_ts"), (int, float))
+    ]
+    t0 = min(anchored) if anchored else 0.0
+    hosts = {m.process_index: m.hostname for m in fleet.members}
+    events: list[dict] = []
+    meta: list[dict] = []
+    pids: set[int] = set()
+    tids: dict[tuple[int, str], int] = {}
+
+    def pid_of(proc: int) -> int:
+        pid = int(proc) + 1  # Perfetto hides pid 0
+        if pid not in pids:
+            pids.add(pid)
+            label = f"proc-{proc}"
+            if hosts.get(proc):
+                label += f" ({hosts[proc]})"
+            meta.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "args": {"name": label},
+                }
+            )
+        return pid
+
+    def tid_of(pid: int, thread: str) -> int:
+        key = (pid, thread)
+        if key not in tids:
+            tids[key] = sum(1 for k in tids if k[0] == pid) + 1
+            meta.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tids[key],
+                    "args": {"name": thread},
+                }
+            )
+        return tids[key]
+
+    for rec in merged:
+        if rec.get("type") != "span":
+            continue
+        ts = rec.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        pid = pid_of(int(rec.get("process_index") or 0))
+        t = tid_of(pid, rec.get("thread", "main"))
+        abs_ts = rec.get("abs_ts")
+        # the per-record delta from member-local to fleet-absolute time;
+        # an unanchored stream keeps its local timebase (better skewed
+        # than dropped)
+        shift = (abs_ts - t0 - ts) if isinstance(abs_ts, (int, float)) else 0.0
+        events.append(
+            {
+                "name": rec["name"],
+                "cat": "span",
+                "ph": "X",
+                "ts": round((ts + shift) * 1e6, 3),
+                "dur": round((rec.get("dur") or 0.0) * 1e6, 3),
+                "pid": pid,
+                "tid": t,
+                "args": rec.get("attrs", {}),
+            }
+        )
+        for ev in rec.get("events", ()):
+            if not isinstance(ev.get("ts"), (int, float)):
+                continue
+            events.append(
+                {
+                    "name": ev["name"],
+                    "cat": "event",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": round((ev["ts"] + shift) * 1e6, 3),
+                    "pid": pid,
+                    "tid": t,
+                    "args": ev.get("attrs", {}),
+                }
+            )
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
 def perfetto_path(trace_out: str) -> str:
     """The sibling ``.perfetto.json`` path for a span JSONL path (shared by
     every driver that auto-exports a Chrome trace next to its JSONL)."""
@@ -401,10 +528,17 @@ def perfetto_path(trace_out: str) -> str:
 
 
 def export_chrome_trace(jsonl_path: str, out_path: str) -> int:
-    """Convert a span JSONL file to a Chrome/Perfetto trace JSON file.
+    """Convert a span JSONL file — or a fleet telemetry DIRECTORY of
+    ``trace.proc-<i>.jsonl`` streams — to one Chrome/Perfetto trace file.
 
     Returns the number of trace events written. Unparseable lines are
     skipped (a crashed run leaves a truncated last line)."""
+    if os.path.isdir(jsonl_path):
+        doc = to_chrome_trace(jsonl_path)
+        from photon_ml_tpu.utils.atomic import atomic_write_json
+
+        atomic_write_json(out_path, doc)
+        return len(doc["traceEvents"])
     records = []
     with open(jsonl_path, encoding="utf-8") as fh:
         for line in fh:

@@ -77,6 +77,11 @@ EXPECTED_POINTS = {
     "incremental.warm_restore",
     "incremental.delta_scan",
     "incremental.publish",
+    # request-scoped tracing (plain point — the dump itself rides
+    # utils.atomic tmp-then-rename; tools/chaos.py --serving-fleet row
+    # flight_dump_kill kills mid-dump and proves fleet discovery never
+    # adopts the torn .tmp; ring/parse coverage in tests/test_requests)
+    "telemetry.flight_dump",
 }
 
 WRITE_PATH_POINTS = [
@@ -118,6 +123,7 @@ def test_registry_catalog_is_complete_and_stable():
     import photon_ml_tpu.parallel.fleet_status  # noqa: F401
     import photon_ml_tpu.parallel.multihost  # noqa: F401
     import photon_ml_tpu.incremental  # noqa: F401
+    import photon_ml_tpu.telemetry.requests  # noqa: F401
 
     registered = faults.registered_points()
     assert set(registered) == EXPECTED_POINTS

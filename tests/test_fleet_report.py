@@ -402,7 +402,9 @@ def test_fleet_report_member_with_no_artifacts_is_synthesized_lost(
     assert report.num_processes == 2
     assert report.lost_members() == [1]
     rows = {r["process_index"]: r for r in report.rows()}
-    assert rows[1]["artifacts"] == {"trace": None, "telemetry": None}
+    assert rows[1]["artifacts"] == {
+        "trace": None, "telemetry": None, "flight": None,
+    }
 
 
 def test_discover_falls_back_to_newest_generation_dir(tmp_path):

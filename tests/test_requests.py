@@ -1,0 +1,454 @@
+"""Request-scoped tracing (ISSUE 18): trace-context propagation
+(``X-Photon-Trace`` mint/parse roundtrip), the per-process request RING
+(overflow evicts oldest, drop-counted), TAIL SAMPLING (persist only
+slow / degraded / errored / explicitly-sampled requests as ``request:*``
+spans), the crash-safe FLIGHT RECORDER (atomic dump, the
+``telemetry.flight_dump`` fault seam, torn-tail harvest for hard-killed
+members), the fleet-report join (one user request reads as one trace
+spanning router + member streams, with "last words" for lost members),
+and the report/CLI surfaces (``requests_summary``, ``--requests``,
+merged fleet Chrome export)."""
+
+import json
+import os
+
+import pytest
+
+from photon_ml_tpu import faults, telemetry
+from photon_ml_tpu.cli import report as cli_report
+from photon_ml_tpu.telemetry import fleet_report, trace
+from photon_ml_tpu.telemetry import requests as rq
+from photon_ml_tpu.telemetry.report import RunReport
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    faults.clear_plan()
+
+
+def _counter(name: str) -> int:
+    return int(telemetry.snapshot()["counters"].get(name, 0))
+
+
+# ---------------------------------------------------------------------------
+# context propagation
+# ---------------------------------------------------------------------------
+
+
+def test_context_header_roundtrip():
+    ctx = rq.make_context()
+    assert ";s=1" not in ctx.to_header()
+    back = rq.parse_header(ctx.to_header())
+    assert (back.trace_id, back.request_id) == (ctx.trace_id, ctx.request_id)
+    assert back.sampled is False
+
+    sampled = rq.make_context(sampled=True)
+    assert sampled.to_header().endswith(";s=1")
+    back = rq.parse_header(sampled.to_header())
+    assert back.sampled is True
+    # ids are process-unique and monotone per mint
+    assert sampled.trace_id != ctx.trace_id
+    assert sampled.request_id != ctx.request_id
+
+
+@pytest.mark.parametrize(
+    "value",
+    [None, "", "abc", "a/b/c", "/b", "a/", "//", ";s=1", 123, b"a/b"],
+)
+def test_parse_header_malformed_is_none_never_raises(value):
+    # a bad header must never fail the request it rode in on
+    assert rq.parse_header(value) is None
+
+
+def test_parse_header_tolerates_whitespace_and_unknown_flags():
+    ctx = rq.parse_header("  tid/rid;x=9;s=1  ")
+    assert (ctx.trace_id, ctx.request_id, ctx.sampled) == ("tid", "rid", True)
+    assert rq.parse_header("tid/rid;x=9").sampled is False
+
+
+# ---------------------------------------------------------------------------
+# ring overflow + drop accounting
+# ---------------------------------------------------------------------------
+
+
+def test_request_ring_overflow_evicts_oldest_and_counts_drops():
+    rq.configure(ring_limit=4)
+    for i in range(7):
+        rq.finish(rq.begin(f"r{i}"))
+    recs = rq.records()
+    assert [r["name"] for r in recs] == ["r3", "r4", "r5", "r6"]
+    assert rq.REQUESTS.dropped == 3
+    assert _counter("telemetry.trace_dropped") == 3
+    assert _counter("request.records") == 7
+    # reset restores the default cap and clears drop accounting
+    rq.reset()
+    assert rq.REQUESTS.dropped == 0
+    assert rq.REQUESTS._ring_limit == rq.DEFAULT_RING_LIMIT
+
+
+def test_tracer_buffer_overflow_evicts_oldest_and_counts_drops():
+    telemetry.configure(buffer_limit=4)
+    now = trace.TRACER.now()
+    for i in range(10):
+        trace.TRACER.emit(f"s{i}", ts=now, dur=0.001)
+    kept = [s.name for s in trace.finished_spans()]
+    assert kept == ["s6", "s7", "s8", "s9"]
+    assert trace.TRACER.dropped_spans == 6
+    assert _counter("trace.dropped_spans") == 6
+
+
+def test_disabled_tracer_records_nothing():
+    rq.configure(enabled=False)
+    assert rq.begin("x") is None
+    assert rq.finish(None) is None
+    assert rq.records() == []
+    rq.configure(enabled=True)
+    assert rq.begin("x") is not None
+
+
+# ---------------------------------------------------------------------------
+# tail sampling
+# ---------------------------------------------------------------------------
+
+
+def _persisted(name="x"):
+    return trace.finished_spans(f"request:{name}")
+
+
+def test_tail_sampling_persists_error_degraded_sampled_slow():
+    # fast + unsampled + threshold still filling: ring-only, no spans
+    rq.finish(rq.begin("x"))
+    assert _persisted() == []
+
+    rq.finish(rq.begin("x"), status="error", error="boom")
+    (err,) = _persisted()
+    assert err.attrs["sampled_reason"] == "error"
+    assert err.attrs["error"] == "boom"
+
+    rq.finish(rq.begin("x", degraded=True))
+    assert [s.attrs["sampled_reason"] for s in _persisted()] == [
+        "error", "degraded",
+    ]
+
+    rec = rq.begin("x", ctx=rq.make_context(sampled=True))
+    rq.finish(rec)
+    assert _persisted()[-1].attrs["sampled_reason"] == "sampled"
+
+    # a pinned slow threshold of 0 makes everything "slow"
+    rq.configure(slow_threshold_ms=0.0)
+    rq.finish(rq.begin("x"))
+    assert _persisted()[-1].attrs["sampled_reason"] == "slow"
+    # ...and None restores the rolling p99 (still unfilled -> not slow)
+    rq.configure(slow_threshold_ms=None)
+    before = len(_persisted())
+    rq.finish(rq.begin("x"))
+    assert len(_persisted()) == before
+    assert _counter("request.persisted") == before
+
+
+def test_error_outranks_sampled_and_root_carries_phase_children():
+    rec = rq.begin(
+        "score", ctx=rq.make_context(sampled=True), role="member",
+        version="v3", fleet_size=4,
+    )
+    rec.phase("batcher_wait", 2.0)
+    rec.phase("device_dispatch", 1.0)
+    rq.finish(rec, status="error", error="shed")
+    (root,) = trace.finished_spans("request:score")
+    assert root.attrs["sampled_reason"] == "error"  # error > sampled
+    assert root.attrs["trace_id"] == rec.ctx.trace_id
+    assert root.attrs["version"] == "v3"
+    assert root.attrs["fleet_size"] == 4
+    assert root.attrs["phases"] == {
+        "batcher_wait": 2.0, "device_dispatch": 1.0,
+    }
+    children = trace.finished_spans("request:score:batcher_wait")
+    assert children and children[0].parent_id == root.span_id
+    assert children[0].attrs["trace_id"] == rec.ctx.trace_id
+
+
+def test_rolling_p99_threshold_engages_after_min_samples():
+    assert rq.REQUESTS.slow_threshold_ms is None
+    for _ in range(128):
+        rq.finish(rq.begin("x"))
+    # 128 finishes > _MIN_SAMPLES with recompute every 32: engaged
+    assert rq.REQUESTS.slow_threshold_ms is not None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: dump, read, fault seam, harvest
+# ---------------------------------------------------------------------------
+
+
+def test_flight_path_naming_contract(monkeypatch):
+    assert rq.flight_path("/x", 3) == "/x/flight-proc-3.json"
+    monkeypatch.setenv("PHOTON_PROC_ID", "2")
+    assert rq.flight_path("/x").endswith("flight-proc-2.json")
+    assert fleet_report._FLIGHT_RE.match("flight-proc-3.json")
+    # the atomic-write shadow must never look adoptable
+    assert not fleet_report._FLIGHT_RE.match("flight-proc-3.json.tmp")
+
+
+def test_flight_dump_read_roundtrip(tmp_path):
+    for i in range(5):
+        rq.finish(rq.begin(f"r{i}"))
+    path = str(tmp_path / "flight-proc-0.json")
+    assert rq.flight_dump(path) == 5
+    doc = rq.read_flight(path)
+    assert doc["type"] == "flight_record"
+    assert [r["name"] for r in doc["records"]] == [f"r{i}" for i in range(5)]
+    assert doc["window_s"] == 30.0
+    assert doc["dropped"] == 0
+    assert "anchor_unix_s" in doc and "monotonic_anchor" in doc
+    # the window filter: nothing just-finished survives last_s=0
+    assert rq.flight_dump(path, last_s=0.0) == 0
+
+    # read_flight: absent / torn / not-a-flight-record -> None
+    assert rq.read_flight(str(tmp_path / "missing.json")) is None
+    (tmp_path / "torn.json").write_text('{"type": "flight_record", "rec')
+    assert rq.read_flight(str(tmp_path / "torn.json")) is None
+    (tmp_path / "other.json").write_text('{"type": "metrics"}')
+    assert rq.read_flight(str(tmp_path / "other.json")) is None
+
+
+def test_flight_dump_fault_seam_fails_soft(tmp_path):
+    rq.finish(rq.begin("x"))
+    faults.install_plan(
+        faults.FaultPlan(
+            [faults.FaultRule("telemetry.flight_dump", action="io", nth=1)]
+        )
+    )
+    path = str(tmp_path / "flight-proc-0.json")
+    # the drain path must survive a failed dump: None, counted, no file
+    assert rq.flight_dump(path) is None
+    assert _counter("telemetry.flight_dump_failures") == 1
+    assert not os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")
+    # seam disarmed: the retry lands atomically
+    faults.clear_plan()
+    assert rq.flight_dump(path) == 1
+    assert rq.read_flight(path)["records"][0]["name"] == "x"
+
+
+def test_tail_records_drops_torn_first_and_last_lines(tmp_path):
+    path = tmp_path / "trace.proc-0.jsonl"
+    header = {"type": "trace_header", "anchor_unix_s": 1.0,
+              "monotonic_anchor": 0.0, "hostname": "h"}
+    lines = [json.dumps(header)]
+    for i in range(50):
+        lines.append(json.dumps(
+            {"type": "span", "name": f"s{i}", "ts": float(i), "dur": 0.001,
+             "attrs": {"pad": "x" * 64}}
+        ))
+    path.write_text("\n".join(lines) + "\n" + '{"type": "span", "na')
+    # full read: torn LAST line (hard kill mid-write) skipped silently
+    hdr, recs = rq.tail_records(str(path))
+    assert hdr["type"] == "trace_header"
+    assert len(recs) == 51  # header line parses as a record too
+    assert recs[-1]["name"] == "s49"
+    # bounded read: the seek lands mid-line, the torn FIRST line drops,
+    # the header still comes from the file's real first line
+    hdr, recs = rq.tail_records(str(path), max_tail_bytes=400)
+    assert hdr["type"] == "trace_header"
+    assert 0 < len(recs) < 10
+    assert all(isinstance(r, dict) for r in recs)
+
+
+def test_harvest_flight_windows_and_anchors(tmp_path):
+    path = tmp_path / "trace.proc-1.jsonl"
+    header = {"type": "trace_header", "anchor_unix_s": 123.0,
+              "monotonic_anchor": 5.0, "hostname": "h", "process_index": 1}
+    spans = [
+        {"type": "span", "name": "request:old", "ts": 0.0, "dur": 0.001},
+        {"type": "span", "name": "request:new", "ts": 100.0, "dur": 0.002},
+    ]
+    path.write_text(
+        "\n".join(json.dumps(r) for r in [header] + spans)
+        + "\n" + '{"torn'
+    )
+    out = str(tmp_path / "flight-proc-1.json")
+    assert rq.harvest_flight(str(path), out, last_s=10.0) == 1
+    doc = rq.read_flight(out)
+    assert doc["harvested"] is True
+    assert doc["process_index"] == 1
+    assert doc["anchor_unix_s"] == 123.0
+    assert [r["name"] for r in doc["records"]] == ["request:new"]
+    # a missing or span-free stream harvests to None, writes nothing
+    missing_out = str(tmp_path / "flight-proc-2.json")
+    assert rq.harvest_flight(str(tmp_path / "nope.jsonl"), missing_out) is None
+    assert not os.path.exists(missing_out)
+
+
+# ---------------------------------------------------------------------------
+# the fleet join: one request across router + members (+ flight records)
+# ---------------------------------------------------------------------------
+
+
+def _build_fleet_dir(tmp_path, monkeypatch):
+    """A synthetic 2-member fleet dir carrying ONE fanned-out request:
+    router stream + member streams share a trace_id; member 1 "dies"
+    (no metrics snapshot, torn trace tail) and gets a harvested flight
+    record."""
+    d = tmp_path / "fleet"
+    d.mkdir(exist_ok=True)
+    monkeypatch.delenv("PHOTON_PROC_ID", raising=False)
+    monkeypatch.setenv("PHOTON_PROC_COUNT", "2")
+
+    telemetry.configure(trace_out=str(d / "trace.router.jsonl"))
+    ctx = rq.make_context(sampled=True)
+    rec = rq.begin("route", ctx=ctx, role="router", fleet_size=2)
+    rec.phase("fanout", 2.0)
+    rq.finish(rec)
+
+    monkeypatch.setenv("PHOTON_PROC_ID", "0")
+    telemetry.configure(
+        trace_out=telemetry.member_artifact_path(str(d / "trace.jsonl"))
+    )
+    rec = rq.begin("margins", ctx=ctx, role="member", version="v1",
+                   fleet_size=2)
+    rec.phase("engine_dispatch", 1.5)
+    rq.finish(rec)
+    (d / "telemetry.proc-0.jsonl").write_text(
+        json.dumps({"type": "metrics", "snapshot": {"counters": {}}}) + "\n"
+    )
+
+    monkeypatch.setenv("PHOTON_PROC_ID", "1")
+    m1 = telemetry.member_artifact_path(str(d / "trace.jsonl"))
+    telemetry.configure(trace_out=m1)
+    rec = rq.begin("margins", ctx=ctx, role="member", version="v1",
+                   fleet_size=2)
+    rec.phase("engine_dispatch", 1.1)
+    rq.finish(rec)
+    telemetry.configure(trace_out=str(tmp_path / "scratch.jsonl"))
+    with open(m1, "a", encoding="utf-8") as fh:
+        fh.write('{"type": "span", "torn')  # hard kill mid-write
+    assert rq.harvest_flight(m1, rq.flight_path(str(d), 1)) is not None
+
+    monkeypatch.delenv("PHOTON_PROC_ID", raising=False)
+    return d, ctx
+
+
+def test_fleet_report_joins_one_request_across_processes(
+    tmp_path, monkeypatch
+):
+    d, ctx = _build_fleet_dir(tmp_path, monkeypatch)
+    fr = fleet_report.FleetReport.load(str(d))
+    assert [m.process_index for m in fr.members] == [0, 1]
+    assert fr.router is not None and fr.router.process_index == -1
+    assert fr.router_trace_path.endswith("trace.router.jsonl")
+
+    traces = fr.request_traces()
+    (t,) = [t for t in traces if t["trace_id"] == ctx.trace_id]
+    # one user request spans the router and BOTH members
+    assert t["sources"] == ["proc-0", "proc-1", "router"]
+    assert t["status"] == "ok"
+    by_source = {h["source"]: h for h in t["hops"]}
+    assert by_source["router"]["phases"] == {"fanout": 2.0}
+    for proc in ("proc-0", "proc-1"):
+        hop = by_source[proc]
+        assert hop["phases"]  # non-empty phase decomposition
+        assert hop["attrs"]["version"] == "v1"
+        assert hop["attrs"]["fleet_size"] == 2
+    # the harvested flight re-read the same stream member 1 persisted
+    # to: still exactly one hop per process
+    assert len(t["hops"]) == 3
+
+
+def test_fleet_report_last_words_for_lost_member(tmp_path, monkeypatch):
+    d, _ctx = _build_fleet_dir(tmp_path, monkeypatch)
+    fr = fleet_report.FleetReport.load(str(d))
+    assert fr.lost_members() == [1]
+    m1 = fr.members[1]
+    assert m1.flight is not None and m1.flight.get("harvested")
+    assert m1.flight_path.endswith("flight-proc-1.json")
+    md = fr.to_markdown()
+    assert "## Flight recorder" in md
+    assert "Last words — member 1" in md
+    assert "## Requests" in md
+    assert "router" in md
+    doc = fr.to_json()
+    assert doc["request_traces"]
+    assert doc["router_trace"] == fr.router_trace_path
+
+
+def test_fleet_chrome_export_merges_member_tracks(tmp_path, monkeypatch):
+    d, _ctx = _build_fleet_dir(tmp_path, monkeypatch)
+    tc = telemetry.to_chrome_trace(str(d))
+    names = {
+        e["args"]["name"]: e["pid"]
+        for e in tc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert any(k.startswith("proc-0") for k in names)
+    assert any(k.startswith("proc-1") for k in names)
+    assert len({pid for pid in names.values()}) == 2
+    assert any(
+        e.get("ph") == "X" and e["name"].startswith("request:")
+        for e in tc["traceEvents"]
+    )
+    out = str(tmp_path / "fleet.perfetto.json")
+    telemetry.export_chrome_trace(str(d), out)
+    with open(out, encoding="utf-8") as fh:
+        assert json.load(fh)["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# RunReport + CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+def _build_run_artifacts(tmp_path):
+    tpath = str(tmp_path / "run.trace.jsonl")
+    mpath = str(tmp_path / "run.metrics.jsonl")
+    telemetry.configure(trace_out=tpath)
+    rec = rq.begin("score", ctx=rq.make_context(sampled=True))
+    rec.phase("batcher_wait", 3.0)
+    rq.finish(rec)
+    rq.finish(rq.begin("score"), status="error", error="boom")
+    rq.finish(rq.begin("score"))  # ring-only
+    telemetry.flush_metrics(mpath)
+    return tpath, mpath
+
+
+def test_run_report_requests_summary_and_slowest(tmp_path):
+    tpath, mpath = _build_run_artifacts(tmp_path)
+    run = RunReport.load(trace=tpath, telemetry=mpath)
+    rs = run.requests_summary()
+    assert rs["records"] == 3
+    assert rs["persisted"] == 2
+    assert rs["dropped"] == 0
+    assert rs["p99_ms"] is not None
+    assert rs["phases"]["batcher_wait"]["count"] == 1
+    slow = run.slowest_requests()
+    assert len(slow) == 2
+    assert {r["sampled_reason"] for r in slow} == {"sampled", "error"}
+    assert all(r["trace_id"] for r in slow)
+    md = run.to_markdown()
+    assert "## Requests" in md
+    assert "persisted by tail sampling" in md
+    assert run.to_json()["requests"]["records"] == 3
+
+
+def test_run_report_without_requests_has_no_section():
+    run = RunReport(spans=[], snapshot={"counters": {"x": 1}})
+    assert run.requests_summary() is None
+    assert "## Requests" not in run.to_markdown()
+
+
+def test_cli_report_requests_flag(tmp_path, capsys):
+    tpath, mpath = _build_run_artifacts(tmp_path)
+    assert cli_report.main(
+        ["--trace", tpath, "--telemetry", mpath, "--requests", "5"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "## Requests" in out
+    assert "Slowest persisted traces" in out
+
+    # a run with no request records says so instead of an empty report
+    empty = str(tmp_path / "empty.trace.jsonl")
+    telemetry.reset()
+    telemetry.configure(trace_out=empty)
+    telemetry.configure(trace_out=str(tmp_path / "scratch2.jsonl"))
+    assert cli_report.main(["--trace", empty, "--requests"]) == 0
+    assert "No request traces" in capsys.readouterr().out

@@ -536,9 +536,15 @@ def test_three_process_fleet_parity_budget_kill_drain(
     """The acceptance e2e: a REAL 3-process `cli serve --member` fleet
     under a per-member HBM budget the FULL model exceeds (a) matches the
     single-process engine to 1e-6, (b) survives a SIGKILLed member with
-    zero failed requests and exact degraded accounting, and (c) drains
-    every survivor to exit 75 on SIGTERM."""
+    zero failed requests and exact degraded accounting, (c) drains
+    every survivor to exit 75 on SIGTERM, and (d) — ISSUE 18 — yields
+    ONE joined per-request trace spanning router + members, plus a
+    harvested flight record ("last words") for the hard-killed member
+    in `cli report --fleet`."""
+    from photon_ml_tpu.cli import report as cli_report
     from photon_ml_tpu.data.model_store import load_game_model
+    from photon_ml_tpu.telemetry import requests as rq
+    from photon_ml_tpu.telemetry.fleet_report import FleetReport
 
     model = load_game_model(published["version_dir"])
     full_bytes = serving_table_bytes(model)
@@ -554,6 +560,7 @@ def test_three_process_fleet_parity_budget_kill_drain(
     )
     os.makedirs(spec.announce_dir(), exist_ok=True)
     os.makedirs(spec.fleet_dir(), exist_ok=True)
+    tdir = os.path.dirname(spec.telemetry_base())
     members = {
         m: fleet_tools._launch_serving_member(spec, m, 3, 0)
         for m in range(3)
@@ -563,10 +570,16 @@ def test_three_process_fleet_parity_budget_kill_drain(
         fleet_tools._wait_for_epoch(
             spec, 0, 3, time.monotonic() + spec.warm_timeout_s
         )
+        # router-side span stream + head-sample EVERY request: members
+        # see `X-Photon-Trace ...;s=1` and persist their half of the tree
+        telemetry.configure(
+            trace_out=os.path.join(tdir, "trace.router.jsonl")
+        )
         router = FleetRouter(
             spec.announce_dir(), published["lookups"],
             task=published["task"], link=published["link"],
             member_timeout_s=3.0, cooldown_s=0.2, backoff_s=0.02,
+            sample_every=1,
         )
         router.refresh()
         rows = _request_rows()
@@ -592,12 +605,46 @@ def test_three_process_fleet_parity_budget_kill_drain(
             {k: v for k, v in r.items() if k != "ids"} for r in rows
         ]))
         np.testing.assert_allclose(got[lost], fe_only[lost], atol=1e-6)
+        # the supervisor-side flight harvest: member 1 never ran its own
+        # drain dump, so its "last words" come from the span-stream tail
+        assert rq.harvest_flight(
+            telemetry.member_artifact_path(spec.trace_base(), 1),
+            rq.flight_path(tdir, 1),
+        )
         # graceful drain: SIGTERM -> drain -> exit 75 (the supervisor's
         # relaunch-vs-crash verdict keys on this)
         for m in (0, 2):
             members[m].proc.send_signal(signal.SIGTERM)
         assert members[0].proc.wait(timeout=30) == 75
         assert members[2].proc.wait(timeout=30) == 75
+
+        # -- ISSUE 18 acceptance: the joined per-request trace ------------
+        fr = FleetReport.load(str(tmp_path))
+        joined = [
+            t for t in fr.request_traces()
+            if "router" in t["sources"]
+            and sum(s.startswith("proc-") for s in t["sources"]) >= 2
+        ]
+        assert joined, "no request trace spans router + >=2 members"
+        member_hops = [
+            h for h in joined[0]["hops"]
+            if h["source"].startswith("proc-")
+        ]
+        for hop in member_hops:
+            assert hop["phases"], hop  # non-empty phase decomposition
+            assert "version" in hop["attrs"]
+            assert hop["attrs"]["fleet_size"] == 3
+        # the hard-killed member's flight record surfaces as last words
+        # through the real CLI fleet report
+        assert 1 in [m.process_index for m in fr.members if m.flight]
+        out_md = str(tmp_path / "fleet-report.md")
+        assert cli_report.main(
+            ["--fleet", str(tmp_path), "--out", out_md]
+        ) == 0
+        with open(out_md, encoding="utf-8") as fh:
+            content = fh.read()
+        assert "Last words — member 1" in content
+        assert "## Requests" in content
     finally:
         if router is not None:
             router.close()
@@ -610,15 +657,20 @@ def test_three_process_fleet_parity_budget_kill_drain(
 @pytest.mark.chaos_serving
 def test_serving_chaos_tier1_slice(tmp_path):
     """Budget-capped tier-1 slice of the serving chaos matrix: the three
-    IN-PROCESS seam rows (member_load_io, route_fanout_io, resize_swap).
-    The full matrix — including the subprocess hard-kill-under-traffic
-    row — runs under --slow / `python -m tools.chaos --serving-fleet`."""
+    IN-PROCESS seam rows (member_load_io, route_fanout_io, resize_swap)
+    plus the cheap flight-recorder kill row (flight_dump_kill: exit 113
+    mid-dump, fleet discovery never adopts the torn .tmp). The full
+    matrix — including the subprocess hard-kill-under-traffic row —
+    runs under --slow / `python -m tools.chaos --serving-fleet`."""
     from tools import chaos
 
     budget = float(os.environ.get("PHOTON_CHAOS_BUDGET_S", "300"))
     report = chaos.run_serving_matrix(
         str(tmp_path),
-        rows=["member_load_io", "route_fanout_io", "resize_swap"],
+        rows=[
+            "member_load_io", "route_fanout_io", "resize_swap",
+            "flight_dump_kill",
+        ],
         budget_s=budget,
     )
     if report["skipped"]:
@@ -631,6 +683,9 @@ def test_serving_chaos_tier1_slice(tmp_path):
         return
     assert report["ok"], json.dumps(report, indent=2, default=str)
     assert report["results"]["route_fanout_io"]["degraded_scores"] > 0
+    flight = report["results"]["flight_dump_kill"]
+    assert flight["armed_rc"] == 113
+    assert flight["adopted_after_kill"] == []
 
 
 @pytest.mark.slow

@@ -62,6 +62,13 @@ SYNC_SEEDS = (
     # trap on the hottest path in the process). A rename surfaces as
     # W002, not silence.
     "photon_ml_tpu.telemetry.profile.profile_dispatch",
+    # request-scoped tracing (ISSUE 18): finish() runs on every request
+    # (batcher dispatcher thread, router pool threads) and flight_dump()
+    # on the SIGTERM drain path — a device sync inside trace bookkeeping
+    # would wedge the event loop / block the drain exactly when the
+    # process is being told to die
+    "photon_ml_tpu.telemetry.requests.RequestTracer.finish",
+    "photon_ml_tpu.telemetry.requests.RequestTracer.flight_dump",
 )
 
 #: The sanctioned device->host crossing: its body is the accounted fetch.

@@ -91,6 +91,10 @@ L011_HOT_FILES = {
     # functions are also L013 jit-walk seeds, so a device sync it
     # introduces is caught on the real dispatch path
     os.path.join("photon_ml_tpu", "telemetry", "profile.py"),
+    # the request tracer runs inside every serving request (batcher
+    # dispatch, router fan-out, engine folds) — pure-stdlib by contract:
+    # a device touch in trace bookkeeping would wedge the event loop
+    os.path.join("photon_ml_tpu", "telemetry", "requests.py"),
 }
 L011_COLD_ALLOWLIST = {
     # gather_to_host: a once-per-summary replicating identity, not a

@@ -393,6 +393,7 @@ SERVING_ROWS = (
     "member_load_io",
     "route_fanout_io",
     "resize_swap",
+    "flight_dump_kill",
     "member_hard_kill",
 )
 
@@ -467,6 +468,11 @@ def run_serving_matrix(
       fleet view serving untouched (counted
       ``serving.resize_swap_failures``); the unarmed refresh adopts the
       new epoch and parity holds across the swap.
+    - ``flight_dump_kill``: a process hard-killed MID flight-recorder
+      dump (injected exit at ``telemetry.flight_dump``) leaves nothing a
+      fleet report will adopt — the tmp-then-rename contract, including
+      planted ``.tmp`` debris — while the unarmed rerun's dump parses
+      with every ring record.
     - ``member_hard_kill``: a real 3-process ``cli serve`` fleet under
       sustained router traffic, one member SIGKILLed mid-stream — zero
       non-shed request failures, degraded scores bounded and accounted,
@@ -657,6 +663,84 @@ def run_serving_matrix(
                     router.close()
                     for server, _source in members:
                         server.stop()
+
+            elif row == "flight_dump_kill":
+                sub = os.path.join(workdir, row)
+                os.makedirs(sub, exist_ok=True)
+                snippet = (
+                    "import os, sys\n"
+                    "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+                    "from photon_ml_tpu import faults\n"
+                    "faults.warn_if_armed()\n"
+                    "from photon_ml_tpu.telemetry import requests as rq\n"
+                    "for _ in range(5):\n"
+                    "    rq.finish(rq.begin('score', rows=1))\n"
+                    "n = rq.flight_dump(rq.flight_path(sys.argv[1], 0))\n"
+                    "print('dumped', n)\n"
+                )
+                env = dict(os.environ)
+                env["JAX_PLATFORMS"] = "cpu"
+                env["PHOTON_FAULT_PLAN"] = json.dumps({
+                    "rules": [{
+                        "point": "telemetry.flight_dump",
+                        "action": "exit", "nth": 1,
+                    }],
+                })
+                armed = subprocess.run(
+                    [sys.executable, "-c", snippet, sub],
+                    env=env, capture_output=True, text=True, timeout=120,
+                )
+                entry["armed_rc"] = armed.returncode
+                if armed.returncode != 113:
+                    problems.append(
+                        f"armed dump process exited {armed.returncode}, "
+                        "expected the injected 113 (seam misses the "
+                        "dump path?)"
+                    )
+                # a kill can also land between the tmp write and the
+                # rename (the kernel-race shape no seam placement can
+                # rule out) — plant exactly that debris and prove
+                # discovery adopts neither it nor anything else
+                with open(
+                    os.path.join(sub, "flight-proc-1.json.tmp"),
+                    "w", encoding="utf-8",
+                ) as fh:
+                    fh.write('{"type": "flight_record", "records": [')
+                from photon_ml_tpu.telemetry import fleet_report
+                from photon_ml_tpu.telemetry import requests as rq
+
+                adopted = fleet_report.discover_flight_records(sub)
+                entry["adopted_after_kill"] = sorted(adopted)
+                if adopted:
+                    problems.append(
+                        "kill mid-dump left an adoptable flight record: "
+                        f"{sorted(adopted.values())}"
+                    )
+                env.pop("PHOTON_FAULT_PLAN")
+                clean = subprocess.run(
+                    [sys.executable, "-c", snippet, sub],
+                    env=env, capture_output=True, text=True, timeout=120,
+                )
+                if clean.returncode != 0:
+                    problems.append(
+                        f"unarmed rerun exited {clean.returncode}: "
+                        f"{clean.stderr[-200:]}"
+                    )
+                doc = rq.read_flight(rq.flight_path(sub, 0))
+                entry["clean_records"] = (
+                    None if doc is None
+                    else len(doc.get("records") or [])
+                )
+                if doc is None:
+                    problems.append(
+                        "unarmed rerun produced no parseable flight "
+                        "record"
+                    )
+                elif len(doc.get("records") or []) != 5:
+                    problems.append(
+                        f"flight record carries {entry['clean_records']} "
+                        "record(s), expected 5"
+                    )
 
             elif row == "member_hard_kill":
                 spec = fleet.ServingFleetSpec(

@@ -674,6 +674,10 @@ class ServingFleetSpec:
     status_file: Optional[str] = None
     status_port: Optional[int] = None
     status_interval_s: float = 0.5
+    #: router-side head sampling: mint a sampled trace context every Nth
+    #: routed batch (0 = never; slow/error/degraded requests still
+    #: persist via tail sampling)
+    trace_sample_every: int = 0
 
     def announce_dir(self) -> str:
         return os.path.join(self.workdir, "announce")
@@ -683,6 +687,9 @@ class ServingFleetSpec:
 
     def telemetry_base(self) -> str:
         return os.path.join(self.workdir, "telemetry", "serving.jsonl")
+
+    def trace_base(self) -> str:
+        return os.path.join(self.workdir, "telemetry", "trace.jsonl")
 
 
 @dataclasses.dataclass
@@ -727,6 +734,11 @@ def _launch_serving_member(
         "--heartbeat-dir", spec.fleet_dir(),
         "--telemetry-out",
         identity.member_artifact_path(spec.telemetry_base(), member),
+        # kill-safe span stream: PHOTON_PROC_ID in the member env makes
+        # cli serve suffix this to trace.proc-<member>.jsonl, and the
+        # supervisor harvests it into flight-proc-<member>.json when the
+        # member dies without draining
+        "--trace-out", spec.trace_base(),
     ]
     if spec.hbm_budget_mb is not None:
         argv += ["--hbm-budget-mb", str(spec.hbm_budget_mb)]
@@ -913,14 +925,18 @@ def run_serving_fleet(spec: ServingFleetSpec) -> dict:
         extras = {}
         down = router.members_status() if router is not None else {}
         for m, rec in records.items():
+            d = down.get(m, {})
             entry = {
                 "url": rec.get("url"),
                 "model_version": rec.get("version"),
                 "owned": rec.get("owned") or {},
                 "degraded": bool(
-                    down.get(m, {}).get("cooling_down", False)
+                    d.get("degraded", d.get("cooling_down", False))
                 ),
+                "cooldown_remaining_s": d.get("cooldown_remaining_s", 0.0),
             }
+            if d.get("fanout_rtt_ms"):
+                entry["fanout_rtt_ms"] = d["fanout_rtt_ms"]
             tail = tail_heartbeat_fields(
                 identity.member_artifact_path(spec.telemetry_base(), m),
                 expect_proc=m,
@@ -968,6 +984,14 @@ def run_serving_fleet(spec: ServingFleetSpec) -> dict:
             min(deadline, time.monotonic() + spec.warm_timeout_s),
         )
         version = str(records[0]["version"])
+        # router-side span stream: the supervisor process persists its
+        # request:route spans next to the members' per-proc streams so
+        # `cli report --fleet` can join one trace_id across the fan-out
+        telemetry.configure(
+            trace_out=os.path.join(
+                os.path.dirname(spec.telemetry_base()), "trace.router.jsonl"
+            )
+        )
         router = FleetRouter(
             spec.announce_dir(),
             lookups,
@@ -978,6 +1002,7 @@ def run_serving_fleet(spec: ServingFleetSpec) -> dict:
             retries=1,
             backoff_s=0.05,
             cooldown_s=0.4,
+            sample_every=spec.trace_sample_every,
         )
         router.refresh()
         _push_status(records)
@@ -1029,6 +1054,23 @@ def run_serving_fleet(spec: ServingFleetSpec) -> dict:
                         break
                     time.sleep(0.05)
                 killed["detect_s"] = round(_rel() - t_kill, 3)
+                # flight-recorder harvest: the victim died without its
+                # drain-path dump, so recover its last words from the
+                # kill-safe trace stream (bounded tail read; a torn last
+                # line is dropped, never adopted)
+                from photon_ml_tpu.telemetry import requests as rq
+
+                flight = rq.harvest_flight(
+                    identity.member_artifact_path(
+                        spec.trace_base(), spec.kill_member
+                    ),
+                    rq.flight_path(
+                        os.path.dirname(spec.telemetry_base()),
+                        spec.kill_member,
+                    ),
+                )
+                if flight is not None:
+                    killed["flight_spans"] = flight
                 if spec.relaunch:
                     members[spec.kill_member] = _launch_serving_member(
                         spec, spec.kill_member, fleet_size, epoch
@@ -1159,6 +1201,7 @@ def run_serving_fleet(spec: ServingFleetSpec) -> dict:
         )
         report["fleet_size"] = fleet_size
         report["epoch"] = epoch
+        report["telemetry_dir"] = os.path.dirname(spec.telemetry_base())
         report["ok"] = not traffic.failures
         return report
     finally:
