@@ -35,7 +35,10 @@ import time
 
 import numpy as np
 
-FRESHNESS_METRICS = ("freshness_speedup",)
+FRESHNESS_METRICS = (
+    "freshness_speedup",
+    "event_to_served_staleness_p99_s",
+)
 
 DELTA_FRACTION = 0.05
 QUALITY_TOL = 0.02
@@ -76,9 +79,13 @@ def _build_dataset(fe_vals, fe_rows, fe_cols, users, Xu, y,
 def run_freshness(deadline=None) -> dict[str, float | None]:
     from bench_suite import truncated_line
 
-    def truncated():
-        print(truncated_line("freshness_speedup"), flush=True)
-        return {"freshness_speedup": None}
+    def truncated(done=None):
+        done = dict(done or {})
+        for metric in FRESHNESS_METRICS:
+            if metric not in done:
+                print(truncated_line(metric), flush=True)
+                done[metric] = None
+        return done
 
     if deadline is not None and time.monotonic() > deadline:
         return truncated()
@@ -275,7 +282,58 @@ def run_freshness(deadline=None) -> dict[str, float | None]:
             ),
             flush=True,
         )
-        return {"freshness_speedup": round(speedup, 3)}
+        out = {"freshness_speedup": round(speedup, 3)}
+
+        # --- event→served staleness p99 (the conductor's gated SLO) ---
+        # One sample = the measured incremental fit plus a real registry
+        # publish + ModelRegistry hot-swap leg — the `cli pipeline`
+        # cycle's serving composition, without re-fitting per sample.
+        if deadline is not None and time.monotonic() > deadline:
+            return truncated(out)
+        from photon_ml_tpu.serving.registry import ModelRegistry
+
+        registry_dir = f"{workdir}/registry"
+        index_maps = {
+            "global": [f"g{i}" for i in range(fe_features)],
+            "user": [f"u{i}" for i in range(re_f)],
+        }
+        registry = None
+        samples = []
+        for _ in range(3):
+            t_pub = time.perf_counter()
+            incremental.publish_incremental(
+                registry_dir, res.model, index_maps, res.lineage,
+                delta=scan,
+            )
+            if registry is None:
+                registry = ModelRegistry(registry_dir, warm=False)
+            swapped = registry.refresh()
+            assert swapped, "registry did not hot-swap a published version"
+            samples.append(inc_s + (time.perf_counter() - t_pub))
+        registry.stop()
+        p99 = float(np.percentile(np.asarray(samples), 99.0))
+        print(
+            json.dumps(
+                {
+                    "metric": "event_to_served_staleness_p99_s",
+                    "value": round(p99, 3),
+                    "unit": "s",
+                    "vs_baseline": None,
+                    "detail": {
+                        "samples_s": [round(s, 3) for s in samples],
+                        "time_to_fresh_s": round(inc_s, 3),
+                        "publishes": len(samples),
+                        "composition": "incremental fit + registry "
+                        "publish + ModelRegistry hot-swap per sample",
+                        "platform": jax.devices()[0].platform,
+                        "simulated": not on_tpu,
+                    },
+                }
+            ),
+            flush=True,
+        )
+        out["event_to_served_staleness_p99_s"] = round(p99, 3)
+        return out
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 

@@ -77,6 +77,10 @@ LOWER_IS_BETTER_METRICS = frozenset({
     # collectives and per-member MFU imbalance both regress upward
     "fleet_collective_wait_fraction",
     "fleet_mfu_spread",
+    # freshness conductor (bench_freshness staleness section): seconds
+    # from delta-event mtime to registry hot-swap confirmed — the
+    # pipeline tier's headline SLO regresses upward
+    "event_to_served_staleness_p99_s",
 })
 
 

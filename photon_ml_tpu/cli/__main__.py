@@ -9,9 +9,10 @@ import sys
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m photon_ml_tpu.cli {train|refresh|sweep|score|serve|glm|index|report|profile} [options]")
+        print("usage: python -m photon_ml_tpu.cli {train|refresh|pipeline|sweep|score|serve|glm|index|report|profile} [options]")
         print("  train --config <json> [--output-dir <dir>] [--sweep lambda=...]   GAME training")
         print("  refresh --config <json> --warm-start <dir> [--delta <avro>...]  incremental warm-start retrain")
+        print("  pipeline --config <json> --base <dir> --delta-dir <dir> --registry-dir <dir>  supervised freshness daemon")
         print("  sweep --config <json> --sweep lambda=...     multi-λ sweep + best-model selection")
         print("  score --model-dir <dir> --config <json> [--output <avro>]")
         print("  serve --registry-dir <dir> | --model-dir <dir>  online scoring server")
@@ -29,6 +30,10 @@ def main(argv=None) -> int:
         from photon_ml_tpu.cli.refresh import main as refresh_main
 
         return refresh_main(rest)
+    if cmd == "pipeline":
+        from photon_ml_tpu.cli.pipeline import main as pipeline_main
+
+        return pipeline_main(rest)
     if cmd == "sweep":
         from photon_ml_tpu.cli.sweep import main as sweep_main
 
@@ -58,7 +63,7 @@ def main(argv=None) -> int:
 
         return profile_main(rest)
     print(
-        f"unknown command '{cmd}' (expected train|refresh|sweep|score|serve|glm|index|report|profile)",
+        f"unknown command '{cmd}' (expected train|refresh|pipeline|sweep|score|serve|glm|index|report|profile)",
         file=sys.stderr,
     )
     return 2

@@ -1,0 +1,169 @@
+"""Freshness-conductor driver: ``cli pipeline`` — the supervised daemon
+that tails a delta directory and keeps the serving registry fresh.
+
+One long-running process unifying the three freshness tiers::
+
+    python -m photon_ml_tpu.cli pipeline --config train.json \
+        --base ckpt/ --delta-dir deltas/ --registry-dir registry/ \
+        --workdir pipeline-work/ --interval-s 30 \
+        --escalate-touched-fraction 0.5 --escalate-after-cycles 24 \
+        --status-port 8080
+
+Each cycle: ``delta_digest`` detects new/changed shards, ``scan_delta``
+finds the touched entities, the masked re-solve refreshes only their
+lanes, ``publish_incremental`` lands a lineage-linked registry version
+(carrying the nearline-vs-delta reconciliation decision), and the live
+``ModelRegistry`` hot-swaps it. Touched-fraction or cycle-count
+thresholds escalate to a full retrain into a fresh base generation under
+the workdir. Event→served staleness p99 is the run's headline gauge.
+
+SIGTERM/SIGINT finish the in-flight cycle, then exit 75 (the scheduler
+restart convention); a restarted daemon re-seeds its digest cursor from
+the newest published lineage and continues. ``--status-file`` /
+``--status-port`` expose the ``/statusz`` fleet-status document with
+per-cycle pipeline facts under ``members["0"].pipeline``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+
+from photon_ml_tpu import faults, telemetry
+from photon_ml_tpu.utils import setup_logging
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="photon_ml_tpu.cli pipeline",
+        description=__doc__.splitlines()[0],
+    )
+    parser.add_argument("--config", required=True,
+                        help="training JSON config path")
+    parser.add_argument(
+        "--base", "--warm-start", dest="base", required=True, metavar="DIR",
+        help="warm-start base artifact (step checkpoint or saved model "
+        "dir); escalations re-base onto new generations under --workdir",
+    )
+    parser.add_argument(
+        "--delta-dir", required=True, metavar="DIR",
+        help="directory tailed for delta shards (see --delta-glob)",
+    )
+    parser.add_argument(
+        "--registry-dir", required=True, metavar="DIR",
+        help="serving registry: each cycle publishes the next version "
+        "here and hot-swaps the live engine",
+    )
+    parser.add_argument(
+        "--workdir", required=True, metavar="DIR",
+        help="daemon scratch: escalation base generations and the "
+        "fleet-status heartbeat directory live here",
+    )
+    parser.add_argument(
+        "--cycles", type=int, default=0, metavar="N",
+        help="stop after N cycles (default 0 = run until SIGTERM)",
+    )
+    parser.add_argument(
+        "--interval-s", type=float, default=5.0,
+        help="seconds between delta polls (default 5)",
+    )
+    parser.add_argument(
+        "--delta-glob", default="*.avro",
+        help="shard pattern tailed inside --delta-dir (default *.avro)",
+    )
+    parser.add_argument(
+        "--escalate-touched-fraction", type=float, default=0.5,
+        help="escalate to a full retrain when a delta touches at least "
+        "this fraction of any coordinate's entities (default 0.5; >=1 "
+        "disables)",
+    )
+    parser.add_argument(
+        "--escalate-after-cycles", type=int, default=0,
+        help="escalate to a full retrain after this many incremental "
+        "cycles since the last full one (default 0 = never by count)",
+    )
+    parser.add_argument(
+        "--no-serve", action="store_true",
+        help="publish without hot-swapping a live ModelRegistry (staleness "
+        "then measures event->published)",
+    )
+    parser.add_argument(
+        "--status-file", metavar="PATH",
+        help="write the fleet-status JSON document here each cycle",
+    )
+    parser.add_argument(
+        "--status-port", type=int, metavar="PORT",
+        help="serve the live status document over HTTP /statusz "
+        "(0 = ephemeral port)",
+    )
+    parser.add_argument(
+        "--telemetry-out",
+        help="append the final metrics snapshot to this JSONL file",
+    )
+    parser.add_argument(
+        "--report-out",
+        help="write the run report (with its Pipeline section) here when "
+        "the daemon stops",
+    )
+    args = parser.parse_args(argv)
+
+    setup_logging()
+    # an armed PHOTON_FAULT_PLAN must be LOUD: this run will fail on
+    # purpose (the chaos harness arms its subprocesses this way)
+    faults.warn_if_armed()
+    with open(args.config) as f:
+        config = json.load(f)
+    # the conductor owns checkpointing (escalation generations under the
+    # workdir); an inherited train-config checkpoint dir would alias the
+    # warm-start base — same hazard cli refresh drops it for
+    config.pop("checkpoint", None)
+
+    from photon_ml_tpu.pipeline import FreshnessPipeline, PipelineSpec
+
+    pipe = FreshnessPipeline(PipelineSpec(
+        config=config,
+        delta_dir=args.delta_dir,
+        base_dir=args.base,
+        registry_dir=args.registry_dir,
+        workdir=args.workdir,
+        interval_s=args.interval_s,
+        max_cycles=args.cycles,
+        delta_glob=args.delta_glob,
+        escalate_touched_fraction=args.escalate_touched_fraction,
+        escalate_after_cycles=args.escalate_after_cycles,
+        serve=not args.no_serve,
+        status_file=args.status_file,
+        status_port=args.status_port,
+    ))
+
+    def _on_signal(signum, frame):
+        pipe.request_stop()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    summary = pipe.run()
+    if args.telemetry_out:
+        summary["telemetry"] = telemetry.flush_metrics(args.telemetry_out)
+    if args.report_out:
+        from photon_ml_tpu.telemetry.report import RunReport
+
+        report = RunReport.from_live()
+        with open(args.report_out, "w", encoding="utf-8") as fh:
+            fh.write(report.to_markdown())
+        json_path = (
+            args.report_out[: -len(".md")] + ".json"
+            if args.report_out.endswith(".md")
+            else args.report_out + ".json"
+        )
+        report.save_json(json_path)
+        summary["report"] = args.report_out
+        summary["report_json"] = json_path
+    print(json.dumps(summary, default=float))
+    # an interrupted daemon is incomplete: exit 75 so schedulers restart
+    return 75 if summary.get("interrupted") else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -57,6 +57,14 @@ def main(argv=None) -> int:
         "--report-out",
         help="write the run report (with its Freshness section) here",
     )
+    parser.add_argument(
+        "--force",
+        action="store_true",
+        help="republish even when the delta digest matches what the "
+        "newest registry version already trained on (without this flag "
+        "an unchanged delta is a typed refusal — re-running a stuck "
+        "cron must not publish no-op versions)",
+    )
     args = parser.parse_args(argv)
 
     setup_logging()
@@ -73,6 +81,8 @@ def main(argv=None) -> int:
         ws["registry_dir"] = args.registry_dir
     if args.lambda_points is not None:
         ws["lambda_points"] = args.lambda_points
+    if args.force:
+        ws["force"] = True
     if "dir" not in ws:
         parser.error("refresh needs --warm-start (or config warm_start.dir)")
     config["warm_start"] = ws

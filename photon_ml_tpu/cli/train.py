@@ -272,7 +272,7 @@ def _parse_checkpoint_spec(config: Mapping) -> Optional[CheckpointSpec]:
 
 
 _WARM_START_KEYS = {
-    "dir", "delta_paths", "registry_dir", "base_version",
+    "dir", "delta_paths", "registry_dir", "base_version", "force",
     "lambda_factors", "lambda_points", "lambda_span", "metric", "policy",
 }
 
@@ -366,6 +366,17 @@ def _run_incremental(
                 delta_scan = scan_delta(
                     delta_data, base_vocabs, paths=delta_paths
                 )
+    if delta_scan is not None and warm.get("registry_dir"):
+        # a delta whose digest the newest published version already
+        # trained on is a typed refusal (StaleDeltaError) — re-running a
+        # stuck cron on unchanged shards must not publish no-op versions
+        from photon_ml_tpu.incremental import check_delta_freshness
+
+        check_delta_freshness(
+            warm["registry_dir"],
+            delta_scan.digest,
+            force=bool(warm.get("force")),
+        )
     factors = warm.get("lambda_factors")
     if factors is None and warm.get("lambda_points"):
         factors = local_lambda_factors(

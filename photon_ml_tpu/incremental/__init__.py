@@ -65,13 +65,17 @@ from photon_ml_tpu.incremental.delta import (  # noqa: F401
 )
 from photon_ml_tpu.incremental.refit import (  # noqa: F401
     IncrementalFitResult,
+    MaskedFactoredRandomEffectCoordinate,
     MaskedRandomEffectCoordinate,
     local_lambda_factors,
     run_incremental_fit,
+    transplant_factored_random_effect,
     transplant_fixed_effect,
     transplant_random_effect,
 )
 from photon_ml_tpu.incremental.publish import (  # noqa: F401
+    StaleDeltaError,
+    check_delta_freshness,
     lineage_record,
     publish_incremental,
 )
@@ -81,9 +85,12 @@ __all__ = [
     "CoordinateDelta",
     "DeltaScan",
     "IncrementalFitResult",
+    "MaskedFactoredRandomEffectCoordinate",
     "MaskedRandomEffectCoordinate",
+    "StaleDeltaError",
     "WarmStart",
     "WarmStartError",
+    "check_delta_freshness",
     "delta_digest",
     "detect_warm_start_kind",
     "grow_entity_rows",
@@ -94,6 +101,7 @@ __all__ = [
     "run_incremental_fit",
     "scan_delta",
     "scan_delta_stream",
+    "transplant_factored_random_effect",
     "transplant_fixed_effect",
     "transplant_random_effect",
 ]

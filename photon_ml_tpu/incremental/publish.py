@@ -36,10 +36,60 @@ FP_PUBLISH = faults.register_point(
 )
 
 
+class StaleDeltaError(ValueError):
+    """A delta whose digest matches what the base already trained on.
+
+    Re-publishing an identical delta silently produces a no-op version
+    with fresh lineage — a cron job stuck on yesterday's shards would
+    pollute the registry with indistinguishable versions. Typed so the
+    CLI can refuse loudly (``--force`` overrides for deliberate
+    republish, e.g. after a registry wipe)."""
+
+
+def check_delta_freshness(
+    registry_dir: str,
+    delta_digest: str,
+    force: bool = False,
+) -> None:
+    """Refuse a delta the newest published version already trained on.
+
+    Compares ``delta_digest`` against the ``lineage.delta_digest`` the
+    newest registry version recorded at publish time; a match raises
+    :class:`StaleDeltaError` unless ``force``. An empty/absent registry
+    or a newest version without delta lineage (full retrain, nearline)
+    passes — there is nothing to be stale against."""
+    import os
+
+    if force or not registry_dir or not os.path.isdir(registry_dir):
+        return
+    from photon_ml_tpu.data.model_store import load_game_model_metadata
+    from photon_ml_tpu.serving.registry import scan_versions
+
+    versions = scan_versions(registry_dir)
+    if not versions:
+        return
+    _, path = versions[-1]
+    try:
+        meta = load_game_model_metadata(path)
+    except (OSError, ValueError, KeyError):
+        return  # unreadable metadata cannot prove staleness
+    recorded = ((meta.get("extra") or {}).get("lineage") or {}).get(
+        "delta_digest"
+    )
+    if recorded is not None and recorded == delta_digest:
+        raise StaleDeltaError(
+            f"delta digest {delta_digest[:16]}... matches the digest "
+            f"already published as {os.path.basename(path)} in "
+            f"{registry_dir} — re-running on an unchanged delta would "
+            "publish a no-op version; pass --force to republish anyway"
+        )
+
+
 def lineage_record(
     lineage,
     delta=None,
     base_version: Optional[str] = None,
+    reconciliation: Optional[dict] = None,
 ) -> dict:
     """The JSON-safe lineage block registry metadata carries."""
     out: dict = {
@@ -63,6 +113,11 @@ def lineage_record(
         ]
         if fractions:
             out["touched_fraction"] = round(max(fractions), 6)
+    if reconciliation is not None:
+        # the conductor's nearline-vs-delta decision rides the lineage
+        # so causality is auditable from the registry alone (and from
+        # /healthz, which serves the lineage of the running version)
+        out["reconciliation"] = dict(reconciliation)
     return out
 
 
@@ -75,6 +130,7 @@ def publish_incremental(
     base_version: Optional[str] = None,
     extra_metadata: Optional[dict] = None,
     selection=None,
+    reconciliation: Optional[dict] = None,
 ) -> str:
     """Atomically publish an incremental retrain's model as the next
     registry version, lineage in metadata. Returns the version path.
@@ -83,7 +139,8 @@ def publish_incremental(
     serving as, when known — closes the ancestor chain for nearline
     consumers. ``selection``: the local λ sweep's
     :class:`~photon_ml_tpu.sweep.select.SweepSelection`, recorded like
-    the sweep exporter records it.
+    the sweep exporter records it. ``reconciliation``: the conductor's
+    nearline-vs-delta decision record, embedded in the lineage block.
     """
     from photon_ml_tpu.serving.registry import publish_version
 
@@ -97,7 +154,8 @@ def publish_incremental(
         index_maps,
         extra_metadata=meta,
         lineage=lineage_record(
-            lineage, delta=delta, base_version=base_version
+            lineage, delta=delta, base_version=base_version,
+            reconciliation=reconciliation,
         ),
     )
     telemetry.counter("incremental.published_versions").inc()

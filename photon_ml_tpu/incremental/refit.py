@@ -154,6 +154,62 @@ def transplant_random_effect(base, coord) -> tuple[object, np.ndarray]:
     )
 
 
+def transplant_factored_random_effect(base, coord) -> tuple[object, np.ndarray]:
+    """Re-home a base :class:`FactoredRandomEffectModel`'s latent rows
+    into the combined run's flat latent table.
+
+    Factored per-entity state is one K-vector with no per-feature
+    geometry, so re-homing is a pure row move by entity VALUE —
+    bit-identical for every entity the base trained. The base's shared
+    projection matrix A is carried verbatim (the latent rows are only
+    meaningful against the A they trained under; by construction A is
+    also seed-deterministic, so base and fresh agree anyway). Returns
+    ``(model, untransplanted_codes)`` like
+    :func:`transplant_random_effect` — active combined-vocab codes with
+    no base latent row must re-solve whatever the delta says."""
+    from photon_ml_tpu.incremental.warmstart import WarmStartError
+
+    fresh = coord.initialize_model()
+    base_latent = np.asarray(base.latent)
+    if base_latent.shape[1] != int(fresh.latent.shape[1]):
+        raise WarmStartError(
+            f"factored coordinate '{coord.name}': warm-start latent "
+            f"dimension {base_latent.shape[1]} != configured "
+            f"{int(fresh.latent.shape[1])} — the latent space must stay "
+            "pinned across incremental retrains"
+        )
+    base_mat = np.asarray(base.projection.matrix)
+    fresh_mat = np.asarray(fresh.projection.matrix)
+    if base_mat.shape != fresh_mat.shape:
+        raise WarmStartError(
+            f"factored coordinate '{coord.name}': warm-start projection "
+            f"is {base_mat.shape} but the combined data needs "
+            f"{fresh_mat.shape} — the feature space must stay pinned "
+            "across incremental retrains"
+        )
+    new_vocab = np.asarray(fresh.vocab)
+    bcodes = map_vocab_codes(np.asarray(base.vocab), new_vocab)
+    base_flat = np.asarray(base.entity_flat)
+    new_flat = np.asarray(fresh.entity_flat)
+    active = np.nonzero(new_flat >= 0)[0]
+    src = np.where(
+        bcodes[active] >= 0, base_flat[np.maximum(bcodes[active], 0)], -1
+    )
+    known = src >= 0
+    L = np.zeros(
+        (int(fresh.latent.shape[0]), base_latent.shape[1]), np.float64
+    )
+    L[new_flat[active[known]]] = base_latent[src[known]]
+    return (
+        dataclasses.replace(
+            fresh,
+            latent=jnp.asarray(L, fresh.latent.dtype),
+            projection=base.projection,
+        ),
+        active[~known].astype(np.int64),
+    )
+
+
 # ---------------------------------------------------------------------------
 # the masked coordinate
 # ---------------------------------------------------------------------------
@@ -327,6 +383,185 @@ class MaskedRandomEffectCoordinate:
         return dataclasses.replace(model, buckets=tuple(new_buckets))
 
 
+class MaskedFactoredRandomEffectCoordinate:
+    """A :class:`FactoredRandomEffectCoordinate` whose ``update_model``
+    re-solves ONLY the touched entities' latent vectors.
+
+    The shared projection matrix A is FROZEN regardless of the inner
+    coordinate's ``refit_projection``: a matrix refit rewrites every
+    entity's effective coefficients ``A^T c_e``, which would defeat the
+    untouched-lanes-bit-identical guarantee the masked path exists for.
+    Touched entities re-solve in the fixed projected space — exactly the
+    ``refit_projection=False`` per-entity step, gathered down to the
+    touched lanes (same pad-to-pow2 / scatter-back protocol as
+    :class:`MaskedRandomEffectCoordinate`). A base whose A has drifted
+    stale escalates to a full retrain — the conductor's escalation path.
+    """
+
+    def __init__(self, inner, touched_mask: np.ndarray):
+        self.inner = inner
+        self.name = inner.name
+        self.data = inner.data
+        red = inner.re_data
+        mask = np.asarray(touched_mask, bool)
+        if len(mask) != red.num_entities:
+            raise ValueError(
+                f"touched mask covers {len(mask)} entities but coordinate "
+                f"'{inner.name}' has {red.num_entities}"
+            )
+        if inner.refit_projection:
+            logger.warning(
+                "masked incremental solve freezes coordinate '%s's shared "
+                "projection matrix (refit_projection is configured on); "
+                "escalate to a full retrain to refresh it", inner.name,
+            )
+        codes = np.nonzero(mask)[0]
+        self._positions: list[np.ndarray] = []
+        for i in range(len(red.buckets)):
+            sel = codes[red.entity_bucket[codes] == i]
+            self._positions.append(
+                np.sort(red.entity_pos[sel]).astype(np.int64)
+            )
+        self.extra_l2 = 0.0
+        self.health_check = False
+        self.last_health = None
+        self.last_tracker = None
+        self.lanes_solved = 0
+        self.lanes_skipped = 0
+        self.bucket_solves = 0
+        self.buckets_skipped = 0
+
+    def initialize_model(self):
+        return self.inner.initialize_model()
+
+    def score(self, model):
+        return self.inner.score(model)
+
+    def update_model(self, model, residual_scores):
+        from photon_ml_tpu.game.coordinates import (
+            place_entity_solve,
+            record_entity_solve_comms,
+        )
+        from photon_ml_tpu.game.factored import _latent_design_T_fn
+        from photon_ml_tpu.ops.sparse import SparseBatch
+        from photon_ml_tpu.optim.trackers import (
+            FactoredRandomEffectOptimizationTracker,
+            RandomEffectOptimizationTracker,
+        )
+        from photon_ml_tpu.parallel import sharding as psharding
+
+        inner = self.inner
+        obj = damped_objective(inner._re_obj, self.extra_l2)
+        a_ext = model.projection.extended()
+        k = inner._proj_rows
+        n_dev = (
+            0 if inner.mesh is None
+            else psharding.axis_size(inner.mesh, inner._axis)
+        )
+        latent = model.latent
+        tracker_its, tracker_reasons, tracker_vals = [], [], []
+        healths = []
+        for b_idx, b in enumerate(inner.re_data.device_buckets()):
+            ti = self._positions[b_idx]
+            n_real = int(b.num_entities)
+            if not len(ti):
+                # zero touched entities: no solve dispatched at all —
+                # the bucket's latent rows stand bit-identical
+                self.buckets_skipped += 1
+                self.lanes_skipped += n_real
+                telemetry.counter("incremental.buckets_skipped").inc()
+                telemetry.counter("incremental.lanes_skipped").inc(n_real)
+                continue
+            T = len(ti)
+            total = _next_pow2(T)
+            if n_dev:
+                total = -(-total // n_dev) * n_dev
+            # pad by REPEATING the last touched lane (idempotent; scatter
+            # below only writes the first T lanes)
+            idx = np.concatenate(
+                [ti, np.full(total - T, ti[-1], np.int64)]
+            )
+            idx_dev = jnp.asarray(idx, jnp.int32)
+
+            def take(x):
+                return jnp.take(x, idx_dev, axis=0)
+
+            bucket = (
+                b if residual_scores is None
+                else b.with_extra_offsets(residual_scores)
+            )
+            R = b.rows_per_entity
+            # gather the touched entities' raw arrays FIRST, then build
+            # the transposed latent design only over them — the design
+            # cost scales with touched lanes, not bucket size
+            X = _latent_design_T_fn(R)(
+                take(b.values), take(b.rows), take(b.cols),
+                take(b.projection), a_ext,
+            ).transpose(0, 2, 1)  # [total, R, K]
+            dense = SparseBatch(
+                values=X.reshape(total, R * k),
+                rows=jnp.broadcast_to(
+                    jnp.repeat(jnp.arange(R, dtype=jnp.int32), k),
+                    (total, R * k),
+                ),
+                cols=jnp.broadcast_to(
+                    jnp.tile(jnp.arange(k, dtype=jnp.int32), R),
+                    (total, R * k),
+                ),
+                labels=take(bucket.labels),
+                offsets=take(bucket.offsets),
+                weights=take(bucket.weights),
+                num_features=k,
+            )
+            flat = inner._flat_offsets[b_idx] + idx
+            w0 = jnp.take(latent, jnp.asarray(flat, jnp.int32), axis=0)
+            if inner.mesh is not None:
+                dense, w0, _ = place_entity_solve(
+                    inner.mesh, inner._axis, dense, w0
+                )
+                record_entity_solve_comms(
+                    "latent_re_solve", inner.mesh, inner._axis,
+                    inner.re_config.max_iterations,
+                )
+            res, _ = inner._re_solver(obj, dense, w0, inner._re_l1, None)
+            w = res.w[:T]
+            flat_t = jnp.asarray(
+                inner._flat_offsets[b_idx] + ti, jnp.int32
+            )
+            latent = latent.at[flat_t].set(w.astype(latent.dtype))
+            tracker_its.append(res.iterations[:T])
+            tracker_reasons.append(res.reason[:T])
+            tracker_vals.append(res.value[:T])
+            if self.health_check:
+                healths.append(solve_health(res, res.w))
+            self.bucket_solves += 1
+            self.lanes_solved += T
+            self.lanes_skipped += n_real - T
+            telemetry.counter("incremental.bucket_solves").inc()
+            telemetry.counter("incremental.lanes_solved").inc(T)
+            telemetry.counter("incremental.lanes_skipped").inc(n_real - T)
+        self.last_health = (
+            (jnp.all(jnp.stack(healths)) if healths else jnp.bool_(True))
+            if self.health_check
+            else None
+        )
+        self.last_tracker = (
+            FactoredRandomEffectOptimizationTracker(
+                steps=(
+                    (
+                        RandomEffectOptimizationTracker.from_device_parts(
+                            tracker_its, tracker_reasons, tracker_vals
+                        ),
+                        None,
+                    ),
+                )
+            )
+            if tracker_its
+            else None
+        )
+        return dataclasses.replace(model, latent=latent)
+
+
 # ---------------------------------------------------------------------------
 # the incremental fit driver
 # ---------------------------------------------------------------------------
@@ -404,16 +639,20 @@ def _wrap_masked(coords: dict, delta, data, untransplanted: dict) -> dict:
     window rather than the delta shards still has only a zero-init row —
     skipping its lane would publish an all-zero random effect."""
     from photon_ml_tpu.game.coordinates import RandomEffectCoordinate
+    from photon_ml_tpu.game.factored import FactoredRandomEffectCoordinate
 
     if delta is None:
         return dict(coords)
     out = {}
     for name, coord in coords.items():
-        cd = (
-            delta.for_id(coord.re_data.id_name)
-            if isinstance(coord, RandomEffectCoordinate)
-            else None
-        )
+        if isinstance(coord, RandomEffectCoordinate):
+            cd = delta.for_id(coord.re_data.id_name)
+            masked_cls = MaskedRandomEffectCoordinate
+        elif isinstance(coord, FactoredRandomEffectCoordinate):
+            cd = delta.for_id(coord.re_data.id_name)
+            masked_cls = MaskedFactoredRandomEffectCoordinate
+        else:
+            cd = None
         if cd is None:
             out[name] = coord
             continue
@@ -422,7 +661,7 @@ def _wrap_masked(coords: dict, delta, data, untransplanted: dict) -> dict:
         missing = untransplanted.get(name)
         if missing is not None and len(missing):
             mask[missing] = True
-        out[name] = MaskedRandomEffectCoordinate(coord, mask)
+        out[name] = masked_cls(coord, mask)
     return out
 
 
@@ -439,6 +678,11 @@ def _transplant_models(
         FixedEffectCoordinate,
         RandomEffectCoordinate,
     )
+    from photon_ml_tpu.game.factored import (
+        FactoredRandomEffectCoordinate,
+        FactoredRandomEffectModel,
+    )
+    from photon_ml_tpu.incremental.warmstart import WarmStartError
 
     initial = {}
     new_entities = 0
@@ -455,6 +699,18 @@ def _transplant_models(
             initial[name] = transplant_fixed_effect(base, coord)
         elif isinstance(coord, RandomEffectCoordinate):
             model, missing = transplant_random_effect(base, coord)
+            initial[name] = model
+            new_entities += int(len(missing))
+            untransplanted[name] = missing
+        elif isinstance(coord, FactoredRandomEffectCoordinate):
+            if not isinstance(base, FactoredRandomEffectModel):
+                raise WarmStartError(
+                    f"coordinate '{name}' is factored in this config but "
+                    f"the warm start holds a {type(base).__name__} — the "
+                    "coordinate structure must stay pinned across "
+                    "incremental retrains"
+                )
+            model, missing = transplant_factored_random_effect(base, coord)
             initial[name] = model
             new_entities += int(len(missing))
             untransplanted[name] = missing

@@ -1,0 +1,31 @@
+"""The freshness tier's conductor: a supervised daemon (``cli
+pipeline``) that tails a delta directory, runs masked incremental
+retrains on a cadence, reconciles nearline updates, escalates to full
+retrains, and hot-swaps the serving registry — with event→served
+staleness p99 as the gated SLO.  See :mod:`.conductor` for the loop and
+:mod:`.reconcile` for the nearline-vs-delta reconciliation rule.
+"""
+
+from .conductor import (
+    FP_CYCLE_START,
+    FP_ESCALATE,
+    FP_RECONCILE,
+    FreshnessPipeline,
+    PipelineSpec,
+)
+from .reconcile import (
+    RECONCILE_RULE,
+    newest_version_metadata,
+    reconcile_nearline,
+)
+
+__all__ = [
+    "FP_CYCLE_START",
+    "FP_ESCALATE",
+    "FP_RECONCILE",
+    "FreshnessPipeline",
+    "PipelineSpec",
+    "RECONCILE_RULE",
+    "newest_version_metadata",
+    "reconcile_nearline",
+]

@@ -1,0 +1,477 @@
+"""The freshness conductor: a supervised daemon unifying the three
+freshness tiers (nearline, incremental, full retrain) under one cadence.
+
+The repo grew three freshness mechanisms at three timescales — nearline
+per-entity solves (seconds), masked incremental retrains (minutes), and
+full retrains (hours) — with no conductor: nothing tailed deltas on a
+cadence, nothing reconciled a nearline-updated row that also lands in a
+delta's touched set, and nothing measured event→served staleness, the
+metric the whole tier exists for.  :class:`FreshnessPipeline` is that
+conductor, surfaced as ``cli pipeline``.
+
+Each cycle:
+
+1. tail the delta directory; :func:`delta_digest` over the globbed
+   shards detects new/changed content (an unchanged digest is an idle
+   cycle — no read, no fit, no publish);
+2. ``scan_delta`` the new shards against the base model's vocabularies;
+3. decide the nearline-vs-delta reconciliation (``pipeline.reconcile``)
+   and record it — see :mod:`photon_ml_tpu.pipeline.reconcile` for the
+   retrain-wins-touched rule and its rationale;
+4. either run the masked incremental re-solve
+   (``estimator.fit_incremental`` → ``MaskedRandomEffectCoordinate``)
+   or, when the touched fraction or the cycles-since-full count trips a
+   threshold, escalate (``pipeline.escalate``) to a full retrain into a
+   fresh base generation under the workdir;
+5. ``publish_incremental`` the result — lineage carries the base
+   checkpoint, delta digest, and the reconciliation record — and
+   hot-swap the live :class:`ModelRegistry` so the next score serves it;
+6. observe per-delta-file event→served staleness and publish the p99 as
+   the gauge ``pipeline.event_to_served_staleness_p99_s`` (the tier's
+   headline SLO, gated in ``bench_suite --freshness``).
+
+Crash safety is inherited, not reimplemented: every publish goes through
+the registry's assemble-then-``os.rename`` protocol and the base
+checkpoint is only ever read, so a hard kill at ANY point mid-cycle
+(the three ``pipeline.*`` seams below, exercised by
+``tools/chaos.py --pipeline``) leaves the base byte-identical and the
+registry free of partial versions; the restarted daemon re-seeds its
+digest cursor from the newest published lineage and simply redoes the
+interrupted cycle.
+
+Supervision: ``/statusz``-style live status via
+:class:`FleetStatusWriter` (the conductor is a 1-member fleet — its
+heartbeat file, cycle counters, and served version ride the standard
+fleet-status document), per-cycle spans/counters rendered as the
+RunReport "Pipeline" section, and SIGTERM → finish the current cycle,
+exit 75 (the scheduler-restart convention shared with training).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from .. import faults, telemetry
+from ..config import parse_game_config
+from ..game.checkpoint import CheckpointSpec
+from ..game.estimator import GameEstimator
+from ..incremental import (
+    delta_digest,
+    load_warm_start,
+    publish_incremental,
+    scan_delta,
+)
+from .reconcile import newest_version_metadata, reconcile_nearline
+
+logger = logging.getLogger(__name__)
+
+# -- fault seams -------------------------------------------------------------
+# All three are PLAIN seams (not write-path: the conductor never writes
+# the base, and every registry write is behind incremental.publish's own
+# write-path seam) — a hard kill here must leave the base checkpoint
+# byte-identical and the registry without partial versions, which the
+# chaos row `tools/chaos.py --pipeline` asserts.
+FP_CYCLE_START = faults.register_point(
+    "pipeline.cycle_start",
+    description="top of a conductor cycle, before the delta poll is "
+    "acted on — a kill here loses nothing (the cycle had no effects yet)",
+)
+FP_RECONCILE = faults.register_point(
+    "pipeline.reconcile",
+    description="before the nearline-vs-delta reconciliation decision "
+    "is recorded — a kill here must not publish a version whose lineage "
+    "lacks the decision",
+)
+FP_ESCALATE = faults.register_point(
+    "pipeline.escalate",
+    description="before an escalated full retrain begins — a kill here "
+    "must leave the incumbent base generation intact and serving",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSpec:
+    """Static configuration for one :class:`FreshnessPipeline` run.
+
+    ``config`` is a full train-CLI config document (input spec,
+    coordinates, ...) — the conductor reuses the train driver's readers
+    and estimator so a pipeline cycle fits exactly what ``cli train``
+    would. ``base_dir`` is the warm-start base (step checkpoint or saved
+    model dir); after an escalation the conductor re-bases onto the new
+    generation it trained under ``workdir``.
+    """
+
+    config: Mapping[str, Any]
+    delta_dir: str
+    base_dir: str
+    registry_dir: str
+    workdir: str
+    interval_s: float = 5.0
+    # 0 = run until stopped (SIGTERM); tests and the bench pin a count
+    max_cycles: int = 0
+    delta_glob: str = "*.avro"
+    # escalation trips on EITHER threshold; escalate_after_cycles=0
+    # disables the count trigger, escalate_touched_fraction>=1.0
+    # effectively disables the fraction trigger
+    escalate_touched_fraction: float = 0.5
+    escalate_after_cycles: int = 0
+    # hot-swap a live ModelRegistry after each publish (off for
+    # fit-only runs where nothing serves)
+    serve: bool = True
+    status_file: Optional[str] = None
+    status_port: Optional[int] = None
+    heartbeat_deadline_s: float = 30.0
+
+
+class FreshnessPipeline:
+    """The conductor loop. One instance = one supervised daemon run."""
+
+    def __init__(self, spec: PipelineSpec):
+        if not spec.delta_dir:
+            raise ValueError("PipelineSpec.delta_dir is required")
+        if not spec.registry_dir:
+            raise ValueError("PipelineSpec.registry_dir is required")
+        self.spec = spec
+        # parse eagerly: a malformed config must fail at startup, not on
+        # the first non-idle cycle hours later
+        self._game_config = parse_game_config(spec.config)
+        self._estimator = GameEstimator(self._game_config)
+        self._base_dir = spec.base_dir
+        # index maps are pinned on the first cycle's combined read and
+        # reused verbatim after — the served feature space must not
+        # drift cycle to cycle (scoring ids must match the base model's)
+        self._index_maps: Optional[Mapping] = None
+        self._last_digest: Optional[str] = self._seed_digest()
+        self._staleness: List[float] = []
+        self._stop = threading.Event()
+        self.cycle = 0
+        self._cycles_since_full = 0
+        self._published: List[str] = []
+        self._escalations = 0
+        self._idle_cycles = 0
+        self._reconciliations = 0
+        self._registry = None
+        self._status = None
+        self._heartbeat = None
+        self._last_p99: Optional[float] = None
+
+    # -- cursor seeding ------------------------------------------------------
+
+    def _seed_digest(self) -> Optional[str]:
+        """Resume the digest cursor from the newest published lineage so
+        a restarted conductor does not re-publish the delta it already
+        served (the crash-restart idempotence contract)."""
+        _, meta = newest_version_metadata(self.spec.registry_dir)
+        lineage = ((meta or {}).get("extra") or {}).get("lineage") or {}
+        return lineage.get("delta_digest")
+
+    def _delta_paths(self) -> List[str]:
+        return sorted(
+            glob.glob(os.path.join(self.spec.delta_dir, self.spec.delta_glob))
+        )
+
+    # -- status --------------------------------------------------------------
+
+    def _start_status(self) -> None:
+        if self.spec.status_file is None and self.spec.status_port is None:
+            return
+        from ..parallel.fleet_status import FleetStatusWriter
+        from ..parallel.multihost import HeartbeatWriter
+
+        fleet_dir = os.path.join(self.spec.workdir, "fleet")
+        os.makedirs(fleet_dir, exist_ok=True)
+        self._status = FleetStatusWriter(
+            fleet_dir,
+            num_processes=1,
+            heartbeat_deadline_s=self.spec.heartbeat_deadline_s,
+            status_file=self.spec.status_file,
+            port=self.spec.status_port,
+        ).start()
+        # the conductor is its own 1-member fleet: the standard
+        # heartbeat file is what makes members["0"].alive true
+        self._heartbeat = HeartbeatWriter(fleet_dir, 0).start()
+
+    def _write_status(self, entry: Mapping[str, Any]) -> None:
+        if self._status is None:
+            return
+        extras = dict(entry)
+        extras.update(
+            base_dir=self._base_dir,
+            cycles_since_full=self._cycles_since_full,
+            publishes=len(self._published),
+            escalations=self._escalations,
+            idle_cycles=self._idle_cycles,
+            staleness_p99_s=self._last_p99,
+            served_version=(
+                getattr(self._registry, "current_version", None)
+                if self._registry is not None
+                else None
+            ),
+        )
+        # per-member facts ride member_extras (the snapshot schema only
+        # renders supervisor fields + per-member merges); generation
+        # doubles as the cycle counter in the fixed doc
+        self._status.update(
+            generation=self.cycle,
+            member_extras={0: {"pipeline": extras}},
+        )
+        self._status.write_once()
+
+    def _close(self, outcome: str) -> None:
+        if self._status is not None:
+            self._status.update(outcome=outcome)
+            self._status.write_once()
+            self._status.stop()
+            self._status = None
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
+        if self._registry is not None:
+            self._registry.stop()
+
+    # -- the cycle -----------------------------------------------------------
+
+    def run_cycle(self) -> Dict[str, Any]:
+        """One conductor cycle. Returns a JSON-safe cycle record."""
+        self.cycle += 1
+        faults.fault_point(FP_CYCLE_START)
+        telemetry.counter("pipeline.cycles").inc()
+        entry: Dict[str, Any] = {
+            "cycle": self.cycle,
+            "idle": True,
+            "published_version": None,
+            "escalated": False,
+        }
+        paths = self._delta_paths()
+        digest = delta_digest(paths) if paths else None
+        if not paths or digest == self._last_digest:
+            self._idle_cycles += 1
+            telemetry.counter("pipeline.idle_cycles").inc()
+            self._write_status(entry)
+            return entry
+        entry["idle"] = False
+        with telemetry.span(
+            "pipeline.cycle",
+            cycle=self.cycle,
+            delta_files=len(paths),
+            delta_digest=digest,
+        ):
+            entry.update(self._refresh(paths))
+        self._last_digest = digest
+        self._write_status(entry)
+        return entry
+
+    def _event_times(self, paths: Sequence[str]) -> List[float]:
+        times = []
+        for p in paths:
+            try:
+                times.append(os.path.getmtime(p))
+            except OSError:
+                pass  # a shard replaced mid-cycle still gets retrained
+        return times
+
+    def _refresh(self, paths: Sequence[str]) -> Dict[str, Any]:
+        from ..cli.train import read_input
+
+        event_times = self._event_times(paths)
+        ws = load_warm_start(self._base_dir)
+        if ws.model is None:
+            raise RuntimeError(
+                f"{self._base_dir} holds a streamed coefficient table, "
+                "not a full GAME model — the conductor needs a model "
+                "base (train with --checkpoint-dir or point --base at a "
+                "saved model dir)"
+            )
+        base_vocabs = {}
+        for sub in ws.model.models.values():
+            id_name = getattr(sub, "id_name", None)
+            vocab = getattr(sub, "vocab", None)
+            if id_name is not None and vocab is not None:
+                base_vocabs[id_name] = vocab
+
+        # the delta alone (id columns drive the touched mask) ...
+        delta_spec = {**self.spec.config["input"], "paths": list(paths)}
+        delta_spec.pop("ingest", None)  # scan is host-side
+        delta_spec.pop("date_range", None)
+        delta_spec.pop("date_range_days_ago", None)
+        delta_data, _ = read_input(delta_spec, index_maps=self._index_maps)
+        scan = scan_delta(delta_data, base_vocabs, paths=list(paths))
+
+        # ... then the combined stream (base shards ∪ delta): the
+        # deterministic planner keeps base chunk ids stable under the
+        # appended files, so streamed reads resume bit-identically
+        input_spec = dict(self.spec.config["input"])
+        base_paths = input_spec.get("paths")
+        if isinstance(base_paths, str):
+            base_paths = [base_paths]
+        input_spec["paths"] = list(base_paths) + list(paths)
+        input_spec.pop("date_range", None)
+        input_spec.pop("date_range_days_ago", None)
+        train_data, index_maps = read_input(
+            input_spec, index_maps=self._index_maps
+        )
+        if self._index_maps is None:
+            self._index_maps = index_maps
+
+        faults.fault_point(FP_RECONCILE)
+        decision = reconcile_nearline(self.spec.registry_dir, scan)
+        if decision["nearline_version"] is not None:
+            self._reconciliations += 1
+            telemetry.counter("pipeline.reconciliations").inc()
+
+        touched = max(
+            (c.touched_fraction for c in scan.coordinates.values()),
+            default=0.0,
+        )
+        self._cycles_since_full += 1
+        escalated = touched >= self.spec.escalate_touched_fraction or (
+            self.spec.escalate_after_cycles > 0
+            and self._cycles_since_full >= self.spec.escalate_after_cycles
+        )
+        base_version_name, _ = newest_version_metadata(self.spec.registry_dir)
+
+        if escalated:
+            faults.fault_point(FP_ESCALATE)
+            telemetry.counter("pipeline.escalations").inc()
+            self._escalations += 1
+            gen_dir = os.path.join(
+                self.spec.workdir, f"base-gen-{self.cycle:04d}"
+            )
+            with telemetry.span(
+                "pipeline.full_retrain", cycle=self.cycle,
+                touched_fraction=round(touched, 6),
+            ):
+                self._estimator.fit(
+                    train_data,
+                    checkpoint_spec=CheckpointSpec(directory=gen_dir),
+                )
+            # re-load through the warm-start reader so the published
+            # (model, lineage) pair is exactly what the NEXT cycle will
+            # warm-start from — one consistent chain, no special case
+            ws_new = load_warm_start(gen_dir)
+            model, lineage = ws_new.model, ws_new.lineage
+            self._base_dir = gen_dir
+            self._cycles_since_full = 0
+        else:
+            result = self._estimator.fit_incremental(
+                train_data, ws, delta=scan
+            )
+            model, lineage = result.model, result.lineage
+
+        published = publish_incremental(
+            self.spec.registry_dir,
+            model,
+            self._index_maps,
+            lineage,
+            delta=scan,
+            base_version=base_version_name,
+            extra_metadata={
+                "pipeline": {
+                    "cycle": self.cycle,
+                    "escalated": bool(escalated),
+                    "cycles_since_full": self._cycles_since_full,
+                }
+            },
+            reconciliation=decision,
+        )
+        telemetry.counter("pipeline.publishes").inc()
+        version_name = os.path.basename(published)
+        logger.info(
+            "pipeline cycle %d published %s (escalated=%s touched=%.4f)",
+            self.cycle, version_name, escalated, touched,
+        )
+        self._published.append(version_name)
+
+        served_ts = self._swap()
+        # event time = delta shard mtime; served time = registry swap
+        # confirmed. Every shard in the cycle contributes one sample so
+        # the p99 reflects the OLDEST events a slow cycle kept stale.
+        samples = [max(served_ts - t, 0.0) for t in event_times]
+        hist = telemetry.histogram("pipeline.staleness_s")
+        for s in samples:
+            hist.observe(s)
+        self._staleness.extend(samples)
+        p99 = float(np.percentile(np.asarray(self._staleness), 99.0))
+        self._last_p99 = p99
+        telemetry.gauge("pipeline.event_to_served_staleness_p99_s").set(p99)
+        return {
+            "published_version": version_name,
+            "escalated": bool(escalated),
+            "touched_fraction": round(float(touched), 6),
+            "reconciliation": decision,
+            "staleness_p99_s": round(p99, 3),
+        }
+
+    def _swap(self) -> float:
+        """Hot-swap the live registry to the freshest version; returns
+        the served timestamp (wall clock by necessity — staleness is
+        measured against delta-file mtimes, same contract as fleet
+        heartbeat liveness)."""
+        import time
+
+        if not self.spec.serve:
+            return time.time()  # photon: noqa[L006]
+        if self._registry is None:
+            from ..serving.registry import ModelRegistry
+
+            # manual-refresh mode: the conductor IS the poller (it knows
+            # exactly when a version landed), so no background thread
+            self._registry = ModelRegistry(self.spec.registry_dir, warm=False)
+        self._registry.refresh()
+        return time.time()  # photon: noqa[L006]
+
+    # -- the daemon loop -----------------------------------------------------
+
+    def request_stop(self) -> None:
+        """Ask the loop to exit after the in-flight cycle (signal-safe)."""
+        self._stop.set()
+
+    def run(self) -> Dict[str, Any]:
+        """Supervised loop: cycle, sleep ``interval_s``, repeat until
+        ``max_cycles`` or a stop request. Returns the run summary."""
+        self._start_status()
+        outcome = "completed"
+        try:
+            while True:
+                if self._stop.is_set():
+                    outcome = "interrupted"
+                    break
+                self.run_cycle()
+                if (
+                    self.spec.max_cycles
+                    and self.cycle >= self.spec.max_cycles
+                ):
+                    break
+                if self._stop.wait(self.spec.interval_s):
+                    outcome = "interrupted"
+                    break
+        finally:
+            self._close(outcome)
+        return self.summary(interrupted=outcome == "interrupted")
+
+    def summary(self, interrupted: bool = False) -> Dict[str, Any]:
+        p99 = (
+            float(np.percentile(np.asarray(self._staleness), 99.0))
+            if self._staleness
+            else None
+        )
+        return {
+            "cycles": self.cycle,
+            "idle_cycles": self._idle_cycles,
+            "published_versions": list(self._published),
+            "escalations": self._escalations,
+            "reconciliations": self._reconciliations,
+            "event_to_served_staleness_p99_s": (
+                round(p99, 3) if p99 is not None else None
+            ),
+            "registry_dir": self.spec.registry_dir,
+            "base_dir": self._base_dir,
+            "interrupted": bool(interrupted),
+        }

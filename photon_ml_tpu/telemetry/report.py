@@ -825,6 +825,7 @@ class RunReport:
             "slowest_requests": self.slowest_requests(),
             "recovery": self.recovery_summary(),
             "freshness": self.freshness_summary(),
+            "pipeline": self.pipeline_summary(),
             "counters": counters,
             "gauges": self.snapshot.get("gauges", {}),
             "histograms": self.snapshot.get("histograms", {}),
@@ -894,6 +895,7 @@ class RunReport:
         lines += self._requests_markdown()
         lines += self._recovery_markdown()
         lines += self._freshness_markdown()
+        lines += self._pipeline_markdown()
         lines += self._memory_markdown()
         lines += self._coordinates_markdown()
         lines += self._sweep_markdown()
@@ -1475,6 +1477,89 @@ class RunReport:
         if published:
             out.append(
                 f"- {published} version(s) published with lineage metadata"
+            )
+        out.append("")
+        return out
+
+    def pipeline_summary(self) -> Optional[dict[str, Any]]:
+        """The freshness conductor's accounting, or None when no
+        ``cli pipeline`` daemon ran.
+
+        Answers the freshness-tier questions: how many cycles ran (and
+        how many were idle — unchanged delta digest), how many versions
+        published vs escalated to full retrains, how many cycles had a
+        nearline version to reconcile against, and the headline SLO —
+        event→served staleness p99 across every delta shard served.
+        """
+        c = self.snapshot.get("counters", {})
+        g = self.snapshot.get("gauges", {})
+        cycle_spans = [
+            s for s in self.spans if s.get("name") == "pipeline.cycle"
+        ]
+        keys = (
+            "pipeline.cycles", "pipeline.idle_cycles",
+            "pipeline.publishes", "pipeline.escalations",
+            "pipeline.reconciliations",
+        )
+        if not cycle_spans and not any(c.get(k) for k in keys):
+            return None
+        out: dict[str, Any] = {
+            k.split(".", 1)[1]: int(c.get(k, 0)) for k in keys if k in c
+        }
+        p99 = g.get("pipeline.event_to_served_staleness_p99_s")
+        if p99 is not None:
+            out["event_to_served_staleness_p99_s"] = float(p99)
+        if cycle_spans:
+            out["cycle_time_s"] = {
+                "count": len(cycle_spans),
+                "total": round(
+                    sum(float(s.get("dur") or 0.0) for s in cycle_spans), 3
+                ),
+                "max": round(
+                    max(float(s.get("dur") or 0.0) for s in cycle_spans), 3
+                ),
+            }
+        return out
+
+    def _pipeline_markdown(self) -> list[str]:
+        pipe = self.pipeline_summary()
+        if pipe is None:
+            return []
+        out = ["## Pipeline", ""]
+        cycles = pipe.get("cycles", 0)
+        idle = pipe.get("idle_cycles", 0)
+        if cycles:
+            out.append(
+                f"- {cycles} conductor cycle(s), {idle} idle "
+                "(unchanged delta digest)"
+            )
+        publishes = pipe.get("publishes", 0)
+        escalations = pipe.get("escalations", 0)
+        if publishes:
+            line = f"- {publishes} version(s) published with lineage"
+            if escalations:
+                line += (
+                    f", {escalations} via full-retrain escalation"
+                )
+            out.append(line)
+        rec = pipe.get("reconciliations", 0)
+        if rec:
+            out.append(
+                f"- {rec} cycle(s) reconciled a nearline-published "
+                "version (retrain-wins-touched; superseded version named "
+                "in lineage)"
+            )
+        p99 = pipe.get("event_to_served_staleness_p99_s")
+        if p99 is not None:
+            out.append(
+                f"- **event→served staleness p99: {p99:.3f} s** (delta "
+                "shard mtime → registry hot-swap confirmed)"
+            )
+        ct = pipe.get("cycle_time_s")
+        if ct:
+            out.append(
+                f"- non-idle cycle time: {ct['total']:.3f} s total over "
+                f"{ct['count']} cycle(s), max {ct['max']:.3f} s"
             )
         out.append("")
         return out

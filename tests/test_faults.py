@@ -82,6 +82,15 @@ EXPECTED_POINTS = {
     # flight_dump_kill kills mid-dump and proves fleet discovery never
     # adopts the torn .tmp; ring/parse coverage in tests/test_requests)
     "telemetry.flight_dump",
+    # freshness-conductor daemon cycle seams (plain points — every write
+    # in a cycle rides the registry's tmp-then-rename or lands in a
+    # fresh escalation generation dir; tools/chaos.py --pipeline
+    # hard-kills the cli pipeline daemon at each of pipeline.cycle_start,
+    # pipeline.reconcile, and pipeline.escalate and proves the base
+    # checkpoint stays byte-identical and the registry partial-free)
+    "pipeline.cycle_start",
+    "pipeline.reconcile",
+    "pipeline.escalate",
 }
 
 WRITE_PATH_POINTS = [
@@ -123,6 +132,7 @@ def test_registry_catalog_is_complete_and_stable():
     import photon_ml_tpu.parallel.fleet_status  # noqa: F401
     import photon_ml_tpu.parallel.multihost  # noqa: F401
     import photon_ml_tpu.incremental  # noqa: F401
+    import photon_ml_tpu.pipeline  # noqa: F401
     import photon_ml_tpu.telemetry.requests  # noqa: F401
 
     registered = faults.registered_points()

@@ -533,6 +533,247 @@ def test_delta_scan_stream_agrees_with_in_core(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# the streamed loop end-to-end: ChunkStream base fit -> streamed scan ->
+# streamed combined re-read -> masked refresh
+# ---------------------------------------------------------------------------
+
+
+def test_streamed_incremental_end_to_end(tmp_path):
+    """The WHOLE incremental loop out-of-core: base data assembled
+    through the ChunkStream reader (multi-chunk, parallel decode), delta
+    scanned with scan_delta_stream, the combined window re-read streamed
+    with the SAME pinned index maps, and a warm-started masked refresh —
+    untouched lanes still bit-identical to the base fit."""
+    from photon_ml_tpu.data.avro import (
+        TRAINING_EXAMPLE_AVRO,
+        build_index_maps_from_avro,
+        write_avro,
+    )
+    from photon_ml_tpu.ingest import IngestSpec
+    from photon_ml_tpu.ingest.assemble import read_game_dataset_streamed
+
+    rng = np.random.default_rng(17)
+    d, n_users, n_base, n_delta = _D, 8, 600, 45
+    X = rng.normal(size=(n_base + n_delta, d))
+    users = np.concatenate([
+        rng.integers(0, n_users, n_base),
+        np.array([1, 4, n_users] * (n_delta // 3)),  # u1, u4 + NEW u8
+    ])
+    w = rng.normal(size=d)
+    u_eff = rng.normal(size=n_users + 1)
+    logits = X @ w + u_eff[users]
+    y = (rng.random(len(users)) < 1 / (1 + np.exp(-logits))).astype(float)
+
+    def recs(lo, hi):
+        for i in range(lo, hi):
+            yield {
+                "uid": str(i),
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"c{j}", "term": "", "value": float(X[i, j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {"userId": f"u{users[i]:03d}"},
+                "weight": None,
+                "offset": None,
+            }
+
+    train_path = str(tmp_path / "base.avro")
+    delta_path = str(tmp_path / "delta.avro")
+    write_avro(train_path, TRAINING_EXAMPLE_AVRO, recs(0, n_base),
+               block_records=64)
+    write_avro(delta_path, TRAINING_EXAMPLE_AVRO,
+               recs(n_base, n_base + n_delta), block_records=64)
+    shards = {"g": ("features",)}
+    spec = IngestSpec(chunk_rows=128, workers=2)
+    # index maps pinned over base ∪ delta: the base and combined reads
+    # must agree on feature geometry for the transplant to line up
+    imaps = build_index_maps_from_avro([train_path, delta_path], shards)
+    base_data = read_game_dataset_streamed(
+        [train_path], feature_shards=shards, index_maps=imaps,
+        id_columns=("userId",), spec=spec,
+    )
+    config = _config()
+    ckpt = str(tmp_path / "ckpt")
+    base_fit = GameEstimator(config).fit(
+        base_data,
+        checkpoint_spec=CheckpointSpec(directory=ckpt, resume=False),
+    )
+    ws = incremental.load_warm_start(ckpt)
+    scan = incremental.scan_delta_stream(
+        [delta_path], {"userId": ws.model.models["perUser"].vocab},
+        index_maps=imaps, feature_shards=shards, spec=spec,
+    )
+    comb_data = read_game_dataset_streamed(
+        [train_path, delta_path], feature_shards=shards, index_maps=imaps,
+        id_columns=("userId",), spec=spec,
+    )
+    res = GameEstimator(config).fit_incremental(comb_data, ws, delta=scan)
+
+    base_map = _entity_coeffs(base_fit.model)
+    inc_map = _entity_coeffs(res.model)
+    touched = {"u001", "u004"}
+    checked = 0
+    for val, coeffs in base_map.items():
+        if val in touched:
+            continue
+        checked += 1
+        assert inc_map[val] == coeffs, val  # bit-identical through i/o
+    assert checked >= n_users - len(touched) - 1
+    for val in touched:
+        assert any(
+            inc_map[val][g] != wv for g, wv in base_map[val].items()
+        ), f"touched entity {val} kept its base coefficients"
+    new_val = f"u{n_users:03d}"
+    assert new_val not in base_map
+    assert any(abs(v) > 1e-8 for v in inc_map[new_val].values())
+    assert res.lanes_solved >= 3 and res.lanes_skipped >= 1
+    assert scan.digest == incremental.delta_digest([delta_path])
+
+
+# ---------------------------------------------------------------------------
+# masked solves for FACTORED coordinates (frozen projection)
+# ---------------------------------------------------------------------------
+
+
+def _latent_rows(model, coord="perUser"):
+    """entity value -> latent row (host copy) for a factored coordinate."""
+    m = model.models[coord]
+    lat = np.asarray(m.latent)
+    flat = np.asarray(m.entity_flat)
+    return {
+        m.vocab[c]: lat[flat[c]]
+        for c in range(len(m.vocab)) if flat[c] >= 0
+    }
+
+
+def test_masked_factored_coordinate_parity(tmp_path):
+    """Factored (projected) coordinates get the same masked treatment:
+    untouched latent rows EXACT from the transplant, touched + new rows
+    matching a full unmasked re-solve under the same frozen projection
+    (the seeded Gaussian A is identical across all three fits)."""
+    rng = np.random.default_rng(23)
+    d, k, n_users, n_base, n_delta = _D, 3, 10, 900, 60
+    X = rng.normal(size=(n_base + n_delta, d))
+    users = np.concatenate([
+        rng.integers(0, n_users, n_base),
+        np.array([2, 7, n_users] * (n_delta // 3)),  # u2, u7 + NEW u10
+    ])
+    w = rng.normal(size=d)
+    u_eff = rng.normal(size=n_users + 1)
+    logits = X @ w + u_eff[users]
+    y = (rng.random(len(users)) < 1 / (1 + np.exp(-logits))).astype(float)
+    base_data = _build(X[:n_base], users[:n_base], y[:n_base])
+    comb_data = _build(X, users, y)
+    delta_data = _build(X[n_base:], users[n_base:], y[n_base:])
+
+    # a SINGLE factored coordinate: per-entity latent solves are convex
+    # and independent, so the masked re-solve and the full re-solve land
+    # on the same optimum for every touched entity
+    config = GameConfig(
+        task="logistic",
+        coordinates={
+            "perUser": RandomEffectConfig(
+                shard_name="g", id_name="userId", optimizer=_opt(),
+                projector="random", projected_dim=k,
+            ),
+        },
+        num_iterations=1,
+    )
+    ckpt = str(tmp_path / "ckpt")
+    base_fit = GameEstimator(config).fit(
+        base_data,
+        checkpoint_spec=CheckpointSpec(directory=ckpt, resume=False),
+    )
+    ws = incremental.load_warm_start(ckpt)
+    scan = incremental.scan_delta(
+        delta_data, {"userId": ws.model.models["perUser"].vocab}
+    )
+    res = GameEstimator(config).fit_incremental(comb_data, ws, delta=scan)
+    ref = GameEstimator(config).fit(comb_data)
+
+    base_rows = _latent_rows(base_fit.model)
+    inc_rows = _latent_rows(res.model)
+    ref_rows = _latent_rows(ref.model)
+    touched = {"u002", "u007", f"u{n_users:03d}"}
+    checked = 0
+    for val, row in base_rows.items():
+        if val in touched:
+            continue
+        checked += 1
+        # untouched latent rows are the TRANSPLANT: exact float equality
+        np.testing.assert_array_equal(inc_rows[val], row, err_msg=val)
+    assert checked >= n_users - 2
+    for val in touched:
+        np.testing.assert_allclose(
+            inc_rows[val], ref_rows[val], atol=1e-3, rtol=1e-3,
+            err_msg=f"masked re-solve of {val} off the full re-solve",
+        )
+        if val in base_rows:
+            assert not np.array_equal(inc_rows[val], base_rows[val]), val
+    # the structural evidence flows through the same lane counters
+    assert res.lanes_solved >= 3
+    assert res.lanes_skipped >= n_users - 3
+    assert res.bucket_solves >= 1
+
+
+def test_transplant_factored_dim_mismatch_is_typed(tmp_path):
+    """A base latent table of a DIFFERENT latent_dim cannot seed the new
+    coordinate — typed WarmStartError, not a silent shape blowup."""
+    rng = np.random.default_rng(29)
+    n = 300
+    X = rng.normal(size=(n, _D))
+    users = rng.integers(0, 4, n)
+    y = (rng.random(n) < 0.5).astype(float)
+    data = _build(X, users, y)
+
+    def cfg(k):
+        return GameConfig(
+            task="logistic",
+            coordinates={
+                "perUser": RandomEffectConfig(
+                    shard_name="g", id_name="userId", optimizer=_opt(),
+                    projector="random", projected_dim=k,
+                ),
+            },
+            num_iterations=1,
+        )
+
+    ckpt = str(tmp_path / "ckpt")
+    GameEstimator(cfg(3)).fit(
+        data, checkpoint_spec=CheckpointSpec(directory=ckpt, resume=False)
+    )
+    ws = incremental.load_warm_start(ckpt)
+    with pytest.raises(incremental.WarmStartError, match="latent"):
+        GameEstimator(cfg(4)).fit_incremental(data, ws)
+
+
+# ---------------------------------------------------------------------------
+# stale-delta refusal (publish gate + cli refresh --force)
+# ---------------------------------------------------------------------------
+
+
+def test_check_delta_freshness_refuses_matching_digest(glmix, tmp_path):
+    reg = str(tmp_path / "registry")
+    res = glmix["res"]
+    incremental.publish_incremental(
+        reg, res.model, {"g": [f"c{j}" for j in range(_D)]},
+        res.lineage, delta=res.delta,
+    )
+    # unchanged delta: typed refusal naming the version that already
+    # trained on it (a stuck cron must not publish no-op versions)
+    with pytest.raises(incremental.StaleDeltaError, match="v-00000001"):
+        incremental.check_delta_freshness(reg, res.delta.digest)
+    # --force and a genuinely new digest both pass
+    incremental.check_delta_freshness(reg, res.delta.digest, force=True)
+    incremental.check_delta_freshness(reg, "0" * 64)
+    # a missing or empty registry never refuses (first publish must work)
+    incremental.check_delta_freshness(
+        str(tmp_path / "nope"), res.delta.digest
+    )
+
+
+# ---------------------------------------------------------------------------
 # fault seams (L016 coverage: incremental.warm_restore,
 # incremental.delta_scan, incremental.publish)
 # ---------------------------------------------------------------------------
@@ -825,6 +1066,48 @@ def test_crash_at_publish_preserves_base_and_registry(cli_base):
     assert _tree_digest(ckpt) == before
 
 
+def test_cli_refresh_stale_delta_refusal_and_force(cli_base):
+    """`cli refresh` refuses (typed, rc != 0) a delta whose digest the
+    newest registry version already recorded — the stuck-cron guard —
+    and publishes nothing; --force deliberately republishes."""
+    tmp = cli_base["tmp"]
+    ckpt = cli_base["config"]["checkpoint"]["dir"]
+    reg = str(tmp / "stale-registry")
+
+    def args(out_name, *extra):
+        return [
+            "refresh",
+            "--config", str(cli_base["cfg_path"]),
+            "--warm-start", ckpt,
+            "--delta", cli_base["delta_path"],
+            "--registry-dir", reg,
+            "--output-dir", str(tmp / out_name),
+            *extra,
+        ]
+
+    _run_cli(args("stale-model-1"), cwd=tmp)  # publishes v-00000001
+
+    # the SAME delta again: typed refusal, nothing published
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli",
+         *args("stale-model-2")],
+        capture_output=True, text=True, cwd=str(tmp), env=env, timeout=600,
+    )
+    assert proc.returncode != 0
+    assert "StaleDeltaError" in proc.stderr
+    assert "--force" in proc.stderr  # the override is named in the error
+    assert sorted(
+        n for n in os.listdir(reg) if n.startswith("v-")
+    ) == ["v-00000001"]
+
+    # --force: the deliberate republish goes through
+    summary = _run_cli(args("stale-model-3", "--force"), cwd=tmp)
+    assert summary["freshness"]["published_version"].endswith("v-00000002")
+
+
 # ---------------------------------------------------------------------------
 # bench wiring
 # ---------------------------------------------------------------------------
@@ -834,7 +1117,18 @@ def test_bench_freshness_budget_truncation(capsys):
     import bench_freshness
 
     out = bench_freshness.run_freshness(deadline=-1.0)
-    assert out == {"freshness_speedup": None}
-    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert line["metric"] == "freshness_speedup"
-    assert line["truncated"] is True
+    # BOTH freshness metrics are reported None with truncated lines —
+    # the suite gate must see every declared metric, never a silent gap
+    assert out == {
+        "freshness_speedup": None,
+        "event_to_served_staleness_p99_s": None,
+    }
+    lines = [
+        json.loads(ln)
+        for ln in capsys.readouterr().out.strip().splitlines()
+        if ln.startswith("{")
+    ]
+    truncated = {
+        ln["metric"] for ln in lines if ln.get("truncated") is True
+    }
+    assert truncated == set(bench_freshness.FRESHNESS_METRICS)

@@ -1,0 +1,552 @@
+"""The freshness conductor (ISSUE 19): ``cli pipeline`` as a library.
+
+The acceptance spine: a 3-cycle supervised run in which EVERY cycle
+publishes a lineage-linked registry version (v1 → v2 → v3 chained via
+``lineage.base_version``), one cycle idles on an unchanged delta digest,
+the third non-idle cycle escalates to a full retrain into a fresh base
+generation under the daemon workdir, and the event→served staleness p99
+is measured and reported. Plus the hard design problem: nearline-vs-delta
+reconciliation under the retrain-wins-touched rule, tested BIT-EXACTLY —
+the winner's row equals a direct masked re-solve's row bit for bit, the
+superseded nearline version stays auditable from the published lineage,
+and the decision round-trips through ``/healthz``. Plus the three
+``pipeline.*`` fault seams (typed in-process, hard-killed via
+``tools/chaos.py --pipeline``), ``/statusz`` live status, and the
+RunReport "Pipeline" section.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import faults, incremental, telemetry
+from photon_ml_tpu.faults import (
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    clear_plan,
+    install_plan,
+)
+from photon_ml_tpu.game import GameEstimator
+from photon_ml_tpu.game.checkpoint import CheckpointSpec
+from photon_ml_tpu.pipeline import (
+    RECONCILE_RULE,
+    FreshnessPipeline,
+    PipelineSpec,
+)
+
+_D = 6
+_N_USERS = 10  # base users "0".."9"; deltas may add the NEW user "10"
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    """One avro base + in-process base fit with a step checkpoint — the
+    warm-start world every conductor test cycles on — plus a delta
+    writer so each test appends its own shards."""
+    from photon_ml_tpu.cli.train import read_input
+    from photon_ml_tpu.config import parse_game_config
+    from photon_ml_tpu.data.avro import TRAINING_EXAMPLE_AVRO, write_avro
+
+    tmp = tmp_path_factory.mktemp("pipeline")
+    rng = np.random.default_rng(11)
+    n_base = 400
+    w = rng.normal(size=_D)
+    u_eff = rng.normal(size=_N_USERS + 2)
+
+    def rows(users, seed):
+        r = np.random.default_rng(seed)
+        X = r.normal(size=(len(users), _D))
+        logits = X @ w + u_eff[users]
+        y = (r.random(len(users)) < 1 / (1 + np.exp(-logits))).astype(float)
+        return X, y
+
+    def recs(X, y, users):
+        for i in range(len(users)):
+            yield {
+                "uid": str(i),
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"c{j}", "term": "", "value": float(X[i, j])}
+                    for j in range(_D)
+                ],
+                "metadataMap": {"userId": str(users[i])},
+                "weight": None,
+                "offset": None,
+            }
+
+    # every base user appears at least once so the perUser vocab is full
+    users = np.concatenate([
+        np.arange(_N_USERS),
+        rng.integers(0, _N_USERS, n_base - _N_USERS),
+    ])
+    Xb, yb = rows(users, 101)
+    train_path = str(tmp / "train.avro")
+    write_avro(train_path, TRAINING_EXAMPLE_AVRO, recs(Xb, yb, users))
+
+    def write_delta(path, user_ids, seed):
+        du = np.asarray(user_ids)
+        Xd, yd = rows(du, seed)
+        write_avro(path, TRAINING_EXAMPLE_AVRO, recs(Xd, yd, du))
+
+    config = {
+        "task": "logistic",
+        "input": {
+            "format": "avro",
+            "paths": [train_path],
+            "feature_shards": {"global": ["features"]},
+            "id_columns": ["userId"],
+        },
+        "coordinates": {
+            "fixed": {
+                "type": "fixed_effect",
+                "shard_name": "global",
+                "optimizer": {"regularization": "l2",
+                              "regularization_weight": 0.1},
+            },
+            "perUser": {
+                "type": "random_effect",
+                "shard_name": "global",
+                "id_name": "userId",
+                "optimizer": {"regularization": "l2",
+                              "regularization_weight": 1.0},
+            },
+        },
+        "num_iterations": 1,
+    }
+    ckpt = str(tmp / "base-ckpt")
+    data, imaps = read_input(config["input"])
+    GameEstimator(parse_game_config(config)).fit(
+        data, checkpoint_spec=CheckpointSpec(directory=ckpt, resume=False)
+    )
+    telemetry.reset()
+    return dict(tmp=tmp, config=config, ckpt=ckpt, train_path=train_path,
+                write_delta=write_delta, imaps=imaps)
+
+
+def _entity_coeffs(model, coord="perUser"):
+    """entity value -> {global feature id: coefficient} (geometry-free;
+    dict equality IS bitwise row equality — same helper as
+    test_incremental)."""
+    re = model.models[coord]
+    out = {}
+    for bm in re.buckets:
+        P = np.asarray(bm.projection)
+        W = np.asarray(bm.coefficients)
+        codes = np.asarray(bm.entity_codes)
+        for e in range(len(codes)):
+            val = re.vocab[codes[e]]
+            out[val] = {
+                int(g): float(W[e, k]) for k, g in enumerate(P[e])
+            }
+    return out
+
+
+def _spec(world, tmp_path, delta_dir, **kw):
+    base = dict(
+        config=world["config"],
+        delta_dir=str(delta_dir),
+        base_dir=world["ckpt"],
+        registry_dir=str(tmp_path / "registry"),
+        workdir=str(tmp_path / "work"),
+        interval_s=0.01,
+        # the fraction trigger is disabled by default so tests decide
+        # escalation deterministically via the cycle-count trigger
+        escalate_touched_fraction=1.1,
+    )
+    base.update(kw)
+    return PipelineSpec(**base)
+
+
+# ---------------------------------------------------------------------------
+# the 3-cycle supervised run (the acceptance spine)
+# ---------------------------------------------------------------------------
+
+
+def test_three_cycle_supervised_run(world, tmp_path):
+    """Three non-idle cycles: each publishes a lineage-linked version
+    (base_version chains v1 → v2 → v3), an unchanged digest idles, the
+    third trips escalate_after_cycles=3 into a full retrain that
+    re-bases under the workdir, the live registry hot-swaps to the
+    freshest version, staleness p99 is reported, a restarted conductor
+    re-seeds its cursor and idles, and the RunReport renders Pipeline."""
+    from photon_ml_tpu.data.model_store import load_game_model_metadata
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    delta_dir = tmp_path / "deltas"
+    delta_dir.mkdir()
+    spec = _spec(world, tmp_path, delta_dir,
+                 escalate_after_cycles=3, serve=True)
+    reg = spec.registry_dir
+    pipe = FreshnessPipeline(spec)
+    try:
+        world["write_delta"](
+            str(delta_dir / "delta-0001.avro"), [1, 2, _N_USERS] * 8, 201
+        )
+        e1 = pipe.run_cycle()
+        assert e1["idle"] is False
+        assert e1["published_version"] == "v-00000001"
+        assert e1["escalated"] is False
+        assert e1["staleness_p99_s"] >= 0.0
+        assert e1["reconciliation"]["rule"] == RECONCILE_RULE
+        assert e1["reconciliation"]["nearline_version"] is None
+
+        # unchanged digest -> idle: no read, no fit, no publish
+        e2 = pipe.run_cycle()
+        assert e2["idle"] is True and e2["published_version"] is None
+
+        world["write_delta"](
+            str(delta_dir / "delta-0002.avro"), [3, 4] * 10, 202
+        )
+        e3 = pipe.run_cycle()
+        assert e3["published_version"] == "v-00000002"
+        assert e3["escalated"] is False
+
+        world["write_delta"](
+            str(delta_dir / "delta-0003.avro"), [5] * 12, 203
+        )
+        e4 = pipe.run_cycle()
+        assert e4["published_version"] == "v-00000003"
+        assert e4["escalated"] is True  # 3rd non-idle cycle since full
+
+        # every cycle published a version whose lineage names its
+        # ancestor — the chain is auditable from the registry alone
+        metas = {
+            n: load_game_model_metadata(os.path.join(reg, n))
+            for n in ("v-00000001", "v-00000002", "v-00000003")
+        }
+        lin = {n: m["extra"]["lineage"] for n, m in metas.items()}
+        assert "base_version" not in lin["v-00000001"]  # empty registry
+        assert lin["v-00000002"]["base_version"] == "v-00000001"
+        assert lin["v-00000003"]["base_version"] == "v-00000002"
+        for n in metas:
+            assert lin[n]["delta_digest"]
+            assert lin[n]["reconciliation"]["rule"] == RECONCILE_RULE
+        # the recorded digest IS the conductor's cursor: the whole
+        # delta-dir glob, so a restart sees nothing new
+        paths = sorted(glob.glob(str(delta_dir / "*.avro")))
+        assert lin["v-00000003"]["delta_digest"] == (
+            incremental.delta_digest(paths)
+        )
+        assert metas["v-00000003"]["extra"]["pipeline"]["escalated"] is True
+        assert metas["v-00000003"]["extra"]["pipeline"]["cycle"] == 4
+        assert metas["v-00000002"]["extra"]["pipeline"]["escalated"] is False
+
+        # the escalation re-based the conductor into a fresh generation
+        # under ITS workdir — the original base is never written
+        s = pipe.summary()
+        assert s["base_dir"].startswith(str(tmp_path / "work"))
+        assert "base-gen-" in s["base_dir"]
+        assert s["cycles"] == 4 and s["idle_cycles"] == 1
+        assert s["published_versions"] == [
+            "v-00000001", "v-00000002", "v-00000003",
+        ]
+        assert s["escalations"] == 1
+        assert s["event_to_served_staleness_p99_s"] is not None
+        assert s["event_to_served_staleness_p99_s"] >= 0.0
+
+        # the live registry hot-swapped to the freshest version
+        assert pipe._registry is not None
+        assert pipe._registry.current_version == "v-00000003"
+
+        # the run's telemetry renders the Pipeline report section
+        report = RunReport.from_live()
+        doc = report.pipeline_summary()
+        assert doc is not None
+        assert doc["cycles"] == 4 and doc["idle_cycles"] == 1
+        assert doc["publishes"] == 3 and doc["escalations"] == 1
+        assert doc["event_to_served_staleness_p99_s"] >= 0.0
+        assert doc["cycle_time_s"]["count"] == 3
+        md = report.to_markdown()
+        assert "## Pipeline" in md
+        assert "staleness p99" in md
+    finally:
+        pipe._close("completed")
+
+    # crash-restart idempotence: a NEW conductor over the same dirs
+    # seeds its digest cursor from the newest published lineage and
+    # idles instead of re-publishing the delta it already served
+    pipe2 = FreshnessPipeline(spec)
+    try:
+        assert pipe2.run_cycle()["idle"] is True
+    finally:
+        pipe2._close("completed")
+
+
+# ---------------------------------------------------------------------------
+# nearline-vs-delta reconciliation, bit-exact
+# ---------------------------------------------------------------------------
+
+
+def test_reconciliation_retrain_wins_touched_bit_exact(world, tmp_path):
+    """The conductor's hard case: user "1" is BOTH nearline-updated (a
+    per-entity residual solve published as v2) and in the next delta's
+    touched set. Retrain-wins-touched: the conductor's v3 carries the
+    masked re-solve's row for "1" BIT-EXACTLY (equal to a direct
+    fit_incremental over the same inputs), the superseded nearline row
+    differs and stays auditable — v2 keeps its nearline metadata and
+    v3's lineage names it — untouched users keep their BASE rows
+    bit-identically, and the decision round-trips through /healthz."""
+    from photon_ml_tpu.cli.train import read_input
+    from photon_ml_tpu.config import parse_game_config
+    from photon_ml_tpu.data.model_store import (
+        load_game_model,
+        load_game_model_metadata,
+    )
+    from photon_ml_tpu.serving.engine import ScoringEngine
+    from photon_ml_tpu.serving.nearline import NearlineUpdater
+    from photon_ml_tpu.serving.registry import publish_version
+    from photon_ml_tpu.serving.server import ScoringService
+
+    reg = str(tmp_path / "registry")
+    ws = incremental.load_warm_start(world["ckpt"])
+    base_map = _entity_coeffs(ws.model)
+
+    # v1: the base model as served
+    publish_version(reg, ws.model, world["imaps"])
+    v1 = os.path.join(reg, "v-00000001")
+
+    # v2: the nearline tier re-solves user "1" online and publishes
+    engine = ScoringEngine.load(v1, max_batch=8).warmup()
+    updater = NearlineUpdater(
+        engine, id_name="userId", rows_per_solve=2,
+        publish_dir=reg, index_maps=world["imaps"],
+    )
+    target = "1"
+    updater.submit([
+        {"ids": {"userId": target},
+         "features": {"global": [[0, 1.0], [2, -0.5]]},
+         "label": 1.0, "offset": 0.0},
+        {"ids": {"userId": target},
+         "features": {"global": [[1, 0.7], [3, 0.4]]},
+         "label": 0.0, "offset": 0.0},
+    ])
+    flushed = updater.flush()
+    assert flushed["applies"] >= 1
+    seq = engine.nearline_seq
+    assert seq >= 1
+    v2 = updater.publish()
+    assert os.path.basename(v2) == "v-00000002"
+    v2_map = _entity_coeffs(load_game_model(v2))
+    assert v2_map[target] != base_map[target]  # nearline moved the row
+
+    # the delta touches the nearline-updated user "1" plus "5"
+    delta_dir = tmp_path / "deltas"
+    delta_dir.mkdir()
+    delta_path = str(delta_dir / "delta-0001.avro")
+    world["write_delta"](delta_path, [1, 5] * 12, 401)
+
+    spec = _spec(world, tmp_path, delta_dir, serve=True)
+    pipe = FreshnessPipeline(spec)
+    try:
+        entry = pipe.run_cycle()
+    finally:
+        pipe._close("completed")
+    assert entry["published_version"] == "v-00000003"
+    dec = entry["reconciliation"]
+    assert dec["rule"] == RECONCILE_RULE
+    assert dec["nearline_version"] == "v-00000002"
+    assert dec["nearline_seq"] == seq
+    assert dec["nearline_base_version"] == "v-00000001"
+    assert dec["touched_count"] == 2
+
+    # the winner's row, bit for bit: a direct masked re-solve over the
+    # exact same base checkpoint + delta must reproduce v3's row for the
+    # contested user (same readers, same estimator, same inputs)
+    cfg = world["config"]
+    delta_data, _ = read_input({**cfg["input"], "paths": [delta_path]})
+    scan = incremental.scan_delta(
+        delta_data, {"userId": ws.model.models["perUser"].vocab},
+        paths=[delta_path],
+    )
+    comb_data, _ = read_input(
+        {**cfg["input"], "paths": [world["train_path"], delta_path]}
+    )
+    ref = GameEstimator(parse_game_config(cfg)).fit_incremental(
+        comb_data, ws, delta=scan
+    )
+    ref_map = _entity_coeffs(ref.model)
+    v3_path = os.path.join(reg, "v-00000003")
+    v3_map = _entity_coeffs(load_game_model(v3_path))
+    assert v3_map[target] == ref_map[target]  # retrain won, EXACTLY
+    assert v3_map[target] != v2_map[target]   # nearline row superseded
+    # untouched users keep their BASE rows (not the nearline version's):
+    # the masked fit warm-starts from the base checkpoint
+    untouched = [v for v in base_map if v not in (target, "5")]
+    assert untouched
+    for val in untouched:
+        assert v3_map[val] == base_map[val], val
+
+    # the loser stays auditable: v2 keeps its nearline metadata, v3's
+    # lineage names the superseded version + sequence
+    meta2 = load_game_model_metadata(v2)
+    assert meta2["extra"]["nearline_seq"] == seq
+    assert meta2["extra"]["nearline_base_version"] == "v-00000001"
+    lin3 = load_game_model_metadata(v3_path)["extra"]["lineage"]
+    assert lin3["reconciliation"] == dec
+    assert lin3["base_version"] == "v-00000002"
+
+    # ... and round-trips through /healthz off the served version
+    health = ScoringService(ScoringEngine.load(v3_path)).health()
+    assert health["model_version"] == "v-00000003"
+    assert health["lineage"]["reconciliation"]["nearline_version"] == (
+        "v-00000002"
+    )
+    assert health["lineage"]["base_version"] == "v-00000002"
+
+
+# ---------------------------------------------------------------------------
+# /statusz + the daemon loop
+# ---------------------------------------------------------------------------
+
+
+def test_run_loop_writes_statusz_and_summary(world, tmp_path):
+    """run() under max_cycles: the conductor is a 1-member fleet whose
+    status document carries the cycle counters, publish/escalation
+    counts, and staleness p99 — and lands outcome=completed on close."""
+    delta_dir = tmp_path / "deltas"
+    delta_dir.mkdir()
+    world["write_delta"](str(delta_dir / "delta-0001.avro"), [1, 2] * 9, 301)
+    status_file = str(tmp_path / "status.json")
+    spec = _spec(world, tmp_path, delta_dir, max_cycles=2,
+                 serve=False, status_file=status_file)
+    summary = FreshnessPipeline(spec).run()
+    assert summary["cycles"] == 2 and summary["idle_cycles"] == 1
+    assert summary["published_versions"] == ["v-00000001"]
+    assert summary["interrupted"] is False
+    assert summary["event_to_served_staleness_p99_s"] >= 0.0
+
+    with open(status_file, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc["type"] == "fleet_status"
+    assert doc["outcome"] == "completed"
+    assert doc["generation"] == 2  # generation doubles as cycle count
+    member = doc["members"]["0"]
+    assert member["pipeline"]["publishes"] == 1
+    assert member["pipeline"]["idle_cycles"] == 1
+    assert member["pipeline"]["escalations"] == 0
+    assert member["pipeline"]["staleness_p99_s"] >= 0.0
+    assert member["pipeline"]["served_version"] is None  # serve=False
+    assert member["pipeline"]["base_dir"] == world["ckpt"]
+
+
+def test_request_stop_interrupts_cleanly(world, tmp_path):
+    """A stop request before the loop starts exits with the interrupted
+    outcome and zero cycles — the SIGTERM path minus the signal."""
+    delta_dir = tmp_path / "deltas"
+    delta_dir.mkdir()
+    pipe = FreshnessPipeline(_spec(world, tmp_path, delta_dir, serve=False))
+    pipe.request_stop()
+    summary = pipe.run()
+    assert summary["interrupted"] is True
+    assert summary["cycles"] == 0 and summary["published_versions"] == []
+
+
+# ---------------------------------------------------------------------------
+# fault seams: typed in-process; hard kills via tools/chaos.py --pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_points_enumeration_is_stable():
+    """The seam set tools/chaos.py --pipeline matrixes over is part of
+    the contract: a new conductor seam must be added HERE (and thereby
+    to the matrix and lint L016) to land."""
+    import photon_ml_tpu.pipeline  # noqa: F401 (registers points)
+    from tools import chaos
+
+    assert list(chaos.PIPELINE_POINTS) == [
+        "pipeline.cycle_start",
+        "pipeline.reconcile",
+        "pipeline.escalate",
+    ]
+    assert set(chaos.PIPELINE_POINTS) <= set(faults.registered_points())
+
+
+def test_pipeline_seams_fire_typed(world, tmp_path):
+    """Each pipeline.* seam raises the typed InjectedFault from inside
+    run_cycle, and a cycle aborted at ANY seam leaves the registry
+    without a published version (the publish never started)."""
+    delta_dir = tmp_path / "deltas"
+    delta_dir.mkdir()
+    world["write_delta"](str(delta_dir / "delta-0001.avro"), [1, 2] * 9, 501)
+    rows = (
+        ("pipeline.cycle_start", {}),
+        ("pipeline.reconcile", {}),
+        # the escalate seam only fires when escalation actually trips
+        ("pipeline.escalate", {"escalate_after_cycles": 1}),
+    )
+    for point, kw in rows:
+        sub = tmp_path / point.replace(".", "_")
+        sub.mkdir()
+        spec = _spec(world, sub, delta_dir, serve=False, **kw)
+        pipe = FreshnessPipeline(spec)
+        install_plan(FaultPlan([FaultRule(point, action="raise")]))
+        try:
+            with pytest.raises(InjectedFault):
+                pipe.run_cycle()
+        finally:
+            clear_plan()
+            pipe._close("failed")
+        reg = spec.registry_dir
+        assert not os.path.isdir(reg) or not any(
+            n.startswith("v-") for n in os.listdir(reg)
+        ), point
+
+
+@pytest.mark.chaos
+def test_pipeline_crash_row_tier1(tmp_path):
+    """Budget-capped tier-1 slice of the pipeline crash matrix: the
+    cli pipeline daemon hard-killed (os._exit 113) at the top of a
+    cycle leaves the base checkpoint byte-identical and the registry
+    partial-free, and the unarmed rerun over the same directories
+    publishes a lineage-linked version. The full 3-seam matrix runs
+    under --slow / `python -m tools.chaos --pipeline`."""
+    from tools import chaos
+
+    budget = float(os.environ.get("PHOTON_CHAOS_BUDGET_S", "300"))
+    report = chaos.run_pipeline_matrix(
+        str(tmp_path), points=["pipeline.cycle_start"], budget_s=budget
+    )
+    if report["skipped"]:
+        warnings.warn(
+            "chaos budget truncated the pipeline matrix; uncovered this "
+            f"run: {report['skipped']} (full matrix: python -m "
+            "tools.chaos --pipeline)",
+            stacklevel=1,
+        )
+        return
+    assert report["ok"], json.dumps(report, indent=2)
+    entry = report["results"]["pipeline.cycle_start"]
+    assert entry["armed_rc"] == faults.DEFAULT_EXIT_CODE
+    assert entry["published_versions"]
+    assert entry["registry_after_resume"]
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_pipeline_crash_matrix_every_seam_recovers(tmp_path):
+    """The full pipeline crash matrix: for EVERY pipeline.* seam, a
+    daemon hard-killed at the seam leaves the base byte-identical and
+    the registry partial-free, and the rerun publishes."""
+    from tools import chaos
+
+    budget = float(os.environ.get("PHOTON_CHAOS_BUDGET_S", "600"))
+    report = chaos.run_pipeline_matrix(str(tmp_path), budget_s=budget)
+    assert report["ok"], json.dumps(report, indent=2)
+    covered = [p for p, e in report["results"].items() if e.get("passed")]
+    assert covered, "the budget covered no pipeline point at all"
+    for entry in report["results"].values():
+        assert entry["armed_rc"] == faults.DEFAULT_EXIT_CODE
+        assert entry["published_versions"]
+    if report["skipped"]:
+        warnings.warn(
+            "chaos budget truncated the pipeline matrix; uncovered this "
+            f"run: {report['skipped']}",
+            stacklevel=1,
+        )

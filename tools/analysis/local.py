@@ -77,6 +77,11 @@ L011_HOT_DIRS = (
     # bare jax.jit there would hide exactly the solve-count structure
     # bench_freshness gates the ≥10× time-to-fresh claim on
     os.path.join("photon_ml_tpu", "incremental") + os.sep,
+    # the freshness conductor re-runs masked solves (and escalated full
+    # fits) every cycle of a long-lived daemon: a bare jax.jit there
+    # would hide recompiles that accumulate directly into the
+    # event→served staleness p99 the pipeline tier gates on
+    os.path.join("photon_ml_tpu", "pipeline") + os.sep,
 )
 L011_HOT_FILES = {
     os.path.join("photon_ml_tpu", "serving", "engine.py"),

@@ -39,6 +39,7 @@ CLI::
     python -m tools.chaos --workdir /tmp/chaos            # full matrix
     python -m tools.chaos --workdir /tmp/chaos --json out.json
     python -m tools.chaos --workdir /tmp/chaos --fleet    # distributed rows
+    python -m tools.chaos --workdir /tmp/chaos --pipeline # conductor rows
     python -m tools.chaos --worker --dir D                # one fit (internal)
 
 The worker fit is self-contained and seed-deterministic (same chunk data
@@ -50,6 +51,7 @@ the protocol and the rerun must still converge to the reference bits.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import subprocess
@@ -815,6 +817,260 @@ def _version_lookups(version_dir: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# the PIPELINE crash matrix (freshness-conductor daemon rows)
+# ---------------------------------------------------------------------------
+
+#: the conductor's supervised-cycle seams, in cycle order. Every row
+#: hard-kills the ``cli pipeline`` daemon subprocess AT the seam (rc=113
+#: asserted) and must leave the warm-start base checkpoint byte-identical
+#: and the registry free of partial versions; the unarmed rerun over the
+#: same directories must publish a lineage-linked version.
+PIPELINE_POINTS = (
+    "pipeline.cycle_start",
+    "pipeline.reconcile",
+    "pipeline.escalate",
+)
+
+
+def _tree_digest(root: str) -> str:
+    """Byte-level digest of a directory tree (relative paths + content) —
+    the 'base checkpoint untouched' assertions are stated over this."""
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            h.update(os.path.relpath(p, root).encode())
+            with open(p, "rb") as fh:
+                h.update(fh.read())
+    return h.hexdigest()
+
+
+def _pipeline_fixture(workdir: str) -> dict:
+    """The pipeline rows' shared world: a tiny avro base + one delta
+    shard (touching 2 of 8 users plus one NEW user — a touched fraction
+    safely under the conductor's default escalation threshold, so
+    unarmed reruns stay incremental) + train config, and the base fit's
+    step checkpoint built via ``cli train`` in a CPU subprocess.
+    Returns {cfg_path, ckpt, delta_dir}."""
+    import numpy as np
+
+    from photon_ml_tpu.data.avro import TRAINING_EXAMPLE_AVRO, write_avro
+
+    os.makedirs(workdir, exist_ok=True)
+    rng = np.random.default_rng(DATA_SEED)
+    d, n_users, n_base, n_delta = 6, 8, 160, 36
+    X = rng.normal(size=(n_base + n_delta, d))
+    users = np.concatenate([
+        rng.integers(0, n_users, n_base),
+        np.array([1, 2, n_users] * (n_delta // 3)),  # u1, u2 + NEW u8
+    ])
+    w = rng.normal(size=d)
+    u_eff = rng.normal(size=n_users + 1)
+    logits = X @ w + u_eff[users]
+    y = (rng.random(len(users)) < 1 / (1 + np.exp(-logits))).astype(float)
+
+    def recs(lo, hi):
+        for i in range(lo, hi):
+            yield {
+                "uid": str(i),
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"c{j}", "term": "", "value": float(X[i, j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {"userId": str(users[i])},
+                "weight": None,
+                "offset": None,
+            }
+
+    train_path = os.path.join(workdir, "train.avro")
+    delta_dir = os.path.join(workdir, "deltas")
+    os.makedirs(delta_dir, exist_ok=True)
+    write_avro(train_path, TRAINING_EXAMPLE_AVRO, recs(0, n_base))
+    write_avro(os.path.join(delta_dir, "delta-0001.avro"),
+               TRAINING_EXAMPLE_AVRO, recs(n_base, n_base + n_delta))
+    ckpt = os.path.join(workdir, "base-ckpt")
+    config = {
+        "task": "logistic",
+        "input": {
+            "format": "avro",
+            "paths": [train_path],
+            "feature_shards": {"global": ["features"]},
+            "id_columns": ["userId"],
+        },
+        "coordinates": {
+            "fixed": {
+                "type": "fixed_effect",
+                "shard_name": "global",
+                "optimizer": {"regularization": "l2",
+                              "regularization_weight": 0.1},
+            },
+            "perUser": {
+                "type": "random_effect",
+                "shard_name": "global",
+                "id_name": "userId",
+                "optimizer": {"regularization": "l2",
+                              "regularization_weight": 1.0},
+            },
+        },
+        "num_iterations": 1,
+        "output_dir": os.path.join(workdir, "base-model"),
+        "checkpoint": {"dir": ckpt, "resume": False},
+    }
+    cfg_path = os.path.join(workdir, "train.json")
+    with open(cfg_path, "w", encoding="utf-8") as fh:
+        json.dump(config, fh)
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli", "train",
+         "--config", cfg_path],
+        env=_worker_env(None), cwd=_repo_root(),
+        capture_output=True, text=True, timeout=600,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pipeline fixture base train failed (rc={proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+        )
+    return {"cfg_path": cfg_path, "ckpt": ckpt, "delta_dir": delta_dir}
+
+
+def run_pipeline_matrix(
+    workdir: str,
+    points: Optional[Sequence[str]] = None,
+    budget_s: Optional[float] = None,
+) -> dict:
+    """The freshness-conductor crash matrix: for every ``pipeline.*``
+    seam, a ``cli pipeline`` daemon armed to hard-kill at that seam must
+    (1) die WITH the injection exit code (at the seam, not elsewhere),
+    (2) leave the warm-start base checkpoint BYTE-IDENTICAL,
+    (3) leave the registry free of partial versions and ``.tmp-`` debris,
+    and (4) publish a lineage-linked version on the unarmed rerun over
+    the exact same directories — the restart story a supervisor relies
+    on. The ``pipeline.escalate`` row arms escalation-after-1-cycle so
+    the seam actually fires (and its rerun proves the FULL-retrain cycle
+    also leaves the original base untouched: escalations re-base into
+    new generations under the daemon workdir, never in place).
+
+    Budget-aware like :func:`run_matrix`: points beyond ``budget_s`` are
+    reported ``skipped``, never silently dropped.
+    """
+    from photon_ml_tpu import faults
+
+    # the pipeline seams register at import of the conductor package
+    import photon_ml_tpu.pipeline  # noqa: F401
+
+    known = list(PIPELINE_POINTS)
+    points = list(points) if points is not None else known
+    unknown = sorted(set(points) - set(known))
+    if unknown:
+        raise ValueError(
+            f"not pipeline fault points: {unknown} (known: {known})"
+        )
+    t0 = time.monotonic()
+    report: dict = {
+        "workdir": workdir,
+        "points": points,
+        "results": {},
+        "skipped": [],
+        "ok": True,
+    }
+    fix = _pipeline_fixture(workdir)
+    base_before = _tree_digest(fix["ckpt"])
+    report["base_digest"] = base_before
+
+    for point in points:
+        if budget_s is not None and time.monotonic() - t0 > budget_s:
+            report["skipped"] = [
+                p for p in points if p not in report["results"]
+            ]
+            break
+        entry: dict = {"point": point}
+        problems: list = []
+        sub = os.path.join(workdir, point.replace(".", "_"))
+        reg = os.path.join(sub, "registry")
+        cmd = [
+            sys.executable, "-m", "photon_ml_tpu.cli", "pipeline",
+            "--config", fix["cfg_path"],
+            "--base", fix["ckpt"],
+            "--delta-dir", fix["delta_dir"],
+            "--registry-dir", reg,
+            "--workdir", os.path.join(sub, "work"),
+            "--cycles", "1",
+            "--interval-s", "0.1",
+        ]
+        if point == "pipeline.escalate":
+            cmd += ["--escalate-after-cycles", "1"]
+        armed = subprocess.run(
+            cmd, env=_worker_env(exit_plan(point)), cwd=_repo_root(),
+            capture_output=True, text=True, timeout=600,
+        )
+        entry["armed_rc"] = armed.returncode
+        if armed.returncode != faults.DEFAULT_EXIT_CODE:
+            problems.append(
+                f"armed daemon exited {armed.returncode}, expected "
+                f"{faults.DEFAULT_EXIT_CODE} (did the seam fire?) "
+                f"{armed.stderr[-500:]}"
+            )
+        if _tree_digest(fix["ckpt"]) != base_before:
+            problems.append(
+                "hard kill mutated the warm-start base checkpoint"
+            )
+        debris = sorted(os.listdir(reg)) if os.path.isdir(reg) else []
+        entry["registry_after_kill"] = debris
+        if any(n.startswith("v-") for n in debris):
+            problems.append(
+                f"kill mid-cycle left published version(s): {debris}"
+            )
+        if any(n.startswith(".tmp-") for n in debris):
+            problems.append(
+                f"kill left .tmp- assembly debris: {debris}"
+            )
+        # unarmed rerun over the SAME directories: the daemon re-seeds
+        # its digest cursor, re-runs the cycle, and publishes
+        resumed = subprocess.run(
+            cmd, env=_worker_env(None), cwd=_repo_root(),
+            capture_output=True, text=True, timeout=600,
+        )
+        entry["resume_rc"] = resumed.returncode
+        if resumed.returncode != 0:
+            problems.append(
+                f"unarmed rerun failed (rc={resumed.returncode}): "
+                f"{resumed.stdout[-500:]} {resumed.stderr[-500:]}"
+            )
+        else:
+            try:
+                summary = json.loads(
+                    resumed.stdout.strip().splitlines()[-1]
+                )
+            except (ValueError, IndexError):
+                summary = {}
+            entry["published_versions"] = summary.get("published_versions")
+            entry["staleness_p99_s"] = summary.get(
+                "event_to_served_staleness_p99_s"
+            )
+            if not summary.get("published_versions"):
+                problems.append("unarmed rerun published nothing")
+            versions = sorted(
+                n for n in os.listdir(reg) if n.startswith("v-")
+            ) if os.path.isdir(reg) else []
+            entry["registry_after_resume"] = versions
+            if not versions:
+                problems.append(
+                    "no registry version after the unarmed rerun"
+                )
+        if _tree_digest(fix["ckpt"]) != base_before:
+            problems.append("unarmed rerun mutated the base checkpoint")
+        if problems:
+            entry["error"] = "; ".join(problems)
+            report["ok"] = False
+        entry["passed"] = not problems
+        report["results"][point] = entry
+    report["elapsed_s"] = round(time.monotonic() - t0, 3)
+    return report
+
+
+# ---------------------------------------------------------------------------
 # the worker fit (runs in the subprocess)
 # ---------------------------------------------------------------------------
 
@@ -904,6 +1160,10 @@ def main(argv=None) -> int:
                         help="run the SERVING matrix (shard-owning fleet "
                         "seams + the hard-kill-under-traffic row) instead "
                         "of the write-path matrix")
+    parser.add_argument("--pipeline", action="store_true",
+                        help="run the PIPELINE matrix (the freshness-"
+                        "conductor daemon hard-killed at each pipeline.* "
+                        "seam) instead of the write-path matrix")
     parser.add_argument("--points", nargs="*",
                         help="subset of write-path points (default: all)")
     parser.add_argument("--nth", type=int, default=1,
@@ -920,7 +1180,11 @@ def main(argv=None) -> int:
         return _worker_main(args.dir)
     if not args.workdir:
         parser.error("--workdir is required (or --worker --dir)")
-    if args.serving_fleet:
+    if args.pipeline:
+        report = run_pipeline_matrix(
+            args.workdir, points=args.points, budget_s=args.budget_s,
+        )
+    elif args.serving_fleet:
         report = run_serving_matrix(
             args.workdir, rows=args.points, budget_s=args.budget_s,
         )
@@ -937,7 +1201,12 @@ def main(argv=None) -> int:
         with open(args.json_out, "w", encoding="utf-8") as fh:
             json.dump(report, fh, indent=2, sort_keys=True)
     for point, entry in report["results"].items():
-        if args.serving_fleet:
+        if args.pipeline:
+            status = "ok" if entry.get("passed") else "FAIL"
+            print(f"{status:4s} {point}  (armed rc={entry.get('armed_rc')}, "
+                  f"published={entry.get('published_versions')}, "
+                  f"error={entry.get('error')})")
+        elif args.serving_fleet:
             status = "ok" if entry.get("passed") else "FAIL"
             print(f"{status:4s} {point}  (degraded="
                   f"{entry.get('degraded_scores')}, "
