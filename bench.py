@@ -283,6 +283,7 @@ from bench_suite import SUITE_METRICS as _SUITE_METRICS
 #: Expected metric lines per sub-benchmark, so a budget-skipped script
 #: still emits one valid truncated line PER metric it would have printed.
 #: bench_suite's names come from its own module — one source of truth.
+from bench_diagnostics import DIAGNOSTICS_METRICS as _DIAGNOSTICS_METRICS
 from bench_freshness import FRESHNESS_METRICS as _FRESHNESS_METRICS
 from bench_ingest import INGEST_METRICS as _INGEST_METRICS
 from bench_multichip import MULTICHIP_METRICS as _MULTICHIP_METRICS
@@ -298,6 +299,7 @@ _SCRIPT_METRICS = {
     "bench_overlap.py": _OVERLAP_METRICS,
     "bench_ingest.py": _INGEST_METRICS,
     "bench_freshness.py": _FRESHNESS_METRICS,
+    "bench_diagnostics.py": _DIAGNOSTICS_METRICS,
     "bench_serving.py": ("serving_p50_ms", "serving_p99_ms",
                          "serving_rows_per_sec",
                          "serving_fleet_p99_resize_ratio",
@@ -323,7 +325,8 @@ def run_sub_benchmarks(deadline=None):
     for script in ("bench_suite.py", "bench_game.py", "bench_scale.py",
                    "bench_multichip.py", "bench_sweep.py",
                    "bench_overlap.py", "bench_ingest.py",
-                   "bench_freshness.py", "bench_serving.py",
+                   "bench_freshness.py", "bench_diagnostics.py",
+                   "bench_serving.py",
                    "bench_northstar.py"):
         path = os.path.join(here, script)
         expected = _SCRIPT_METRICS.get(script, (script.replace(".py", ""),))

@@ -81,6 +81,10 @@ LOWER_IS_BETTER_METRICS = frozenset({
     # from delta-event mtime to registry hot-swap confirmed — the
     # pipeline tier's headline SLO regresses upward
     "event_to_served_staleness_p99_s",
+    # quality diagnostics (bench_diagnostics): B=64 bootstrap wall clock
+    # as a multiple of one fit — the lane-vectorization claim (<= 2.0 on
+    # TPU) regresses upward
+    "bootstrap_overhead_ratio",
 })
 
 
@@ -536,6 +540,15 @@ def main(argv=None) -> int:
         "in the gate; baselines that predate it skip with a note",
     )
     parser.add_argument(
+        "--diagnostics",
+        action="store_true",
+        help="also run bench_diagnostics.py (B=64 GLMix bootstrap wall "
+        "time as a multiple of one fit — the vmapped resample-lane "
+        "claim, <= 2.0 on TPU) and include bootstrap_overhead_ratio in "
+        "the gate (lower is better); baselines that predate it skip "
+        "with a note",
+    )
+    parser.add_argument(
         "--serving",
         action="store_true",
         help="also run bench_serving.py's sustained-load SLO sweep "
@@ -580,6 +593,10 @@ def main(argv=None) -> int:
         from bench_freshness import run_freshness
 
         results.update(run_freshness(deadline=deadline))
+    if args.diagnostics:
+        from bench_diagnostics import run_diagnostics
+
+        results.update(run_diagnostics(deadline=deadline))
     if args.serving:
         from bench_serving import run_serving_slo, run_trace_overhead
 

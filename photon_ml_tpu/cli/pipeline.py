@@ -84,6 +84,18 @@ def main(argv=None) -> int:
         "cycles since the last full one (default 0 = never by count)",
     )
     parser.add_argument(
+        "--no-quality-gate", action="store_true",
+        help="bypass the champion/challenger publish gate: candidate "
+        "quality stats are still computed and recorded (decision "
+        "'bypassed'), but a regression beyond the champion's bootstrap "
+        "CI no longer quarantines the version",
+    )
+    parser.add_argument(
+        "--bootstrap-samples", type=int, default=32,
+        help="bootstrap resamples behind the published error bars "
+        "(AUC CI + masked-lane coefficient CIs); default 32, 0 disables",
+    )
+    parser.add_argument(
         "--no-serve", action="store_true",
         help="publish without hot-swapping a live ModelRegistry (staleness "
         "then measures event->published)",
@@ -135,6 +147,8 @@ def main(argv=None) -> int:
         serve=not args.no_serve,
         status_file=args.status_file,
         status_port=args.status_port,
+        quality_gate=not args.no_quality_gate,
+        bootstrap_samples=args.bootstrap_samples,
     ))
 
     def _on_signal(signum, frame):

@@ -65,6 +65,20 @@ def main(argv=None) -> int:
         "an unchanged delta is a typed refusal — re-running a stuck "
         "cron must not publish no-op versions)",
     )
+    parser.add_argument(
+        "--no-quality-gate",
+        action="store_true",
+        help="bypass the champion/challenger publish gate: the "
+        "candidate's quality stats are still computed and recorded "
+        "(decision 'bypassed'), but a regression beyond the champion's "
+        "bootstrap CI no longer quarantines the version",
+    )
+    parser.add_argument(
+        "--bootstrap-samples",
+        type=int,
+        help="bootstrap resamples behind the published error bars "
+        "(AUC CI + masked-lane coefficient CIs); default 32, 0 disables",
+    )
     args = parser.parse_args(argv)
 
     setup_logging()
@@ -83,6 +97,10 @@ def main(argv=None) -> int:
         ws["lambda_points"] = args.lambda_points
     if args.force:
         ws["force"] = True
+    if args.no_quality_gate:
+        ws["quality_gate"] = False
+    if args.bootstrap_samples is not None:
+        ws["bootstrap_samples"] = args.bootstrap_samples
     if "dir" not in ws:
         parser.error("refresh needs --warm-start (or config warm_start.dir)")
     config["warm_start"] = ws

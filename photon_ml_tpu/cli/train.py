@@ -274,6 +274,7 @@ def _parse_checkpoint_spec(config: Mapping) -> Optional[CheckpointSpec]:
 _WARM_START_KEYS = {
     "dir", "delta_paths", "registry_dir", "base_version", "force",
     "lambda_factors", "lambda_points", "lambda_span", "metric", "policy",
+    "quality_gate", "bootstrap_samples",
 }
 
 
@@ -383,6 +384,9 @@ def _run_incremental(
             points=int(warm["lambda_points"]),
             span=float(warm.get("lambda_span", 4.0)),
         )
+    gate_enabled = bool(warm.get("quality_gate", True))
+    bootstrap_samples = int(warm.get("bootstrap_samples", 32))
+    publishing = bool(warm.get("registry_dir"))
     with timed("incremental fit"):
         result = estimator.fit_incremental(
             train_data,
@@ -397,23 +401,55 @@ def _run_incremental(
             guard=guard,
             checkpoint_spec=checkpoint_spec,
             should_stop=stop if checkpoint_spec is not None else None,
+            bootstrap_samples=bootstrap_samples if publishing else 0,
         )
-    if warm.get("registry_dir"):
+    gate_refusal = None
+    quality = None
+    if publishing:
         if not index_maps:
             raise ValueError(
                 "publishing an incremental model needs index maps (avro "
                 "input builds them; libsvm input cannot publish)"
             )
-        with timed("registry publish"):
-            result.published_version = publish_incremental(
-                warm["registry_dir"],
-                result.model,
-                index_maps,
-                result.lineage,
-                delta=result.delta,
-                base_version=warm.get("base_version"),
-                selection=result.selection,
+        from photon_ml_tpu.quality import (
+            QualityGateRefused,
+            game_quality_stats,
+        )
+
+        with timed("quality stats"):
+            # candidate error bars on the strongest available eval set;
+            # the champion comparison happens inside publish_version
+            eval_data = (
+                validation_data
+                if validation_data is not None
+                else train_data
             )
+            quality = game_quality_stats(
+                result.model, eval_data, num_samples=bootstrap_samples
+            ).to_json()
+            if result.bootstrap is not None:
+                quality["bootstrap"] = result.bootstrap
+        with timed("registry publish"):
+            try:
+                result.published_version = publish_incremental(
+                    warm["registry_dir"],
+                    result.model,
+                    index_maps,
+                    result.lineage,
+                    delta=result.delta,
+                    base_version=warm.get("base_version"),
+                    selection=result.selection,
+                    quality=quality,
+                    gate_override=not gate_enabled,
+                )
+            except QualityGateRefused as exc:
+                # a quarantined candidate is a RESULT, not a crash: the
+                # refresh reports the decision and exits cleanly with
+                # the champion still serving
+                gate_refusal = {
+                    **exc.decision.to_json(),
+                    "quarantine_path": exc.quarantine_path,
+                }
     freshness = {
         "base": result.lineage.to_json(),
         "lanes_solved": result.lanes_solved,
@@ -430,6 +466,10 @@ def _run_incremental(
         freshness["selection"] = result.selection.to_json()
     if result.published_version:
         freshness["published_version"] = result.published_version
+    if quality is not None:
+        freshness["quality"] = quality
+    if gate_refusal is not None:
+        freshness["quality_gate"] = gate_refusal
     return freshness
 
 

@@ -519,6 +519,8 @@ class GameEstimator:
         guard: Optional["GuardSpec"] = None,
         checkpoint_spec: Optional["CheckpointSpec"] = None,
         should_stop=None,
+        bootstrap_samples: int = 0,
+        bootstrap_seed: int = 0,
     ):
         """Delta-aware warm-start refresh over the COMBINED data.
 
@@ -557,6 +559,8 @@ class GameEstimator:
             guard=guard,
             checkpoint_spec=checkpoint_spec,
             should_stop=should_stop,
+            bootstrap_samples=bootstrap_samples,
+            bootstrap_seed=bootstrap_seed,
         )
         if output_dir is not None:
             from photon_ml_tpu.data.model_store import save_game_model

@@ -131,6 +131,8 @@ def publish_incremental(
     extra_metadata: Optional[dict] = None,
     selection=None,
     reconciliation: Optional[dict] = None,
+    quality: Optional[dict] = None,
+    gate_override: bool = False,
 ) -> str:
     """Atomically publish an incremental retrain's model as the next
     registry version, lineage in metadata. Returns the version path.
@@ -141,6 +143,11 @@ def publish_incremental(
     :class:`~photon_ml_tpu.sweep.select.SweepSelection`, recorded like
     the sweep exporter records it. ``reconciliation``: the conductor's
     nearline-vs-delta decision record, embedded in the lineage block.
+    ``quality``/``gate_override`` arm the champion/challenger gate (see
+    ``serving.registry.publish_version``): a candidate that regresses
+    beyond the champion's bootstrap CI raises
+    :class:`photon_ml_tpu.quality.gate.QualityGateRefused` and lands in
+    quarantine instead of the registry proper.
     """
     from photon_ml_tpu.serving.registry import publish_version
 
@@ -157,6 +164,8 @@ def publish_incremental(
             lineage, delta=delta, base_version=base_version,
             reconciliation=reconciliation,
         ),
+        quality=quality,
+        gate_override=gate_override,
     )
     telemetry.counter("incremental.published_versions").inc()
     return path

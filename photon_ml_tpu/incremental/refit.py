@@ -254,6 +254,10 @@ class MaskedRandomEffectCoordinate:
         self.lanes_skipped = 0
         self.bucket_solves = 0
         self.buckets_skipped = 0
+        # per-bucket solve inputs from the LAST update_model pass, kept
+        # so bootstrap_touched() can re-solve the exact same gathered
+        # problems under resampled weights (references, not copies)
+        self._last_inputs: list[dict] = []
 
     def initialize_model(self):
         return self.inner.initialize_model()
@@ -280,6 +284,7 @@ class MaskedRandomEffectCoordinate:
         new_buckets = []
         tracker_its, tracker_reasons, tracker_vals = [], [], []
         healths = []
+        self._last_inputs = []
         for i, (b, bm) in enumerate(zip(inner._buckets, model.buckets)):
             ti = self._positions[i]
             n_real = int(bm.coefficients.shape[0])
@@ -363,6 +368,20 @@ class MaskedRandomEffectCoordinate:
             telemetry.counter("incremental.bucket_solves").inc()
             telemetry.counter("incremental.lanes_solved").inc(T)
             telemetry.counter("incremental.lanes_skipped").inc(n_real - T)
+            # bootstrap_touched re-solves these gathered problems later;
+            # dense-path buckets carry stripped (1, 1) COO stubs, so it
+            # rebuilds the COO view from _dense_x. Only sharded solves
+            # (mesh) are out of scope
+            if inner.mesh is None:
+                self._last_inputs.append(
+                    {
+                        "bucket": i,
+                        "bucket_obj": bucket,
+                        "idx": idx,
+                        "ti": ti,
+                        "w0": res.w,
+                    }
+                )
             new_buckets.append(
                 dataclasses.replace(
                     bm, coefficients=coeffs, variances=variances
@@ -381,6 +400,83 @@ class MaskedRandomEffectCoordinate:
             else None
         )
         return dataclasses.replace(model, buckets=tuple(new_buckets))
+
+    def bootstrap_touched(self, num_samples: int = 32, seed: int = 0):
+        """Masked-lane bootstrap: CI exactly the RE rows the last
+        ``update_model`` pass touched, reusing its gather machinery —
+        B x touched lanes solve in ONE executable per bucket.
+
+        The [B, E, R] resample weights are drawn for the FULL bucket
+        from the shared seed and then gathered down to the touched
+        lanes, so each touched lane sees byte-identical draws to a
+        full-lane ``bootstrap_random_effect`` run over the same bucket
+        — which is why masked and full CIs agree exactly on touched
+        rows. Returns ``{bucket_index: {"report": ReBootstrapReport,
+        "touched": positions}}``."""
+        from photon_ml_tpu.diagnostics.bootstrap import (
+            bootstrap_random_effect,
+            bootstrap_re_weights,
+        )
+
+        inner = self.inner
+        out: dict[int, dict] = {}
+        for stash in self._last_inputs:
+            bucket = stash["bucket_obj"]
+            idx = stash["idx"]
+            full_w = np.asarray(
+                telemetry.sync_fetch(
+                    bucket.weights, label="bootstrap_touched_weights"
+                )
+            )
+            counts = bootstrap_re_weights(num_samples, full_w, seed)
+            idx_dev = jnp.asarray(idx, jnp.int32)
+
+            def take(x):
+                return jnp.take(x, idx_dev, axis=0)
+
+            dense_x = inner._dense_x[stash["bucket"]]
+            if dense_x is not None:
+                # the bucket solved on its packed dense design and its COO
+                # arrays may be stripped (1, 1) stubs — rebuild an explicit
+                # dense-as-COO view [P, R*K] from the design instead
+                from photon_ml_tpu.ops.sparse import SparseBatch
+
+                R = bucket.labels.shape[1]
+                K = int(bucket.num_local_features)
+                x = take(dense_x)
+                rows = jnp.broadcast_to(
+                    jnp.repeat(jnp.arange(R, dtype=jnp.int32), K),
+                    x.shape,
+                )
+                cols = jnp.broadcast_to(
+                    jnp.tile(jnp.arange(K, dtype=jnp.int32), R),
+                    x.shape,
+                )
+                eb = SparseBatch(
+                    values=x,
+                    rows=rows,
+                    cols=cols,
+                    labels=take(bucket.labels),
+                    offsets=take(bucket.offsets),
+                    weights=take(bucket.weights),
+                    num_features=K,
+                )
+            else:
+                eb = jax.tree.map(take, bucket.entity_batch())
+            report = bootstrap_random_effect(
+                eb,
+                inner.loss_name,
+                inner.config,
+                stash["w0"],
+                num_samples=num_samples,
+                seed=seed,
+                lane_weights=counts[:, idx, :],
+            )
+            out[stash["bucket"]] = {
+                "report": report,
+                "touched": stash["ti"],
+            }
+        return out
 
 
 class MaskedFactoredRandomEffectCoordinate:
@@ -586,6 +682,10 @@ class IncrementalFitResult:
     seconds: float
     selection: Optional[object] = None  # SweepSelection when λ-swept
     published_version: Optional[str] = None
+    # JSON-safe masked-lane bootstrap summaries per coordinate (only when
+    # run with bootstrap_samples > 0) — the error bars the publish gate
+    # attaches to the version's quality block
+    bootstrap: Optional[dict] = None
 
 
 def local_lambda_factors(points: int = 3, span: float = 4.0) -> list[float]:
@@ -758,6 +858,8 @@ def run_incremental_fit(
     guard=None,
     checkpoint_spec=None,
     should_stop=None,
+    bootstrap_samples: int = 0,
+    bootstrap_seed: int = 0,
 ) -> IncrementalFitResult:
     """Delta-aware warm-start refresh of ``estimator``'s model over the
     COMBINED data (base ∪ delta). See ``GameEstimator.fit_incremental``
@@ -887,6 +989,34 @@ def run_incremental_fit(
                 float(values[pick])
             )
         result = lane_results[pick]
+        bootstrap = None
+        if bootstrap_samples > 0:
+            # masked-lane bootstrap on the SELECTED lane: CI exactly the
+            # touched rows, B resamples per bucket in one executable
+            with telemetry.span(
+                "incremental_bootstrap", samples=bootstrap_samples
+            ):
+                per_coord = {}
+                for name, coord in lane_wrapped[pick].items():
+                    if not hasattr(coord, "bootstrap_touched"):
+                        continue
+                    buckets = coord.bootstrap_touched(
+                        num_samples=bootstrap_samples, seed=bootstrap_seed
+                    )
+                    if not buckets:
+                        continue
+                    agg = {}
+                    for bi, entry in buckets.items():
+                        summ = entry["report"].summary()
+                        summ["touched_lanes"] = int(len(entry["touched"]))
+                        agg[str(bi)] = summ
+                    per_coord[name] = agg
+                if per_coord:
+                    bootstrap = {
+                        "num_samples": int(bootstrap_samples),
+                        "coordinates": per_coord,
+                    }
+                    telemetry.counter("quality.bootstrap_fits").inc()
         lanes_solved = sum(
             getattr(c, "lanes_solved", 0)
             for w in lane_wrapped for c in w.values()
@@ -920,4 +1050,5 @@ def run_incremental_fit(
         new_entities=new_entities,
         seconds=seconds,
         selection=selection,
+        bootstrap=bootstrap,
     )

@@ -68,6 +68,7 @@ from ..incremental import (
     publish_incremental,
     scan_delta,
 )
+from ..quality import QualityGateRefused
 from .reconcile import newest_version_metadata, reconcile_nearline
 
 logger = logging.getLogger(__name__)
@@ -128,6 +129,12 @@ class PipelineSpec:
     status_file: Optional[str] = None
     status_port: Optional[int] = None
     heartbeat_deadline_s: float = 30.0
+    # champion/challenger publish gate (serving.registry): every cycle's
+    # candidate carries bootstrap error bars; a candidate regressing
+    # beyond the champion's CI is quarantined, not swapped in. False
+    # still computes and records stats but bypasses the refusal.
+    quality_gate: bool = True
+    bootstrap_samples: int = 32
 
 
 class FreshnessPipeline:
@@ -154,6 +161,7 @@ class FreshnessPipeline:
         self.cycle = 0
         self._cycles_since_full = 0
         self._published: List[str] = []
+        self._quarantined: List[str] = []
         self._escalations = 0
         self._idle_cycles = 0
         self._reconciliations = 0
@@ -361,26 +369,62 @@ class FreshnessPipeline:
             self._cycles_since_full = 0
         else:
             result = self._estimator.fit_incremental(
-                train_data, ws, delta=scan
+                train_data, ws, delta=scan,
+                bootstrap_samples=self.spec.bootstrap_samples,
             )
             model, lineage = result.model, result.lineage
 
-        published = publish_incremental(
-            self.spec.registry_dir,
-            model,
-            self._index_maps,
-            lineage,
-            delta=scan,
-            base_version=base_version_name,
-            extra_metadata={
-                "pipeline": {
-                    "cycle": self.cycle,
-                    "escalated": bool(escalated),
-                    "cycles_since_full": self._cycles_since_full,
-                }
-            },
-            reconciliation=decision,
-        )
+        quality = None
+        if self.spec.quality_gate or self.spec.bootstrap_samples > 0:
+            from ..quality import game_quality_stats
+
+            # candidate error bars on the cycle's resident combined
+            # data — the same rows the fit just saw, zero extra IO
+            quality = game_quality_stats(
+                model, train_data,
+                num_samples=self.spec.bootstrap_samples,
+            ).to_json()
+            if not escalated and result.bootstrap is not None:
+                quality["bootstrap"] = result.bootstrap
+
+        try:
+            published = publish_incremental(
+                self.spec.registry_dir,
+                model,
+                self._index_maps,
+                lineage,
+                delta=scan,
+                base_version=base_version_name,
+                extra_metadata={
+                    "pipeline": {
+                        "cycle": self.cycle,
+                        "escalated": bool(escalated),
+                        "cycles_since_full": self._cycles_since_full,
+                    }
+                },
+                reconciliation=decision,
+                quality=quality,
+                gate_override=not self.spec.quality_gate,
+            )
+        except QualityGateRefused as exc:
+            # a quarantined cycle is a completed cycle: the champion
+            # keeps serving, the digest cursor advances (run_cycle), so
+            # the conductor does NOT retry the refused delta forever
+            telemetry.counter("pipeline.quarantines").inc()
+            qname = os.path.basename(exc.quarantine_path or "")
+            self._quarantined.append(qname)
+            logger.warning(
+                "pipeline cycle %d quarantined its candidate (%s): %s",
+                self.cycle, qname, exc.decision.reason,
+            )
+            return {
+                "published_version": None,
+                "quarantined_version": qname,
+                "quality_gate": exc.decision.to_json(),
+                "escalated": bool(escalated),
+                "touched_fraction": round(float(touched), 6),
+                "reconciliation": decision,
+            }
         telemetry.counter("pipeline.publishes").inc()
         version_name = os.path.basename(published)
         logger.info(
@@ -466,6 +510,7 @@ class FreshnessPipeline:
             "cycles": self.cycle,
             "idle_cycles": self._idle_cycles,
             "published_versions": list(self._published),
+            "quarantined_versions": list(self._quarantined),
             "escalations": self._escalations,
             "reconciliations": self._reconciliations,
             "event_to_served_staleness_p99_s": (

@@ -1,0 +1,59 @@
+"""Quality observability (ISSUE 20): the fourth observability layer —
+model quality over time.
+
+Three legs, one package:
+
+- :mod:`photon_ml_tpu.quality.gate` — champion/challenger publish gate:
+  candidate AUC/H-L stats with bootstrap error bars
+  (:func:`game_quality_stats`) and the no-regression decision
+  (:func:`decide_gate`), enforced inside ``serving.registry
+  .publish_version`` and recorded in version metadata + lineage.
+- :mod:`photon_ml_tpu.quality.drift` — online score-distribution and
+  calibration-bin sketches fed by ``ScoringEngine.score_rows`` and the
+  nearline updater, published as the ``"quality"`` section of every
+  ``telemetry.snapshot()`` (``/metricsz``, JSONL flush, RunReport).
+- The GLMix bootstrap itself (B resamples as vmapped lanes riding the
+  sweep machinery) lives in :mod:`photon_ml_tpu.diagnostics.bootstrap`
+  with the solver factory in :mod:`photon_ml_tpu.sweep.runner` — this
+  package consumes its summaries in the published quality block.
+
+Importing the package registers both fault seams (``quality
+.publish_gate``, ``quality.drift_flush``) and the drift snapshot
+provider.
+"""
+
+from __future__ import annotations
+
+from photon_ml_tpu.quality import drift  # noqa: F401
+from photon_ml_tpu.quality.drift import (  # noqa: F401
+    FP_DRIFT_FLUSH,
+    DriftMonitor,
+    observe_labeled,
+    observe_scores,
+    population_stability_index,
+)
+from photon_ml_tpu.quality.gate import (  # noqa: F401
+    FP_PUBLISH_GATE,
+    GateDecision,
+    QualityGateRefused,
+    QualityStats,
+    decide_gate,
+    game_quality_stats,
+    weighted_auc,
+)
+
+__all__ = [
+    "drift",
+    "FP_DRIFT_FLUSH",
+    "DriftMonitor",
+    "observe_labeled",
+    "observe_scores",
+    "population_stability_index",
+    "FP_PUBLISH_GATE",
+    "GateDecision",
+    "QualityGateRefused",
+    "QualityStats",
+    "decide_gate",
+    "game_quality_stats",
+    "weighted_auc",
+]

@@ -59,6 +59,7 @@ from photon_ml_tpu.game.models import (
 )
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.parallel import sharding as psharding
+from photon_ml_tpu.quality import drift as quality_drift
 
 
 class BadRequest(ValueError):
@@ -765,6 +766,9 @@ class ScoringEngine:
             dt_ms = (time.monotonic() - t0) * 1000.0
             telemetry.histogram("serving.device_ms").observe(dt_ms)
             telemetry.counter("serving.scored_rows").inc(len(chunk))
+            # feed the per-version score-distribution sketch (bounded,
+            # host-side numpy only — no extra device crossing)
+            quality_drift.observe_scores(self.version, host[: len(chunk)])
             parts.append(host[: len(chunk)])
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
 

@@ -79,6 +79,7 @@ from photon_ml_tpu.game.models import FixedEffectModel, RandomEffectModel
 from photon_ml_tpu.ops.dense import DenseBatch
 from photon_ml_tpu.ops.losses import get_loss
 from photon_ml_tpu.optim.factory import OptimizerConfig, build_objective
+from photon_ml_tpu.quality import drift as quality_drift
 from photon_ml_tpu.serving.batcher import Overloaded
 from photon_ml_tpu.serving.engine import BadRequest
 
@@ -551,6 +552,24 @@ class NearlineUpdater:
             applies += 1
             entities_total += n
             rows_total += sum(len(rows) for _pos, rows in lanes)
+            # labeled events feed the per-version calibration sketch:
+            # predicted probability (from the rows just applied) against
+            # the observed label. Flush thread, never the request path —
+            # one extra fetch per bucket apply. Logistic only: the
+            # calibration bins assume probabilities.
+            if loss_name == "logistic":
+                w_host = telemetry.sync_fetch(
+                    res.w, label="nearline.calibration_rows"
+                )
+                margins = offsets[:n] + np.einsum(
+                    "jrk,jk->jr", x[:n], w_host[:n]
+                )
+                live = weights[:n] > 0
+                if live.any():
+                    probs = 1.0 / (1.0 + np.exp(-margins[live]))
+                    quality_drift.observe_labeled(
+                        engine.version, probs, labels[:n][live]
+                    )
             now = time.monotonic()
             lag_ms = telemetry.histogram("serving.nearline.update_lag_ms")
             for t in lags:

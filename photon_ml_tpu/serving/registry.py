@@ -83,6 +83,50 @@ def scan_versions(directory: str) -> list[tuple[int, str]]:
     return sorted(out)
 
 
+def champion_quality(directory: str) -> tuple[Optional[str], Optional[dict]]:
+    """(version dirname, quality block) of the newest published version
+    carrying recorded quality stats — the gate's champion. Versions
+    without a quality block (pre-gate publishes, ungated nearline
+    snapshots) are skipped, not treated as champions: a gate can only
+    compare against error bars that were actually recorded."""
+    from photon_ml_tpu.data.model_store import load_game_model_metadata
+
+    for v, path in reversed(scan_versions(directory)):
+        try:
+            meta = load_game_model_metadata(path) or {}
+        except (OSError, ValueError):
+            continue
+        quality = (meta.get("extra") or {}).get("quality")
+        if quality:
+            return version_dirname(v), quality
+    return None, None
+
+
+def _assemble_version(
+    directory: str, name: str, model, index_maps: Mapping, extra_metadata
+) -> str:
+    """Assemble a complete version directory under a ``.tmp-`` sibling
+    and rename it to ``name`` — the atomic-publish protocol shared by
+    accepted and quarantined versions."""
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.data.model_store import save_game_model
+
+    final = os.path.join(directory, name)
+    tmp = os.path.join(directory, ".tmp-" + name)
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    for shard, imap in index_maps.items():
+        if not isinstance(imap, IndexMap):
+            imap = IndexMap(list(imap))
+        imap.save(os.path.join(tmp, "feature-indexes", shard))
+    # model-metadata.json lands last inside tmp (save_game_model order)
+    save_game_model(model, tmp, extra_metadata=extra_metadata)
+    os.rename(tmp, final)
+    fsync_dir(directory)
+    return final
+
+
 def publish_version(
     directory: str,
     model,
@@ -90,6 +134,8 @@ def publish_version(
     version: Optional[int] = None,
     extra_metadata: Optional[dict] = None,
     lineage: Optional[dict] = None,
+    quality: Optional[dict] = None,
+    gate_override: bool = False,
 ) -> str:
     """Atomically publish ``model`` as the next registry version.
 
@@ -104,18 +150,55 @@ def publish_version(
     ``"lineage"`` key; the loaded engine carries it and ``/healthz``
     serves it, so a running version is traceable to the checkpoint and
     delta that produced it.
-    """
-    from photon_ml_tpu.data.index_map import IndexMap
-    from photon_ml_tpu.data.model_store import save_game_model
 
-    if lineage is not None:
-        extra_metadata = dict(extra_metadata or {})
-        extra_metadata["lineage"] = dict(lineage)
+    ``quality`` (optional) arms the champion/challenger gate: a JSON
+    block with the candidate's :class:`photon_ml_tpu.quality.gate
+    .QualityStats` fields (plus any bootstrap summaries). The candidate
+    is compared against the newest published version with recorded
+    stats; a candidate that regresses beyond the champion's bootstrap
+    CI is assembled under ``quarantined-v-*`` (invisible to version
+    scans, evidence preserved) and :class:`QualityGateRefused` is
+    raised. The decision — publish, quarantine, or ``gate_override``
+    bypass — is recorded in the metadata quality block AND in lineage
+    (``quality_gate``), so ``/healthz`` serves it. ``quality=None``
+    publishes ungated (back-compat; the nearline snapshot path).
+    """
     if not index_maps:
         raise ValueError(
             "index_maps is required: a served version must pin the training "
             "feature space next to its coefficients"
         )
+    decision = None
+    if quality is not None:
+        from photon_ml_tpu.quality.gate import (
+            FP_PUBLISH_GATE,
+            QualityGateRefused,
+            QualityStats,
+            decide_gate,
+        )
+
+        champ_version, champ_quality = champion_quality(directory)
+        # the seam sits AFTER candidate stats and champion lookup but
+        # BEFORE any write: a hard kill here must leave the registry
+        # exactly as it was (tools/chaos.py --quality)
+        faults.fault_point(FP_PUBLISH_GATE)
+        decision = decide_gate(
+            QualityStats.from_json(quality),
+            champ_quality,
+            champ_version,
+            override=gate_override,
+        )
+        telemetry.counter(f"quality.gate_{decision.decision}").inc()
+        extra_metadata = dict(extra_metadata or {})
+        extra_metadata["quality"] = {
+            **dict(quality), "gate": decision.to_json(),
+        }
+        if lineage is not None:
+            lineage = dict(lineage)
+            lineage["quality_gate"] = decision.to_json()
+    if lineage is not None:
+        extra_metadata = dict(extra_metadata or {})
+        extra_metadata["lineage"] = dict(lineage)
     os.makedirs(directory, exist_ok=True)
     if version is None:
         existing = scan_versions(directory)
@@ -123,19 +206,33 @@ def publish_version(
     final = os.path.join(directory, version_dirname(version))
     if os.path.exists(final):
         raise FileExistsError(f"version already published: {final}")
-    tmp = os.path.join(directory, ".tmp-" + version_dirname(version))
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
-    for shard, imap in index_maps.items():
-        if not isinstance(imap, IndexMap):
-            imap = IndexMap(list(imap))
-        imap.save(os.path.join(tmp, "feature-indexes", shard))
-    # model-metadata.json lands last inside tmp (save_game_model order)
-    save_game_model(model, tmp, extra_metadata=extra_metadata)
-    os.rename(tmp, final)
-    fsync_dir(directory)
-    return final
+    if decision is not None and decision.decision == "quarantined":
+        # park the refused candidate under a name version scans ignore:
+        # the evidence (model + stats + decision) survives for offline
+        # diagnosis, but no server will ever load it; repeated refusals
+        # of the same slot keep the latest evidence
+        stale = os.path.join(
+            directory, "quarantined-" + version_dirname(version)
+        )
+        if os.path.exists(stale):
+            shutil.rmtree(stale)
+        qpath = _assemble_version(
+            directory,
+            "quarantined-" + version_dirname(version),
+            model,
+            index_maps,
+            extra_metadata,
+        )
+        logger.warning(
+            "quality gate quarantined candidate version %d: %s",
+            version,
+            decision.reason,
+        )
+        raise QualityGateRefused(decision, quarantine_path=qpath)
+    return _assemble_version(
+        directory, version_dirname(version), model, index_maps,
+        extra_metadata,
+    )
 
 
 class ModelRegistry:

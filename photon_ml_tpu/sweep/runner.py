@@ -71,6 +71,7 @@ __all__ = [
     "GameSweepResult",
     "SweepUnsupportedError",
     "path_warm_start",
+    "re_bootstrap_solver",
     "sweep_glm",
     "sweep_game",
 ]
@@ -138,6 +139,37 @@ def _re_sweep_solver(config: OptimizerConfig):
         return jax.vmap(one_cfg)(extra_off, w0, l2s, l1s)
 
     return instrumented_jit(run, name="sweep_re_solve", multi_shape=True)
+
+
+@lru_cache(maxsize=32)
+def re_bootstrap_solver(config: OptimizerConfig):
+    """B-resample x E-entity bucket solve for the GLMix bootstrap
+    (diagnostics.bootstrap): identical lane composition to
+    :func:`_re_sweep_solver`, but the outer vmap axis carries B
+    multinomial weight resamples instead of G regularization configs —
+    ``lane_weights`` [B, E, R] scales the bucket's base row weights per
+    lane, ``w0`` [E, K] (the point estimate) broadcasts across B so
+    every lane warm-starts from the fitted coefficients. One executable
+    solves B*E independent small problems with the bucket design
+    broadcast across resamples, which is why B=64 costs well under 2x a
+    single fit (bench_diagnostics)."""
+
+    def run(obj, ebatch, lane_weights, w0, l1):
+        def one_sample(wts_b):
+            eb = dataclasses.replace(
+                ebatch, weights=ebatch.weights * wts_b
+            )
+
+            def one_entity(eb_e, w0_e):
+                return dispatch_solve(
+                    glm_adapter(obj, eb_e), w0_e, config, l1
+                )
+
+            return jax.vmap(one_entity)(eb, w0)
+
+        return jax.vmap(one_sample)(lane_weights)
+
+    return instrumented_jit(run, name="bootstrap_re_solve", multi_shape=True)
 
 
 @lru_cache(maxsize=8)

@@ -84,6 +84,7 @@ from photon_ml_tpu.telemetry.metrics import (  # noqa: F401
     counter,
     gauge,
     histogram,
+    register_snapshot_provider,
     snapshot,
 )
 from photon_ml_tpu.telemetry.progress import Heartbeat  # noqa: F401
@@ -108,6 +109,7 @@ __all__ = [
     "gauge",
     "histogram",
     "snapshot",
+    "register_snapshot_provider",
     "flush_metrics",
     "sync_fetch",
     "install_compile_hooks",

@@ -903,6 +903,45 @@ class FleetReport:
         atomic_write_json(path, doc, indent=2, sort_keys=True, default=str)
         return doc
 
+    def _quality_markdown(self) -> list[str]:
+        """Fleet quality rollup: gate decisions summed across members,
+        drift-sketch coverage per member. Empty when no member touched
+        the quality layer."""
+        totals: dict[str, int] = {}
+        drift_rows: list[str] = []
+        for m in self.members:
+            q = m.report.quality_summary()
+            if not q:
+                continue
+            for key in (
+                "stats_computed", "bootstrap_fits", "gate_published",
+                "gate_quarantined", "gate_bypassed", "gate_no_champion",
+                "pipeline_quarantines",
+            ):
+                if q.get(key):
+                    totals[key] = totals.get(key, 0) + int(q[key])
+            versions = (q.get("drift") or {}).get("versions") or {}
+            if versions:
+                scored = sum(
+                    (row.get("scores") or {}).get("count", 0)
+                    for row in versions.values()
+                )
+                drift_rows.append(
+                    f"- member {m.process_index}: drift sketches for "
+                    f"{len(versions)} version(s), {scored} score(s) "
+                    "observed"
+                )
+        if not totals and not drift_rows:
+            return []
+        out = ["## Quality", ""]
+        if totals:
+            bits = [f"{v} {k.replace('_', ' ')}" for k, v in
+                    sorted(totals.items())]
+            out.append("- fleet totals: " + ", ".join(bits))
+        out += drift_rows
+        out.append("")
+        return out
+
     def to_markdown(
         self, deltas: Optional[Sequence[MetricDelta]] = None
     ) -> str:
@@ -966,6 +1005,7 @@ class FleetReport:
         lines += self._last_words_markdown()
         lines += self._requests_markdown()
         lines += self._hot_executables_markdown()
+        lines += self._quality_markdown()
 
         straggler = self.straggler()
         if straggler is not None:

@@ -33,6 +33,7 @@ __all__ = [
     "gauge",
     "histogram",
     "snapshot",
+    "register_snapshot_provider",
     "flush_jsonl",
     "reset",
 ]
@@ -182,6 +183,23 @@ class MetricsRegistry:
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
+        self._providers: dict[str, Any] = {}
+
+    def register_snapshot_provider(self, name: str, fn) -> None:
+        """Attach a named section to every :meth:`snapshot`: ``fn()``
+        must return a JSON-safe value, published under ``name`` beside
+        ``counters``/``gauges``/``histograms``. Layers with structured
+        state the scalar registries cannot carry (the quality layer's
+        per-version drift sketches) ride the same snapshot/flush/report
+        surface this way instead of growing unbounded per-version gauge
+        names. Providers survive :meth:`reset` (they are wiring, not
+        run state) and a provider that raises is skipped — a broken
+        section must never take ``/metricsz`` down."""
+        reserved = ("counters", "gauges", "histograms")
+        if name in reserved:
+            raise ValueError(f"snapshot section name {name!r} is reserved")
+        with self._lock:
+            self._providers[name] = fn
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -226,13 +244,22 @@ class MetricsRegistry:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
-        return {
+            providers = dict(self._providers)
+        out: dict[str, Any] = {
             "counters": {n: c.value for n, c in sorted(counters.items())},
             "gauges": {n: g.value for n, g in sorted(gauges.items())},
             "histograms": {
                 n: h.summary() for n, h in sorted(histograms.items())
             },
         }
+        for name, fn in sorted(providers.items()):
+            try:
+                section = fn()
+            except Exception:  # noqa: BLE001 — observability, never control
+                continue
+            if section is not None:
+                out[name] = section
+        return out
 
     def flush_jsonl(self, path: str) -> dict[str, Any]:
         """Append one ``{"type": "metrics", ...}`` line to ``path`` and
@@ -274,5 +301,6 @@ gauge = REGISTRY.gauge
 peek_gauge = REGISTRY.peek_gauge
 histogram = REGISTRY.histogram
 snapshot = REGISTRY.snapshot
+register_snapshot_provider = REGISTRY.register_snapshot_provider
 flush_jsonl = REGISTRY.flush_jsonl
 reset = REGISTRY.reset

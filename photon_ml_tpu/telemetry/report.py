@@ -826,6 +826,7 @@ class RunReport:
             "recovery": self.recovery_summary(),
             "freshness": self.freshness_summary(),
             "pipeline": self.pipeline_summary(),
+            "quality": self.quality_summary(),
             "counters": counters,
             "gauges": self.snapshot.get("gauges", {}),
             "histograms": self.snapshot.get("histograms", {}),
@@ -896,6 +897,7 @@ class RunReport:
         lines += self._recovery_markdown()
         lines += self._freshness_markdown()
         lines += self._pipeline_markdown()
+        lines += self._quality_markdown()
         lines += self._memory_markdown()
         lines += self._coordinates_markdown()
         lines += self._sweep_markdown()
@@ -1561,6 +1563,110 @@ class RunReport:
                 f"- non-idle cycle time: {ct['total']:.3f} s total over "
                 f"{ct['count']} cycle(s), max {ct['max']:.3f} s"
             )
+        out.append("")
+        return out
+
+    def quality_summary(self) -> Optional[dict[str, Any]]:
+        """Quality-observability accounting, or None when the run never
+        touched the quality layer (no gated publish, no bootstrap, no
+        drift sketches).
+
+        Answers the ISSUE-20 questions in one place: how many candidate
+        versions had quality stats computed (weighted AUC + bootstrap
+        CI), what the champion/challenger gate decided (published /
+        quarantined / bypassed / no-champion), how many masked-lane
+        bootstrap fits attached coefficient CIs, and the online drift
+        rows (per-version score sketches + calibration bins + PSI) the
+        serving fleet accumulated — lifted verbatim from the ``quality``
+        snapshot section the drift monitor publishes.
+        """
+        c = self.snapshot.get("counters", {})
+        drift = self.snapshot.get("quality") or {}
+        keys = (
+            "quality.stats_computed", "quality.bootstrap_fits",
+            "quality.gate_published", "quality.gate_quarantined",
+            "quality.gate_bypassed", "quality.gate_no_champion",
+            "quality.scores_observed", "quality.labeled_observed",
+            "quality.versions_evicted", "pipeline.quarantines",
+        )
+        if not drift.get("versions") and not any(c.get(k) for k in keys):
+            return None
+        out: dict[str, Any] = {
+            k.replace("quality.", "").replace(".", "_"): int(c.get(k, 0))
+            for k in keys
+            if k in c
+        }
+        if drift.get("versions"):
+            out["drift"] = drift
+        return out
+
+    def _quality_markdown(self) -> list[str]:
+        q = self.quality_summary()
+        if q is None:
+            return []
+        out = ["## Quality", ""]
+        stats = q.get("stats_computed", 0)
+        if stats:
+            out.append(
+                f"- candidate quality stats computed: {stats} "
+                "(weighted validation AUC + bootstrap CI"
+                " + Hosmer–Lemeshow where logistic)"
+            )
+        fits = q.get("bootstrap_fits", 0)
+        if fits:
+            out.append(
+                f"- {fits} masked-lane bootstrap fit(s) attached "
+                "per-entity coefficient CIs to published metadata"
+            )
+        gate_bits = []
+        for key, label in (
+            ("gate_published", "published"),
+            ("gate_quarantined", "**quarantined**"),
+            ("gate_bypassed", "gate-bypassed"),
+            ("gate_no_champion", "published without a champion"),
+        ):
+            v = q.get(key, 0)
+            if v:
+                gate_bits.append(f"{v} {label}")
+        if gate_bits:
+            out.append(
+                "- champion/challenger gate decisions: "
+                + ", ".join(gate_bits)
+            )
+        quarantines = q.get("pipeline_quarantines", 0)
+        if quarantines:
+            out.append(
+                f"- **{quarantines} regressed challenger(s) quarantined "
+                "by the conductor** (digest advanced; no retry loop)"
+            )
+        drift = q.get("drift") or {}
+        versions = drift.get("versions") or {}
+        if versions:
+            base = drift.get("baseline_version")
+            line = f"- online drift sketches for {len(versions)} version(s)"
+            if base:
+                line += f" (PSI baseline `{base}`)"
+            out.append(line)
+            out.append("")
+            out.append(
+                "| version | scores | mean | std | PSI vs baseline "
+                "| labeled | max calib gap |"
+            )
+            out.append("|---|---|---|---|---|---|---|")
+            for v, row in versions.items():
+                s = row.get("scores") or {}
+                cal = row.get("calibration") or {}
+                out.append(
+                    "| `{}` | {} | {} | {} | {} | {} | {} |".format(
+                        v,
+                        s.get("count", 0),
+                        _fmt(s.get("mean")),
+                        _fmt(s.get("std")),
+                        _fmt(row.get("psi_vs_baseline")),
+                        cal.get("count", 0),
+                        _fmt(cal.get("max_gap")),
+                    )
+                )
         out.append("")
         return out
 

@@ -91,6 +91,13 @@ EXPECTED_POINTS = {
     "pipeline.cycle_start",
     "pipeline.reconcile",
     "pipeline.escalate",
+    # quality observability seams (plain points — the publish gate fires
+    # before ANY registry write so a kill leaves the registry untouched,
+    # and a drift-flush failure drops one snapshot section and nothing
+    # else; both armed in tests/test_quality.py and the chaos --quality
+    # row)
+    "quality.publish_gate",
+    "quality.drift_flush",
 }
 
 WRITE_PATH_POINTS = [
@@ -134,6 +141,8 @@ def test_registry_catalog_is_complete_and_stable():
     import photon_ml_tpu.incremental  # noqa: F401
     import photon_ml_tpu.pipeline  # noqa: F401
     import photon_ml_tpu.telemetry.requests  # noqa: F401
+    import photon_ml_tpu.quality.drift  # noqa: F401
+    import photon_ml_tpu.quality.gate  # noqa: F401
 
     registered = faults.registered_points()
     assert set(registered) == EXPECTED_POINTS

@@ -1,0 +1,807 @@
+"""Quality observability (ISSUE 20): GAME-level bootstrap error bars,
+the champion/challenger publish gate, and online calibration-drift
+telemetry.
+
+The acceptance spine: a deliberately degraded challenger (label-shuffled
+delta) is quarantined by ``cli refresh`` AND by a conductor cycle, the
+decision round-trips ``/healthz`` lineage, a healthy challenger
+publishes unchanged, and the masked-lane bootstrap's CIs agree with a
+full-lane bootstrap on the touched rows (the determinism contract of
+``bootstrap_re_weights``). Plus the two quality fault seams:
+``quality.publish_gate`` (a raise BEFORE any registry write leaves the
+registry untouched) and ``quality.drift_flush`` (absorbed by the
+snapshot provider-skip contract — the section vanishes from ONE
+snapshot, nothing else breaks).
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu import telemetry
+from photon_ml_tpu.faults import (
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    clear_plan,
+    install_plan,
+)
+from photon_ml_tpu.game.models import FixedEffectModel, GameModel
+from photon_ml_tpu.quality import (
+    GateDecision,
+    QualityGateRefused,
+    QualityStats,
+    decide_gate,
+    drift,
+    game_quality_stats,
+    weighted_auc,
+)
+from photon_ml_tpu.serving.registry import (
+    champion_quality,
+    publish_version,
+    scan_versions,
+)
+from photon_ml_tpu.testing import generate_game_dataset
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_D = 5  # fixed-effect dim shared by the in-process worlds
+
+
+# ---------------------------------------------------------------------------
+# weighted AUC + stats plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_weighted_auc_hand_cases():
+    y = np.array([0.0, 0.0, 1.0, 1.0])
+    w = np.ones(4)
+    # perfect separation, reversed separation, all tied
+    assert weighted_auc(np.array([0.1, 0.2, 0.8, 0.9]), y, w) == 1.0
+    assert weighted_auc(np.array([0.9, 0.8, 0.2, 0.1]), y, w) == 0.0
+    assert weighted_auc(np.zeros(4), y, w) == 0.5
+    # one concordant pair, one discordant, two ties of each -> hand value:
+    # pairs (pos, neg): (.5,.5)=tie, (.5,.9)=wrong, (.9,.5)=right, (.9,.9)=tie
+    got = weighted_auc(np.array([0.5, 0.9, 0.5, 0.9]), y, w)
+    assert got == pytest.approx((1.0 + 0.5 + 0.5) / 4.0)
+    # degenerate sets cannot gate: single-class or zero-weight class
+    assert math.isnan(weighted_auc(np.array([0.1, 0.9]), np.ones(2), np.ones(2)))
+    assert math.isnan(
+        weighted_auc(np.array([0.1, 0.9]), y[:2], np.array([1.0, 0.0]))
+    )
+
+
+def test_weighted_auc_weights_matter():
+    # the mis-ranked negative carries 3x weight: AUC drops below the
+    # unweighted value by exactly the weighted pair count
+    s = np.array([0.2, 0.7, 0.5, 0.9])
+    y = np.array([0.0, 0.0, 1.0, 1.0])
+    unweighted = weighted_auc(s, y, np.ones(4))
+    weighted = weighted_auc(s, y, np.array([1.0, 3.0, 1.0, 1.0]))
+    assert unweighted == pytest.approx(3 / 4)
+    # pairs: (.5 vs .2) ok w=1, (.5 vs .7) wrong w=3, (.9 vs .2) ok w=1,
+    # (.9 vs .7) ok w=3 -> 5/8
+    assert weighted == pytest.approx(5 / 8)
+
+
+def test_quality_stats_json_roundtrip():
+    stats = QualityStats(
+        auc=0.8, auc_ci_low=0.75, auc_ci_high=0.85, rows=100,
+        bootstrap_samples=16,
+    )
+    doc = stats.to_json()
+    assert "hl_p_value" not in doc  # None fields dropped
+    # tolerant load: extra keys (the recorded gate decision, bootstrap
+    # summaries) are ignored, not fatal
+    doc["gate"] = {"decision": "published"}
+    doc["bootstrap"] = {"entities": 3}
+    back = QualityStats.from_json(doc)
+    assert back.auc == 0.8 and back.rows == 100
+    assert math.isnan(QualityStats.from_json({}).auc)
+
+
+def _stats(auc, lo, hi, hl_p=None):
+    return QualityStats(
+        auc=auc, auc_ci_low=lo, auc_ci_high=hi, rows=200,
+        bootstrap_samples=8, hl_p_value=hl_p,
+    )
+
+
+def test_decide_gate_matrix():
+    champ = _stats(0.80, 0.75, 0.85, hl_p=0.4).to_json()
+
+    # override always bypasses, champion or not
+    d = decide_gate(_stats(0.10, 0.05, 0.15), champ, "v-1", override=True)
+    assert d.decision == "bypassed"
+    # no champion with recorded stats -> publish, recorded as such
+    assert decide_gate(_stats(0.6, 0.5, 0.7), None).decision == "no_champion"
+    # regression beyond the champion's error bars -> quarantined
+    d = decide_gate(_stats(0.70, 0.65, 0.74), champ, "v-1")
+    assert d.decision == "quarantined" and d.champion_version == "v-1"
+    assert "below champion bootstrap CI" in d.reason
+    # inside the CI -> published (the CI, not an epsilon, is the bar)
+    assert decide_gate(_stats(0.76, 0.72, 0.80), champ, "v-1").decision == (
+        "published"
+    )
+    # better than the champion, trivially published
+    assert decide_gate(_stats(0.90, 0.86, 0.93), champ, "v-1").decision == (
+        "published"
+    )
+    # degenerate eval set on either side -> cannot compare -> publish
+    nan = float("nan")
+    assert decide_gate(_stats(nan, nan, nan), champ, "v-1").decision == (
+        "published"
+    )
+    # H-L collapse while the champion held -> quarantined even with AUC ok
+    d = decide_gate(_stats(0.81, 0.78, 0.84, hl_p=1e-9), champ, "v-1")
+    assert d.decision == "quarantined" and "Hosmer-Lemeshow" in d.reason
+    # both collapsed (a hard dataset, not a regression) -> published
+    champ_bad_hl = _stats(0.80, 0.75, 0.85, hl_p=1e-9).to_json()
+    assert decide_gate(
+        _stats(0.81, 0.78, 0.84, hl_p=1e-9), champ_bad_hl, "v-1"
+    ).decision == "published"
+    # decisions serialize round-trippably
+    assert GateDecision(**{
+        k: v for k, v in d.to_json().items()
+    }).decision == "quarantined"
+
+
+# ---------------------------------------------------------------------------
+# game_quality_stats on a planted model
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def eval_world():
+    data, truth = generate_game_dataset(
+        n_users=8, rows_per_user=12, fe_dim=_D, re_dim=3, seed=7
+    )
+    model = GameModel(
+        task="logistic",
+        models={
+            "fixed": FixedEffectModel(
+                coefficients=jnp.asarray(truth["w_global"], jnp.float32),
+                shard_name="global",
+            )
+        },
+    )
+    return data, model, truth
+
+
+def test_game_quality_stats_ci_and_calibration(eval_world):
+    data, model, _ = eval_world
+    stats = game_quality_stats(model, data, num_samples=24, seed=3)
+    assert stats.rows == data.num_rows
+    assert stats.bootstrap_samples == 24
+    # planted coefficients rank far better than chance, and the
+    # bootstrap CI brackets the point estimate
+    assert stats.auc > 0.6
+    assert stats.auc_ci_low <= stats.auc <= stats.auc_ci_high
+    assert stats.auc_ci_low < stats.auc_ci_high
+    # logistic task -> Hosmer-Lemeshow calibration recorded
+    assert stats.hl_chi_square is not None
+    assert 0.0 <= stats.hl_p_value <= 1.0
+    # resampling is seeded: same seed, same error bars
+    again = game_quality_stats(model, data, num_samples=24, seed=3)
+    assert again.auc_ci_low == stats.auc_ci_low
+    assert again.auc_ci_high == stats.auc_ci_high
+
+
+# ---------------------------------------------------------------------------
+# masked-lane vs full-lane bootstrap agreement (the determinism contract)
+# ---------------------------------------------------------------------------
+
+
+def _entity_problem(rng, n_entities, rows, feats):
+    """Dense-as-COO per-entity logistic problems with planted
+    coefficients; returns host arrays so full and gathered batches are
+    built from the SAME values."""
+    x = rng.normal(size=(n_entities, rows, feats))
+    w_true = rng.normal(size=(n_entities, feats)) * 0.5
+    margins = np.einsum("erk,ek->er", x, w_true)
+    y = (rng.random((n_entities, rows)) < 1.0 / (1.0 + np.exp(-margins)))
+    return x, y.astype(np.float64)
+
+
+def _entity_batch(x, y):
+    from photon_ml_tpu.ops.sparse import SparseBatch
+
+    e, rows, feats = x.shape
+    nnz = rows * feats
+    return SparseBatch(
+        values=jnp.asarray(x.reshape(e, nnz), jnp.float32),
+        rows=jnp.asarray(np.broadcast_to(
+            np.repeat(np.arange(rows, dtype=np.int32), feats), (e, nnz)
+        )),
+        cols=jnp.asarray(np.broadcast_to(
+            np.tile(np.arange(feats, dtype=np.int32), rows), (e, nnz)
+        )),
+        labels=jnp.asarray(y, jnp.float32),
+        offsets=jnp.zeros((e, rows), jnp.float32),
+        weights=jnp.ones((e, rows), jnp.float32),
+        num_features=feats,
+    )
+
+
+def test_bootstrap_re_weights_deterministic_per_entity():
+    from photon_ml_tpu.diagnostics.bootstrap import bootstrap_re_weights
+
+    base = np.ones((5, 6))
+    base[3, 4:] = 0.0  # padding rows stay zero in every draw
+    a = bootstrap_re_weights(8, base, seed=5)
+    b = bootstrap_re_weights(8, base, seed=5)
+    assert np.array_equal(a, b)
+    assert a.shape == (8, 5, 6)
+    assert np.all(a[:, 3, 4:] == 0.0)
+    # each lane resamples exactly its live rows (multinomial of n over n)
+    assert np.array_equal(a.sum(axis=2)[:, 3], np.full(8, 4.0))
+    assert np.all(a.sum(axis=2)[:, :3] == 6.0)
+    # a different seed actually changes the draws
+    assert not np.array_equal(a, bootstrap_re_weights(8, base, seed=6))
+
+
+def test_masked_lane_bootstrap_matches_full_on_touched_rows():
+    """The masked-lane path gathers ``counts[:, idx, :]`` out of the
+    FULL bucket's seeded draw, so the touched lanes see byte-identical
+    resample weights — and therefore the same CIs — as a full-lane
+    bootstrap over the whole bucket."""
+    from photon_ml_tpu.diagnostics.bootstrap import (
+        bootstrap_random_effect,
+        bootstrap_re_weights,
+    )
+    from photon_ml_tpu.optim import (
+        OptimizerConfig,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+    )
+
+    rng = np.random.default_rng(21)
+    n_entities, rows, feats = 6, 12, 3
+    x, y = _entity_problem(rng, n_entities, rows, feats)
+    config = OptimizerConfig(
+        optimizer_type=OptimizerType.NEWTON,
+        max_iterations=12,
+        tolerance=1e-8,
+        regularization=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    counts = bootstrap_re_weights(
+        8, np.ones((n_entities, rows)), seed=4
+    )
+    full = bootstrap_random_effect(
+        _entity_batch(x, y), "logistic", config,
+        jnp.zeros((n_entities, feats), jnp.float32),
+        lane_weights=counts,
+    )
+
+    idx = np.array([1, 3, 4])  # the "touched" entity lanes
+    masked = bootstrap_random_effect(
+        _entity_batch(x[idx], y[idx]), "logistic", config,
+        jnp.zeros((len(idx), feats), jnp.float32),
+        lane_weights=counts[:, idx, :],
+    )
+    for field in ("mean", "ci_low", "ci_high", "median", "std_dev"):
+        np.testing.assert_allclose(
+            getattr(masked, field),
+            getattr(full, field)[idx],
+            rtol=1e-5, atol=1e-6, err_msg=field,
+        )
+    assert masked.num_samples == full.num_samples == 8
+    assert bool(np.all(masked.live_entities))
+    # the error bars are real: nonzero width, bracketing the mean
+    width = masked.ci_high - masked.ci_low
+    assert float(width.max()) > 0.0
+    assert np.all(masked.ci_low <= masked.mean + 1e-9)
+    assert np.all(masked.mean <= masked.ci_high + 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# drift telemetry: sketches, ring eviction, PSI, provider + seam
+# ---------------------------------------------------------------------------
+
+
+def test_drift_ring_eviction_bounded():
+    drift.reset()
+    telemetry.reset()
+    for i in range(drift.MAX_VERSIONS + 3):
+        drift.observe_scores(f"v-{i:08d}", np.full(4, 0.5))
+    rows = drift.MONITOR.snapshot_rows()["versions"]
+    assert len(rows) == drift.MAX_VERSIONS
+    # ring-evicted oldest-first: the first three versions are gone
+    assert "v-00000000" not in rows and "v-00000002" not in rows
+    assert f"v-{drift.MAX_VERSIONS + 2:08d}" in rows
+    snap = telemetry.snapshot()["counters"]
+    assert snap["quality.versions_evicted"] == 3
+    assert snap["quality.scores_observed"] == 4 * (drift.MAX_VERSIONS + 3)
+    drift.reset()
+
+
+def test_drift_psi_flags_shifted_distribution():
+    drift.reset()
+    rng = np.random.default_rng(0)
+    # baseline needs MIN_BASELINE_SAMPLES scores before it anchors PSI
+    drift.observe_scores("v-a", rng.uniform(0.2, 0.4, 200))
+    drift.observe_scores("v-b", rng.uniform(0.2, 0.4, 120))
+    drift.observe_scores("v-c", rng.uniform(0.6, 0.9, 120))
+    doc = drift.MONITOR.snapshot_rows()
+    assert doc["baseline_version"] == "v-a"
+    assert "psi_vs_baseline" not in doc["versions"]["v-a"]
+    # same distribution: stable; disjoint support: screaming drift
+    assert doc["versions"]["v-b"]["psi_vs_baseline"] < 0.1
+    assert doc["versions"]["v-c"]["psi_vs_baseline"] > 0.25
+    s = doc["versions"]["v-a"]["scores"]
+    assert s["count"] == 200 and sum(s["histogram"]) == 200
+    assert 0.2 <= s["mean"] <= 0.4
+    drift.reset()
+
+
+def test_drift_calibration_gap():
+    drift.reset()
+    rng = np.random.default_rng(1)
+    p = rng.uniform(0.05, 0.95, 400)
+    calibrated = (rng.random(400) < p).astype(np.float64)
+    drift.observe_labeled("v-good", p, calibrated)
+    drift.observe_labeled("v-bad", p, 1.0 - calibrated)
+    doc = drift.MONITOR.snapshot_rows()["versions"]
+    good = doc["v-good"]["calibration"]
+    bad = doc["v-bad"]["calibration"]
+    assert good["count"] == bad["count"] == 400
+    # labels drawn AT the predicted rate track it; inverted labels gap
+    assert good["max_gap"] < 0.25
+    assert bad["max_gap"] > 0.5
+    assert len(good["predicted_mean"]) == drift.NUM_BINS
+    drift.reset()
+
+
+def test_quality_snapshot_provider_and_drift_flush_seam():
+    """The ``"quality"`` section rides every telemetry snapshot, and an
+    injected raise at ``quality.drift_flush`` is absorbed by the
+    provider-skip contract: the section vanishes from that one snapshot,
+    nothing else fails, and the next snapshot has it back."""
+    drift.reset()
+    drift.observe_scores("v-seam", np.array([0.3, 0.7]))
+    snap = telemetry.snapshot()
+    assert snap["quality"]["versions"]["v-seam"]["scores"]["count"] == 2
+
+    install_plan(FaultPlan([FaultRule(point="quality.drift_flush",
+                                      action="raise")]))
+    try:
+        broken = telemetry.snapshot()  # must not raise
+        assert "quality" not in broken
+        assert "counters" in broken  # the rest of the snapshot survives
+    finally:
+        clear_plan()
+    again = telemetry.snapshot()
+    assert "v-seam" in again["quality"]["versions"]
+    drift.reset()
+
+
+def test_engine_score_rows_feeds_drift_sketch(eval_world):
+    from photon_ml_tpu.serving.engine import ScoringEngine
+
+    _, model, truth = eval_world
+    drift.reset()
+    engine = ScoringEngine(model, max_batch=16, version="v-drift-e2e")
+    Xg = np.asarray(truth["Xg"])
+    rows = [
+        {"features": {"global": [
+            [j, float(Xg[i, j])] for j in range(_D) if Xg[i, j] != 0
+        ]}}
+        for i in range(40)
+    ]
+    scores = engine.score_rows(rows)
+    doc = drift.MONITOR.snapshot_rows()["versions"]
+    sketch = doc["v-drift-e2e"]["scores"]
+    assert sketch["count"] == 40
+    # the sketch saw exactly the served mean predictions
+    assert sketch["mean"] == pytest.approx(float(np.mean(scores)), abs=1e-5)
+    assert sketch["min"] >= 0.0 and sketch["max"] <= 1.0
+    drift.reset()
+
+
+# ---------------------------------------------------------------------------
+# the gated registry publish: seam, quarantine, lineage round-trip
+# ---------------------------------------------------------------------------
+
+
+def _fe_model(scale=1.0):
+    return GameModel(
+        task="logistic",
+        models={
+            "fixed": FixedEffectModel(
+                coefficients=jnp.asarray(
+                    np.linspace(-0.5, 0.5, _D) * scale, jnp.float32
+                ),
+                shard_name="global",
+            )
+        },
+    )
+
+
+_FE_MAPS = {"global": [f"c{j}" for j in range(_D)]}
+
+
+def test_publish_gate_seam_leaves_registry_untouched(tmp_path):
+    """A raise at ``quality.publish_gate`` fires BEFORE any registry
+    write: no new version, no ``.tmp-`` debris, no wrong quarantine —
+    the in-process face of the ``tools/chaos.py --quality`` crash row."""
+    reg = str(tmp_path / "registry")
+    publish_version(
+        reg, _fe_model(), _FE_MAPS,
+        quality=_stats(0.80, 0.75, 0.85).to_json(),
+    )
+    before = sorted(os.listdir(reg))
+    install_plan(FaultPlan([FaultRule(point="quality.publish_gate",
+                                      action="raise")]))
+    try:
+        with pytest.raises(InjectedFault):
+            publish_version(
+                reg, _fe_model(0.1), _FE_MAPS,
+                quality=_stats(0.55, 0.50, 0.60).to_json(),
+            )
+    finally:
+        clear_plan()
+    assert sorted(os.listdir(reg)) == before
+    # ungated publishes (quality=None) never hit the seam
+    install_plan(FaultPlan([FaultRule(point="quality.publish_gate",
+                                      action="raise")]))
+    try:
+        publish_version(reg, _fe_model(), _FE_MAPS)
+    finally:
+        clear_plan()
+    assert len(scan_versions(reg)) == 2
+
+
+def test_publish_gate_quarantines_and_lineage_roundtrip(tmp_path):
+    from photon_ml_tpu.serving.engine import ScoringEngine
+    from photon_ml_tpu.serving.server import ScoringService
+
+    telemetry.reset()
+    reg = str(tmp_path / "registry")
+    champ_stats = _stats(0.80, 0.75, 0.85)
+    publish_version(
+        reg, _fe_model(), _FE_MAPS,
+        quality=champ_stats.to_json(),
+        lineage={"base_kind": "test"},
+    )
+    champ_v, champ_q = champion_quality(reg)
+    assert champ_v == "v-00000001"
+    assert champ_q["auc"] == pytest.approx(0.80)
+    assert champ_q["gate"]["decision"] == "no_champion"
+
+    # a challenger regressing beyond the champion's CI is refused,
+    # parked invisible to scans, with the decision in its metadata
+    with pytest.raises(QualityGateRefused) as exc_info:
+        publish_version(
+            reg, _fe_model(0.1), _FE_MAPS,
+            quality=_stats(0.55, 0.50, 0.60).to_json(),
+            lineage={"base_kind": "test"},
+        )
+    exc = exc_info.value
+    assert exc.decision.decision == "quarantined"
+    assert exc.decision.champion_version == "v-00000001"
+    qdir = exc.quarantine_path
+    assert os.path.basename(qdir) == "quarantined-v-00000002"
+    assert [v for _, v in scan_versions(reg)] == [
+        os.path.join(reg, "v-00000001")
+    ]
+    with open(os.path.join(qdir, "model-metadata.json")) as fh:
+        qmeta = json.load(fh)
+    assert qmeta["extra"]["quality"]["gate"]["decision"] == "quarantined"
+    assert qmeta["extra"]["lineage"]["quality_gate"]["decision"] == (
+        "quarantined"
+    )
+
+    # a healthy challenger publishes unchanged, takes the refused slot's
+    # version number, and the decision round-trips /healthz lineage
+    good = _stats(0.82, 0.78, 0.86)
+    path = publish_version(
+        reg, _fe_model(1.1), _FE_MAPS,
+        quality=good.to_json(),
+        lineage={"base_kind": "test"},
+    )
+    assert os.path.basename(path) == "v-00000002"
+    engine = ScoringEngine.load(path, max_batch=8)
+    gate = engine.lineage["quality_gate"]
+    assert gate["decision"] == "published"
+    assert gate["champion_version"] == "v-00000001"
+    assert gate["candidate"]["auc"] == pytest.approx(0.82)
+    health = ScoringService(engine).health()
+    assert health["lineage"]["quality_gate"]["decision"] == "published"
+    # the new champion for the NEXT gate is the freshest published stats
+    assert champion_quality(reg)[0] == "v-00000002"
+
+    counters = telemetry.snapshot()["counters"]
+    assert counters["quality.gate_quarantined"] == 1
+    assert counters["quality.gate_published"] == 1
+    assert counters["quality.gate_no_champion"] == 1
+
+
+def test_gate_override_records_bypass(tmp_path):
+    reg = str(tmp_path / "registry")
+    publish_version(
+        reg, _fe_model(), _FE_MAPS, quality=_stats(0.80, 0.75, 0.85).to_json()
+    )
+    # the same regressed challenger, but with --no-quality-gate semantics
+    path = publish_version(
+        reg, _fe_model(0.1), _FE_MAPS,
+        quality=_stats(0.55, 0.50, 0.60).to_json(),
+        gate_override=True,
+    )
+    with open(os.path.join(path, "model-metadata.json")) as fh:
+        meta = json.load(fh)
+    assert meta["extra"]["quality"]["gate"]["decision"] == "bypassed"
+    assert len(scan_versions(reg)) == 2
+
+
+# ---------------------------------------------------------------------------
+# cli refresh: the label-shuffled challenger is quarantined end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def quality_cli_base(tmp_path_factory):
+    """One CLI base train plus three deltas: two clean (follow the
+    planted model) and one label-shuffled (coin-flip labels, the
+    degraded challenger)."""
+    from photon_ml_tpu.data.avro import TRAINING_EXAMPLE_AVRO, write_avro
+
+    rng = np.random.default_rng(42)
+    tmp = tmp_path_factory.mktemp("cli_quality")
+    d, n_users = _D, 5
+    w = rng.normal(size=d)
+    u_eff = rng.normal(size=n_users)
+
+    def write_shard(path, n, seed, shuffle_labels=False):
+        r = np.random.default_rng(seed)
+        users = r.integers(0, n_users, n)
+        X = r.normal(size=(n, d))
+        logits = X @ w + u_eff[users]
+        y = (r.random(n) < 1 / (1 + np.exp(-logits))).astype(float)
+        if shuffle_labels:
+            y = r.permutation(y)  # break the feature-label link
+
+        def recs():
+            for i in range(n):
+                yield {
+                    "uid": str(i),
+                    "label": float(y[i]),
+                    "features": [
+                        {"name": f"c{j}", "term": "", "value": float(X[i, j])}
+                        for j in range(d)
+                    ],
+                    "metadataMap": {"userId": str(users[i])},
+                    "weight": None,
+                    "offset": None,
+                }
+
+        write_avro(path, TRAINING_EXAMPLE_AVRO, recs())
+
+    train_path = str(tmp / "train.avro")
+    write_shard(train_path, 220, 1)
+    clean_delta = str(tmp / "delta-clean.avro")
+    write_shard(clean_delta, 60, 2)
+    bad_delta = str(tmp / "delta-shuffled.avro")
+    write_shard(bad_delta, 240, 3, shuffle_labels=True)
+    clean_delta2 = str(tmp / "delta-clean-2.avro")
+    write_shard(clean_delta2, 60, 4)
+
+    config = {
+        "task": "logistic",
+        "input": {
+            "format": "avro",
+            "paths": [train_path],
+            "feature_shards": {"global": ["features"]},
+            "id_columns": ["userId"],
+        },
+        "coordinates": {
+            "fixed": {
+                "type": "fixed_effect",
+                "shard_name": "global",
+                "optimizer": {"regularization": "l2",
+                              "regularization_weight": 0.1},
+            },
+            "perUser": {
+                "type": "random_effect",
+                "shard_name": "global",
+                "id_name": "userId",
+                "optimizer": {"regularization": "l2",
+                              "regularization_weight": 1.0},
+            },
+        },
+        "num_iterations": 1,
+        "output_dir": str(tmp / "base-model"),
+        "checkpoint": {"dir": str(tmp / "base-ckpt"), "resume": False},
+    }
+    cfg_path = tmp / "train.json"
+    cfg_path.write_text(json.dumps(config))
+    _run_cli(["train", "--config", str(cfg_path)], cwd=tmp)
+    return dict(tmp=tmp, cfg_path=cfg_path, ckpt=str(tmp / "base-ckpt"),
+                clean_delta=clean_delta, bad_delta=bad_delta,
+                clean_delta2=clean_delta2)
+
+
+def _run_cli(args, cwd, expect_rc=0):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "photon_ml_tpu.cli", *args],
+        capture_output=True, text=True, cwd=str(cwd), env=env, timeout=600,
+    )
+    assert proc.returncode == expect_rc, (
+        proc.returncode, proc.stderr[-3000:]
+    )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_cli_refresh_quarantines_label_shuffled_delta(quality_cli_base):
+    tmp = quality_cli_base["tmp"]
+    reg = str(tmp / "registry")
+
+    def refresh(delta, out_name):
+        return _run_cli(
+            [
+                "refresh",
+                "--config", str(quality_cli_base["cfg_path"]),
+                "--warm-start", quality_cli_base["ckpt"],
+                "--delta", delta,
+                "--registry-dir", reg,
+                "--output-dir", str(tmp / out_name),
+            ],
+            cwd=tmp,
+        )["freshness"]
+
+    # refresh 1: clean delta, empty registry -> published with error
+    # bars recorded (no champion yet, and the gate says so)
+    f1 = refresh(quality_cli_base["clean_delta"], "fresh-1")
+    assert f1["published_version"].endswith("v-00000001")
+    q1 = f1["quality"]
+    assert q1["auc_ci_low"] <= q1["auc"] <= q1["auc_ci_high"]
+    assert q1["bootstrap_samples"] == 32
+    # the masked-lane bootstrap summary rides the published block
+    assert q1["bootstrap"]["num_samples"] == 32
+    buckets = q1["bootstrap"]["coordinates"]["perUser"]
+    assert sum(b["touched_lanes"] for b in buckets.values()) >= 1
+    assert any(b.get("mean_ci_width", 0) > 0 for b in buckets.values())
+    assert "quality_gate" not in f1
+    with open(os.path.join(reg, "v-00000001", "model-metadata.json")) as fh:
+        meta = json.load(fh)
+    assert meta["extra"]["quality"]["gate"]["decision"] == "no_champion"
+    assert meta["extra"]["lineage"]["quality_gate"]["decision"] == (
+        "no_champion"
+    )
+
+    # refresh 2: label-shuffled delta -> the candidate's AUC on its own
+    # combined data collapses below the champion's CI -> quarantined,
+    # rc 0 (a refused candidate is a RESULT), champion keeps serving
+    f2 = refresh(quality_cli_base["bad_delta"], "fresh-2")
+    assert "published_version" not in f2
+    gate = f2["quality_gate"]
+    assert gate["decision"] == "quarantined"
+    assert gate["champion_version"] == "v-00000001"
+    assert gate["candidate"]["auc"] < gate["champion"]["auc_ci_low"]
+    assert os.path.basename(gate["quarantine_path"]) == (
+        "quarantined-v-00000002"
+    )
+    assert os.path.isdir(gate["quarantine_path"])
+    assert [os.path.basename(p) for _, p in scan_versions(reg)] == [
+        "v-00000001"
+    ]
+
+    # refresh 3: a healthy challenger publishes unchanged into the slot
+    # the refusal never consumed
+    f3 = refresh(quality_cli_base["clean_delta2"], "fresh-3")
+    assert f3["published_version"].endswith("v-00000002")
+    assert "quality_gate" not in f3
+    with open(os.path.join(reg, "v-00000002", "model-metadata.json")) as fh:
+        meta3 = json.load(fh)
+    g3 = meta3["extra"]["quality"]["gate"]
+    assert g3["decision"] == "published"
+    assert g3["champion_version"] == "v-00000001"
+
+
+# ---------------------------------------------------------------------------
+# conductor cycles: automatic quarantine mid-pipeline + the Quality report
+# ---------------------------------------------------------------------------
+
+
+def test_conductor_cycle_quarantine_and_quality_report(
+    quality_cli_base, tmp_path
+):
+    """A 3-cycle conductor run over the same world: cycle 1 publishes
+    the champion with error bars, cycle 2's label-shuffled delta is
+    automatically quarantined (the champion keeps serving), cycle 3
+    publishes a healthy challenger — and the whole story renders in the
+    RunReport "Quality" section."""
+    import shutil
+
+    from photon_ml_tpu.pipeline import FreshnessPipeline, PipelineSpec
+    from photon_ml_tpu.telemetry.report import RunReport
+
+    telemetry.reset()
+    drift.reset()
+    tmp = quality_cli_base["tmp"]
+    with open(quality_cli_base["cfg_path"]) as fh:
+        config = json.load(fh)
+    config.pop("output_dir", None)
+    config.pop("checkpoint", None)
+    delta_dir = tmp_path / "deltas"
+    delta_dir.mkdir()
+    spec = PipelineSpec(
+        config=config,
+        delta_dir=str(delta_dir),
+        base_dir=quality_cli_base["ckpt"],
+        registry_dir=str(tmp_path / "registry"),
+        workdir=str(tmp_path / "work"),
+        interval_s=0.01,
+        escalate_touched_fraction=1.1,
+        bootstrap_samples=16,
+    )
+    pipe = FreshnessPipeline(spec)
+    try:
+        shutil.copy(quality_cli_base["clean_delta"],
+                    delta_dir / "delta-0001.avro")
+        e1 = pipe.run_cycle()
+        assert e1["published_version"] == "v-00000001"
+        with open(os.path.join(spec.registry_dir, "v-00000001",
+                               "model-metadata.json")) as fh:
+            m1 = json.load(fh)
+        q1 = m1["extra"]["quality"]
+        assert q1["gate"]["decision"] == "no_champion"
+        assert q1["auc_ci_low"] <= q1["auc"] <= q1["auc_ci_high"]
+        # the masked-lane bootstrap summary rides the published block
+        assert q1["bootstrap"]["num_samples"] == 16
+
+        bad = delta_dir / "delta-0002.avro"
+        shutil.copy(quality_cli_base["bad_delta"], bad)
+        e2 = pipe.run_cycle()
+        assert e2["published_version"] is None
+        assert e2["quarantined_version"] == "quarantined-v-00000002"
+        assert e2["quality_gate"]["decision"] == "quarantined"
+        # the champion keeps serving through the refusal
+        assert pipe._registry.current_version == "v-00000001"
+        # the digest cursor advanced: the refused delta is NOT retried
+        assert pipe.run_cycle()["idle"] is True
+
+        # the degraded shard is cleaned out of the window; the next
+        # cycle's healthy candidate publishes unchanged
+        os.remove(bad)
+        shutil.copy(quality_cli_base["clean_delta2"],
+                    delta_dir / "delta-0003.avro")
+        e4 = pipe.run_cycle()
+        assert e4["published_version"] == "v-00000002"
+        assert pipe._registry.current_version == "v-00000002"
+        with open(os.path.join(spec.registry_dir, "v-00000002",
+                               "model-metadata.json")) as fh:
+            m4 = json.load(fh)
+        g4 = m4["extra"]["quality"]["gate"]
+        assert g4["decision"] == "published"
+        assert g4["champion_version"] == "v-00000001"
+
+        s = pipe.summary()
+        assert s["published_versions"] == ["v-00000001", "v-00000002"]
+        assert s["quarantined_versions"] == ["quarantined-v-00000002"]
+
+        report = RunReport.from_live()
+        doc = report.quality_summary()
+        assert doc is not None
+        assert doc["gate_quarantined"] == 1
+        assert doc["gate_published"] == 1
+        assert doc["pipeline_quarantines"] == 1
+        assert doc["stats_computed"] == 3
+        md = report.to_markdown()
+        assert "## Quality" in md
+        assert "**quarantined**" in md
+        assert "regressed challenger" in md
+    finally:
+        pipe._close("completed")
+    telemetry.reset()
+    drift.reset()

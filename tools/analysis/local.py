@@ -82,6 +82,11 @@ L011_HOT_DIRS = (
     # would hide recompiles that accumulate directly into the
     # event→served staleness p99 the pipeline tier gates on
     os.path.join("photon_ml_tpu", "pipeline") + os.sep,
+    # the quality layer runs inside every gated publish (gate stats on
+    # the candidate model) and inside every score_rows chunk (drift
+    # sketches): a bare jax.jit or stray device sync there would tax
+    # exactly the serving and publish paths the quality benches gate
+    os.path.join("photon_ml_tpu", "quality") + os.sep,
 )
 L011_HOT_FILES = {
     os.path.join("photon_ml_tpu", "serving", "engine.py"),
@@ -89,6 +94,11 @@ L011_HOT_FILES = {
     # cadence: a bare jax.jit there would hide exactly the executables
     # whose recompiles the SLO bench gates p99 flatness over
     os.path.join("photon_ml_tpu", "serving", "nearline.py"),
+    # GLMix bootstrap: B resample lanes ride the sweep solver family on
+    # the publish path (and the masked incremental variant); a bare
+    # jax.jit there would hide exactly the lane-composition executables
+    # bench_diagnostics gates the <=2x overhead claim on
+    os.path.join("photon_ml_tpu", "diagnostics", "bootstrap.py"),
     os.path.join("photon_ml_tpu", "training.py"),
     # the executable profiler wraps EVERY instrumented dispatch: a bare
     # jax.jit inside it would both escape its own accounting and put an

@@ -40,6 +40,7 @@ CLI::
     python -m tools.chaos --workdir /tmp/chaos --json out.json
     python -m tools.chaos --workdir /tmp/chaos --fleet    # distributed rows
     python -m tools.chaos --workdir /tmp/chaos --pipeline # conductor rows
+    python -m tools.chaos --workdir /tmp/chaos --quality  # publish-gate row
     python -m tools.chaos --worker --dir D                # one fit (internal)
 
 The worker fit is self-contained and seed-deterministic (same chunk data
@@ -1070,6 +1071,181 @@ def run_pipeline_matrix(
     return report
 
 
+def _quality_worker_main(directory: str, mode: str) -> int:
+    """Publish ONE version through the champion/challenger gate (runs in
+    a subprocess so the armed variant can hard-kill at the seam).
+
+    Modes: ``champion`` publishes a healthy first version (no champion
+    yet — gate passes with decision no_champion); ``challenger-bad``
+    submits quality stats whose AUC sits below the champion's bootstrap
+    CI (must quarantine); ``challenger-good`` submits stats inside the
+    CI (must publish)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.game.models import FixedEffectModel, GameModel
+    from photon_ml_tpu.quality import QualityGateRefused, QualityStats
+    from photon_ml_tpu.serving.registry import publish_version
+
+    model = GameModel(
+        task="logistic",
+        models={
+            "fixed": FixedEffectModel(
+                coefficients=jnp.asarray(
+                    np.linspace(-0.5, 0.5, DIM), jnp.float32
+                ),
+                shard_name="global",
+            )
+        },
+    )
+    index_maps = {"global": [f"f{i}" for i in range(DIM)]}
+    stats = {
+        "champion": QualityStats(
+            auc=0.80, auc_ci_low=0.75, auc_ci_high=0.85,
+            rows=200, bootstrap_samples=8,
+        ),
+        "challenger-bad": QualityStats(
+            auc=0.60, auc_ci_low=0.55, auc_ci_high=0.65,
+            rows=200, bootstrap_samples=8,
+        ),
+        "challenger-good": QualityStats(
+            auc=0.82, auc_ci_low=0.77, auc_ci_high=0.87,
+            rows=200, bootstrap_samples=8,
+        ),
+    }[mode]
+    try:
+        path = publish_version(
+            os.path.join(directory, "registry"),
+            model,
+            index_maps,
+            quality=stats.to_json(),
+            lineage={"base_kind": "chaos", "mode": mode},
+        )
+        print(json.dumps({"published": os.path.basename(path)}))
+    except QualityGateRefused as exc:
+        print(json.dumps({
+            "quarantined": os.path.basename(exc.quarantine_path or ""),
+            "decision": exc.decision.to_json(),
+        }))
+    return 0
+
+
+def run_quality_matrix(workdir: str) -> dict:
+    """The publish-gate crash row (ISSUE 20): a publisher hard-killed
+    MID-GATE-EVALUATION (``quality.publish_gate`` fires before any
+    registry write) must leave the registry with (1) no partial or
+    ``.tmp-`` version, (2) no WRONGLY-quarantined version, and (3) the
+    champion byte-identical. The unarmed rerun must then make the
+    CORRECT decision over the same registry: the regressed challenger
+    quarantines (champion still serving), the healthy challenger
+    publishes."""
+    from photon_ml_tpu import faults
+
+    import photon_ml_tpu.quality  # noqa: F401 — registers the seam
+
+    point = "quality.publish_gate"
+    t0 = time.monotonic()
+    report: dict = {
+        "workdir": workdir,
+        "points": [point],
+        "results": {},
+        "skipped": [],
+        "ok": True,
+    }
+    entry: dict = {"point": point}
+    problems: list = []
+    os.makedirs(workdir, exist_ok=True)
+    reg = os.path.join(workdir, "registry")
+
+    def worker(mode, plan=None):
+        return subprocess.run(
+            [sys.executable, "-m", "tools.chaos", "--worker-quality",
+             "--dir", workdir, "--mode", mode],
+            env=_worker_env(plan), cwd=_repo_root(),
+            capture_output=True, text=True, timeout=600,
+        )
+
+    def last_json(proc):
+        try:
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            return {}
+
+    # 1. the champion lands (first version: gate passes, no champion yet)
+    champ = worker("champion")
+    champ_name = last_json(champ).get("published")
+    if champ.returncode != 0 or not champ_name:
+        problems.append(
+            f"champion publish failed (rc={champ.returncode}): "
+            f"{champ.stderr[-500:]}"
+        )
+    champion_digest = _tree_digest(reg)
+    champ_dir = os.path.join(reg, champ_name or "")
+    listing_before = sorted(os.listdir(reg)) if os.path.isdir(reg) else []
+
+    # 2. hard kill mid-gate-evaluation on a REGRESSED challenger: the
+    # seam fires before any write, so the kill must be invisible
+    armed = worker("challenger-bad", plan=exit_plan(point))
+    entry["armed_rc"] = armed.returncode
+    if armed.returncode != faults.DEFAULT_EXIT_CODE:
+        problems.append(
+            f"armed publisher exited {armed.returncode}, expected "
+            f"{faults.DEFAULT_EXIT_CODE} (did the seam fire?) "
+            f"{armed.stderr[-500:]}"
+        )
+    listing = sorted(os.listdir(reg)) if os.path.isdir(reg) else []
+    entry["registry_after_kill"] = listing
+    if any(n.startswith(".tmp-") for n in listing):
+        problems.append(f"kill left .tmp- assembly debris: {listing}")
+    if any(n.startswith("quarantined-") for n in listing):
+        problems.append(
+            f"kill mid-gate left a wrongly-quarantined version: {listing}"
+        )
+    if listing != listing_before:
+        problems.append(
+            f"kill changed the registry: {listing_before} -> {listing}"
+        )
+    if _tree_digest(reg) != champion_digest:
+        problems.append("hard kill mutated the champion version")
+
+    # 3. unarmed rerun of the regressed challenger: quarantines, and the
+    # champion keeps serving
+    rerun = worker("challenger-bad")
+    out = last_json(rerun)
+    entry["rerun_rc"] = rerun.returncode
+    entry["quarantined"] = out.get("quarantined")
+    if rerun.returncode != 0 or not out.get("quarantined"):
+        problems.append(
+            f"unarmed regressed challenger did not quarantine cleanly "
+            f"(rc={rerun.returncode}, out={out}) {rerun.stderr[-500:]}"
+        )
+    listing = sorted(os.listdir(reg)) if os.path.isdir(reg) else []
+    if not any(n.startswith("quarantined-") for n in listing):
+        problems.append(f"no quarantine directory after rerun: {listing}")
+
+    # 4. a healthy challenger publishes over the same registry
+    good = worker("challenger-good")
+    out = last_json(good)
+    entry["published"] = out.get("published")
+    if good.returncode != 0 or not out.get("published"):
+        problems.append(
+            f"healthy challenger failed to publish "
+            f"(rc={good.returncode}, out={out}) {good.stderr[-500:]}"
+        )
+    if champ_name and not os.path.isdir(champ_dir):
+        problems.append(
+            f"champion {champ_name} vanished during the matrix"
+        )
+
+    if problems:
+        entry["error"] = "; ".join(problems)
+        report["ok"] = False
+    entry["passed"] = not problems
+    report["results"][point] = entry
+    report["elapsed_s"] = round(time.monotonic() - t0, 3)
+    return report
+
+
 # ---------------------------------------------------------------------------
 # the worker fit (runs in the subprocess)
 # ---------------------------------------------------------------------------
@@ -1164,6 +1340,18 @@ def main(argv=None) -> int:
                         help="run the PIPELINE matrix (the freshness-"
                         "conductor daemon hard-killed at each pipeline.* "
                         "seam) instead of the write-path matrix")
+    parser.add_argument("--quality", action="store_true",
+                        help="run the QUALITY row (a publisher hard-"
+                        "killed mid-gate-evaluation at "
+                        "quality.publish_gate must leave no partial or "
+                        "wrongly-quarantined version; the unarmed rerun "
+                        "quarantines the regressed challenger and "
+                        "publishes the healthy one)")
+    parser.add_argument("--worker-quality", action="store_true",
+                        help="publish ONE gated version (internal)")
+    parser.add_argument("--mode", default="champion",
+                        help="worker-quality mode: champion | "
+                        "challenger-bad | challenger-good")
     parser.add_argument("--points", nargs="*",
                         help="subset of write-path points (default: all)")
     parser.add_argument("--nth", type=int, default=1,
@@ -1178,9 +1366,15 @@ def main(argv=None) -> int:
         if not args.dir:
             parser.error("--worker requires --dir")
         return _worker_main(args.dir)
+    if args.worker_quality:
+        if not args.dir:
+            parser.error("--worker-quality requires --dir")
+        return _quality_worker_main(args.dir, args.mode)
     if not args.workdir:
         parser.error("--workdir is required (or --worker --dir)")
-    if args.pipeline:
+    if args.quality:
+        report = run_quality_matrix(args.workdir)
+    elif args.pipeline:
         report = run_pipeline_matrix(
             args.workdir, points=args.points, budget_s=args.budget_s,
         )
@@ -1201,7 +1395,13 @@ def main(argv=None) -> int:
         with open(args.json_out, "w", encoding="utf-8") as fh:
             json.dump(report, fh, indent=2, sort_keys=True)
     for point, entry in report["results"].items():
-        if args.pipeline:
+        if args.quality:
+            status = "ok" if entry.get("passed") else "FAIL"
+            print(f"{status:4s} {point}  (armed rc={entry.get('armed_rc')}, "
+                  f"quarantined={entry.get('quarantined')}, "
+                  f"published={entry.get('published')}, "
+                  f"error={entry.get('error')})")
+        elif args.pipeline:
             status = "ok" if entry.get("passed") else "FAIL"
             print(f"{status:4s} {point}  (armed rc={entry.get('armed_rc')}, "
                   f"published={entry.get('published_versions')}, "
